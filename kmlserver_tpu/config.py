@@ -1,0 +1,177 @@
+"""Typed configuration over the reference's env-var contract.
+
+The reference configures both workloads purely through environment variables
+(reference: machine-learning/main.py:17-49, rest_api/app/main.py:31-50), bound
+in-cluster by the manifests (reference: kubernetes/job.yaml:24-40,
+kubernetes/deployment.yaml:33-53). The variable NAMES and defaults here are
+that contract and must not drift — the Kubernetes layer depends on them.
+
+On top, the TPU rebuild adds its own knobs under a ``KMLS_`` prefix (mesh
+shape, rule-row capacity, confidence semantics, server port); these have safe
+defaults and are absent from the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from .utils.envfile import load_dotenv
+
+
+def _getenv_int(name: str, default: int) -> int:
+    raw = os.getenv(name)
+    return int(raw) if raw not in (None, "") else default
+
+
+def _getenv_float(name: str, default: float) -> float:
+    raw = os.getenv(name)
+    return float(raw) if raw not in (None, "") else default
+
+
+def _getenv_bool(name: str, default: bool) -> bool:
+    raw = os.getenv(name)
+    if raw in (None, ""):
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# Columns dropped from the raw CSV before any processing
+# (reference: machine-learning/main.py:42).
+DROP_COLUMNS = ("duration_ms",)
+
+# First dataset index in the rotation scheme (reference: machine-learning/main.py:46).
+BASE_INDEX = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MiningConfig:
+    """Batch mining job config (reference: machine-learning/main.py:17-49,
+    kubernetes/job.yaml:24-40)."""
+
+    base_dir: str = "./api-data"
+    datasets_dir: str = ""
+    regex_filename: str = "2023_spotify_ds*.csv"
+    min_support: float = 0.05
+    pickles_folder: str = "pickles"
+    recommendations_file: str = "recommendations.pickle"
+    best_tracks_file: str = "best_tracks.pickle"
+    data_invalidation_file: str = "last_execution.txt"
+    top_tracks_save_percentile: float = 0.03
+    artists_mapping_file: str = "artistsMapping.pickle"
+    repeated_tracks_file: str = "trackNameToRepeatedUris.pickle"
+    track_info_file: str = "trackIdsToInfo.pickle"
+    datasets_list_file: str = "datasets_list.txt"
+    dataset_history_file: str = "dataset_history.csv"
+    sample_ratio: float = 1.0
+
+    # --- TPU-rebuild knobs (not in the reference) ---
+    # Max itemset length the miner enumerates. 2 reproduces the reference
+    # fast path's OUTPUT exactly (see ops/support.py dominance note); 3/4 add
+    # the itemset census + true-confidence rules.
+    max_itemset_len: int = 2
+    # Padded per-antecedent rule-row capacity (consequents kept per song).
+    k_max_consequents: int = 256
+    # "support" = reference fast-path semantics (itemset support stored as the
+    # confidence, symmetric rules — machine-learning/main.py:284-296);
+    # "confidence" = the dormant slow path's true asymmetric confidence
+    # (machine-learning/main.py:224-260).
+    confidence_mode: str = "support"
+    # Minimum confidence when confidence_mode == "confidence"
+    # (reference slow path hardcodes 0.04 — machine-learning/main.py:226-227).
+    min_confidence: float = 0.04
+    # Device-mesh shape for sharded mining: "auto", "1x1", "dpxtp" e.g. "4x1".
+    mesh_shape: str = "auto"
+    # Use the bit-packed popcount path instead of int8 matmul when the
+    # one-hot matrix would exceed this many elements.
+    bitpack_threshold_elems: int = 1 << 28
+    # Write the tensor-native artifact (rules npz) alongside the pickles.
+    write_tensor_artifact: bool = True
+
+    @property
+    def pickles_dir(self) -> str:
+        return os.path.join(self.base_dir, self.pickles_folder)
+
+    @staticmethod
+    def from_env(dotenv_path: str | None = ".env") -> "MiningConfig":
+        if dotenv_path:
+            load_dotenv(dotenv_path)
+        base_dir = os.getenv("BASE_DIR", "./api-data")
+        return MiningConfig(
+            base_dir=base_dir,
+            datasets_dir=os.getenv("DATASETS_DIR", os.path.join(base_dir, "datasets")),
+            regex_filename=os.getenv("REGEX_FILENAME", "2023_spotify_ds*.csv"),
+            min_support=_getenv_float("MIN_SUPPORT", 0.05),
+            pickles_folder=os.getenv("PICKLES_FOLDER", "pickles"),
+            recommendations_file=os.getenv("RECOMMENDATIONS_FILE", "recommendations.pickle"),
+            best_tracks_file=os.getenv("BEST_TRACKS_FILE", "best_tracks.pickle"),
+            data_invalidation_file=os.getenv("DATA_INVALIDATION_FILE", "last_execution.txt"),
+            top_tracks_save_percentile=_getenv_float("TOP_TRACKS_SAVE_PERCENTILE", 0.03),
+            artists_mapping_file=os.getenv("ARTISTS_MAPPING_FILE", "artistsMapping.pickle"),
+            repeated_tracks_file=os.getenv("REPEATED_TRACKS_FILE", "trackNameToRepeatedUris.pickle"),
+            track_info_file=os.getenv("TRACK_INFO_FILE", "trackIdsToInfo.pickle"),
+            datasets_list_file=os.getenv("DATASETS_LIST_FILE", "datasets_list.txt"),
+            dataset_history_file=os.getenv("DATASET_HISTORY_FILE", "dataset_history.csv"),
+            sample_ratio=_getenv_float("SAMPLE_RATIO", 1.0),
+            max_itemset_len=_getenv_int("KMLS_MAX_ITEMSET_LEN", 2),
+            k_max_consequents=_getenv_int("KMLS_K_MAX_CONSEQUENTS", 256),
+            confidence_mode=os.getenv("KMLS_CONFIDENCE_MODE", "support"),
+            min_confidence=_getenv_float("KMLS_MIN_CONFIDENCE", 0.04),
+            mesh_shape=os.getenv("KMLS_MESH_SHAPE", "auto"),
+            bitpack_threshold_elems=_getenv_int("KMLS_BITPACK_THRESHOLD_ELEMS", 1 << 28),
+            write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Online API config (reference: rest_api/app/main.py:31-50,
+    kubernetes/deployment.yaml:33-53)."""
+
+    version: str = "V1.1"
+    base_dir: str = "./api-data/"
+    pickle_dir: str = "pickles/"
+    app_path_from_root: str = "/app"
+    recommendations_file: str = "recommendations.pickle"
+    best_tracks_file: str = "best_tracks.pickle"
+    data_invalidation_file: str = "last_execution.txt"
+    k_best_tracks: int = 10
+    polling_wait_in_minutes: float = 5.0
+
+    # --- TPU-rebuild knobs ---
+    port: int = 80
+    # Max seed songs per request the jitted kernel is specialized for;
+    # requests are bucketed to powers of two up to this bound.
+    max_seed_tracks: int = 128
+    # Micro-batching window for aggregating concurrent requests into one
+    # device call (milliseconds); 0 disables batching.
+    batch_window_ms: float = 2.0
+    batch_max_size: int = 32
+    # Prefer the tensor-native npz artifact over the pickle when present.
+    prefer_tensor_artifact: bool = True
+
+    @property
+    def pickles_dir(self) -> str:
+        return os.path.join(self.base_dir, self.pickle_dir)
+
+    @staticmethod
+    def from_env(dotenv_path: str | None = ".env") -> "ServingConfig":
+        if dotenv_path:
+            load_dotenv(dotenv_path)
+        base_dir = os.getenv("BASE_DIR", "./api-data/")
+        return ServingConfig(
+            version=os.getenv("VERSION", "V1.1"),
+            base_dir=base_dir,
+            pickle_dir=os.getenv("PICKLE_DIR", "pickles/"),
+            app_path_from_root=os.getenv("APP_PATH_FROM_ROOT", "/app"),
+            recommendations_file=os.getenv("RECOMMENDATIONS_FILE", "recommendations.pickle"),
+            best_tracks_file=os.getenv("BEST_TRACKS_FILE", "best_tracks.pickle"),
+            data_invalidation_file=os.getenv("DATA_INVALIDATION_FILE", "last_execution.txt"),
+            k_best_tracks=_getenv_int("K_BEST_TRACKS", 10),
+            polling_wait_in_minutes=_getenv_float("POLLING_WAIT_IN_MINUTES", 5.0),
+            port=_getenv_int("KMLS_PORT", 80),
+            max_seed_tracks=_getenv_int("KMLS_MAX_SEED_TRACKS", 128),
+            batch_window_ms=_getenv_float("KMLS_BATCH_WINDOW_MS", 2.0),
+            batch_max_size=_getenv_int("KMLS_BATCH_MAX_SIZE", 32),
+            prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),
+        )

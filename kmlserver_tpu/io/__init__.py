@@ -1,0 +1,1 @@
+from . import artifacts, registry  # noqa: F401

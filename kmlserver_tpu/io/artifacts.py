@@ -1,0 +1,160 @@
+"""Artifact I/O — the wire contract between the mining job and the API.
+
+The reference hands everything between its two workloads as pickle files on a
+shared RWX PVC (reference: machine-learning/main.py:136-145 writes;
+rest_api/app/main.py:52-80 reads). This module keeps that pickle contract
+byte-compatible (same object shapes, same filenames) so either side of the
+reference could interoperate with this rebuild, and adds:
+
+- **atomic writes** (tmp file + ``os.replace``) — the reference rewrites
+  artifacts in place, racing readers (acknowledged in its report); atomic
+  rename removes the torn-read window without changing the protocol;
+- a **tensor-native artifact** (``.npz`` of the padded rule tensors) written
+  alongside the pickle, so the serving engine can ``jax.device_put`` rule
+  tensors straight into HBM without re-deriving them from the dict.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import tempfile
+from typing import Any
+
+import numpy as np
+
+TENSOR_ARTIFACT_SUFFIX = ".tensors.npz"
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp_path = tempfile.mkstemp(dir=directory, prefix=".tmp_", suffix=".part")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
+
+
+def save_pickle(obj: Any, path: str) -> None:
+    """Pickle ``obj`` to ``path`` atomically.
+
+    Same role as the reference's ``save_pickle`` (machine-learning/main.py:136-145),
+    which mkdirs the folder and ``pickle.dump``s in place; here the folder is
+    created and the write is atomic.
+    """
+    _atomic_write_bytes(path, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def load_pickle(path: str) -> Any:
+    with open(path, "rb") as fh:
+        return pickle.load(fh)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    _atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def read_text(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def tensor_artifact_path(recommendations_pickle_path: str) -> str:
+    """Path of the npz rule-tensor artifact shadowing a recommendations pickle."""
+    return recommendations_pickle_path + TENSOR_ARTIFACT_SUFFIX
+
+
+def save_rule_tensors(
+    path: str,
+    *,
+    vocab: list[str],
+    rule_ids: np.ndarray,
+    rule_confs: np.ndarray,
+    n_playlists: int,
+    min_support: float,
+) -> None:
+    """Write the padded rule tensors + vocabulary as one ``.npz``.
+
+    ``rule_ids``   int32 (V, K_max) — consequent track ids, -1 padding.
+    ``rule_confs`` float32 (V, K_max) — the stored "confidence" (itemset
+                   support under the reference's fast-path semantics,
+                   machine-learning/main.py:284-296), 0 padding.
+    """
+    if rule_ids.shape != rule_confs.shape:
+        raise ValueError(f"rule_ids {rule_ids.shape} != rule_confs {rule_confs.shape}")
+    if rule_ids.shape[0] != len(vocab):
+        raise ValueError(f"rows {rule_ids.shape[0]} != vocab size {len(vocab)}")
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        vocab=np.asarray(vocab, dtype=object),
+        rule_ids=rule_ids.astype(np.int32),
+        rule_confs=rule_confs.astype(np.float32),
+        n_playlists=np.int64(n_playlists),
+        min_support=np.float64(min_support),
+    )
+    _atomic_write_bytes(path, buf.getvalue())
+
+
+def load_rule_tensors(path: str) -> dict[str, Any]:
+    with np.load(path, allow_pickle=True) as npz:
+        return {
+            "vocab": [str(s) for s in npz["vocab"]],
+            "rule_ids": npz["rule_ids"],
+            "rule_confs": npz["rule_confs"],
+            "n_playlists": int(npz["n_playlists"]),
+            "min_support": float(npz["min_support"]),
+        }
+
+
+def rules_dict_from_tensors(
+    vocab: list[str], rule_ids: np.ndarray, rule_confs: np.ndarray
+) -> dict[str, dict[str, float]]:
+    """Expand rule tensors into the reference's pickle object shape:
+    ``{song_name: {other_song_name: confidence}}``
+    (the object ``rest_api/app/main.py:68-76`` unpickles)."""
+    out: dict[str, dict[str, float]] = {}
+    for row, (ids, confs) in enumerate(zip(rule_ids, rule_confs)):
+        valid = ids >= 0
+        if not valid.any():
+            continue
+        out[vocab[row]] = {
+            vocab[int(j)]: float(c) for j, c in zip(ids[valid], confs[valid])
+        }
+    return out
+
+
+def tensors_from_rules_dict(
+    rules: dict[str, dict[str, float]],
+    vocab: list[str],
+    k_max: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`rules_dict_from_tensors` for loading legacy pickles
+    produced by the reference into the device-resident layout."""
+    index = {name: i for i, name in enumerate(vocab)}
+    V = len(vocab)
+    rule_ids = np.full((V, k_max), -1, dtype=np.int32)
+    rule_confs = np.zeros((V, k_max), dtype=np.float32)
+    for name, row in rules.items():
+        i = index.get(name)
+        if i is None:
+            continue
+        # resolve to known-vocab ids first, then truncate — so unknown
+        # consequents neither punch -1 holes mid-row nor crowd out valid
+        # lower-ranked ones
+        resolved = [
+            (index[other], conf) for other, conf in row.items() if other in index
+        ]
+        resolved.sort(key=lambda jc: -jc[1])
+        for k, (j, conf) in enumerate(resolved[:k_max]):
+            rule_ids[i, k] = j
+            rule_confs[i, k] = conf
+    return rule_ids, rule_confs

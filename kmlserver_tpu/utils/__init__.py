@@ -1,0 +1,1 @@
+from . import envfile, timeutil  # noqa: F401

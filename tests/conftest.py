@@ -1,0 +1,44 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip sharding is tested without TPU hardware by asking XLA's host
+platform for 8 virtual devices — this must happen before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def tiny_baskets():
+    """A hand-written transaction DB with known frequent pairs.
+
+    5 playlists over 6 tracks (t0..t5):
+      p0: t0 t1 t2
+      p1: t0 t1
+      p2: t0 t1 t3
+      p3: t2 t3
+      p4: t0 t4
+    Pair counts: (t0,t1)=3, (t0,t2)=1, (t0,t3)=1, (t0,t4)=1,
+                 (t1,t2)=1, (t1,t3)=1, (t2,t3)=2.
+    t5 never appears.
+    """
+    return [
+        ["t0", "t1", "t2"],
+        ["t0", "t1"],
+        ["t0", "t1", "t3"],
+        ["t2", "t3"],
+        ["t0", "t4"],
+    ]

@@ -1,0 +1,168 @@
+"""Tests for the env contract, dotenv parsing, artifact I/O, and the
+dataset-registry / history-rotation / invalidation-token state machine
+(reference behaviors: machine-learning/main.py:315-411)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import BASE_INDEX, MiningConfig, ServingConfig
+from kmlserver_tpu.io import artifacts, registry
+from kmlserver_tpu.utils.envfile import load_dotenv, parse_env_line
+
+
+class TestEnvFile:
+    def test_parse_basic(self):
+        assert parse_env_line("FOO=bar") == ("FOO", "bar")
+        assert parse_env_line("export FOO=bar") == ("FOO", "bar")
+        assert parse_env_line('FOO="bar baz"') == ("FOO", "bar baz")
+        assert parse_env_line("FOO=bar # comment") == ("FOO", "bar")
+        assert parse_env_line('FOO="/data/api" # prod path') == ("FOO", "/data/api")
+        assert parse_env_line("FOO='x y' # c") == ("FOO", "x y")
+        assert parse_env_line("# comment") is None
+        assert parse_env_line("") is None
+        assert parse_env_line("NOEQUALS") is None
+
+    def test_load_no_override(self, tmp_path, monkeypatch):
+        envf = tmp_path / ".env"
+        envf.write_text("A=1\nB=2\n")
+        monkeypatch.setenv("A", "keep")
+        monkeypatch.delenv("B", raising=False)
+        load_dotenv(envf)
+        assert os.environ["A"] == "keep"
+        assert os.environ["B"] == "2"
+
+    def test_load_missing_file(self, tmp_path):
+        assert load_dotenv(tmp_path / "nope.env") == {}
+
+
+class TestConfig:
+    def test_mining_env_contract(self, monkeypatch, tmp_path):
+        # names bound by kubernetes/job.yaml:24-40 in the reference
+        monkeypatch.setenv("BASE_DIR", str(tmp_path))
+        monkeypatch.setenv("MIN_SUPPORT", "0.07")
+        monkeypatch.setenv("REGEX_FILENAME", "ds*.csv")
+        monkeypatch.setenv("TOP_TRACKS_SAVE_PERCENTILE", "0.1")
+        cfg = MiningConfig.from_env(dotenv_path=None)
+        assert cfg.base_dir == str(tmp_path)
+        assert cfg.min_support == 0.07
+        assert cfg.regex_filename == "ds*.csv"
+        assert cfg.top_tracks_save_percentile == 0.1
+        assert cfg.datasets_dir == os.path.join(str(tmp_path), "datasets")
+        assert cfg.pickles_dir == os.path.join(str(tmp_path), "pickles")
+
+    def test_serving_env_contract(self, monkeypatch):
+        # names bound by kubernetes/deployment.yaml:33-53 in the reference
+        monkeypatch.setenv("VERSION", "V9")
+        monkeypatch.setenv("K_BEST_TRACKS", "7")
+        monkeypatch.setenv("POLLING_WAIT_IN_MINUTES", "1")
+        cfg = ServingConfig.from_env(dotenv_path=None)
+        assert cfg.version == "V9"
+        assert cfg.k_best_tracks == 7
+        assert cfg.polling_wait_in_minutes == 1.0
+
+
+class TestArtifacts:
+    def test_pickle_roundtrip(self, tmp_path):
+        path = str(tmp_path / "sub" / "x.pickle")
+        obj = {"a": {"b": 0.5}}
+        artifacts.save_pickle(obj, path)
+        assert artifacts.load_pickle(path) == obj
+        # no temp droppings
+        assert sorted(os.listdir(tmp_path / "sub")) == ["x.pickle"]
+
+    def test_rule_tensor_roundtrip(self, tmp_path):
+        vocab = ["a", "b", "c"]
+        rule_ids = np.array([[1, -1], [0, 2], [-1, -1]], dtype=np.int32)
+        rule_confs = np.array([[0.5, 0.0], [0.5, 0.25], [0.0, 0.0]], dtype=np.float32)
+        path = str(tmp_path / "r.npz")
+        artifacts.save_rule_tensors(
+            path, vocab=vocab, rule_ids=rule_ids, rule_confs=rule_confs,
+            n_playlists=4, min_support=0.05,
+        )
+        loaded = artifacts.load_rule_tensors(path)
+        assert loaded["vocab"] == vocab
+        np.testing.assert_array_equal(loaded["rule_ids"], rule_ids)
+        np.testing.assert_array_equal(loaded["rule_confs"], rule_confs)
+        assert loaded["n_playlists"] == 4
+
+    def test_dict_tensor_inverse(self):
+        vocab = ["a", "b", "c"]
+        rule_ids = np.array([[1, -1], [0, 2], [-1, -1]], dtype=np.int32)
+        rule_confs = np.array([[0.5, 0.0], [0.5, 0.25], [0.0, 0.0]], dtype=np.float32)
+        d = artifacts.rules_dict_from_tensors(vocab, rule_ids, rule_confs)
+        assert d == {"a": {"b": 0.5}, "b": {"a": 0.5, "c": 0.25}}
+        ids2, confs2 = artifacts.tensors_from_rules_dict(d, vocab, k_max=2)
+        d2 = artifacts.rules_dict_from_tensors(vocab, ids2, confs2)
+        assert d2 == d
+
+    def test_tensors_from_dict_unknown_consequents(self):
+        # unknown consequents must not punch holes or crowd out valid ones
+        vocab = ["a", "b", "c"]
+        d = {"a": {"zz-not-in-vocab": 0.9, "b": 0.5, "c": 0.4}}
+        ids, confs = artifacts.tensors_from_rules_dict(d, vocab, k_max=2)
+        np.testing.assert_array_equal(ids[0], [1, 2])
+        np.testing.assert_allclose(confs[0], [0.5, 0.4])
+
+
+def _mk_cfg(tmp_path, n_datasets=3) -> MiningConfig:
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(1, n_datasets + 1):
+        (ds_dir / f"2023_spotify_ds{i}.csv").write_text("pid,track_name\n")
+    return MiningConfig(base_dir=str(tmp_path), datasets_dir=str(ds_dir))
+
+
+class TestRegistry:
+    def test_discover_and_persist(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        datasets = registry.get_dataset_list(cfg)
+        assert len(datasets) == 3
+        assert all(d.endswith(".csv") for d in datasets)
+        # list is persisted and re-read, not re-globbed
+        (tmp_path / "datasets" / "2023_spotify_ds9.csv").write_text("x\n")
+        assert registry.get_dataset_list(cfg) == datasets
+
+    def test_no_datasets_raises(self, tmp_path):
+        cfg = MiningConfig(base_dir=str(tmp_path), datasets_dir=str(tmp_path / "none"))
+        with pytest.raises(FileNotFoundError):
+            registry.get_dataset_list(cfg)
+
+    def test_rotation_wraparound(self, tmp_path):
+        # reference semantics: last index + 1, wrap to BASE_INDEX
+        # (machine-learning/main.py:364-392)
+        cfg = _mk_cfg(tmp_path, n_datasets=2)
+        datasets = registry.get_dataset_list(cfg)
+        assert registry.get_next_run_index(cfg, datasets) == BASE_INDEX
+        registry.append_history_and_invalidate(cfg, BASE_INDEX, datasets[0])
+        assert registry.get_next_run_index(cfg, datasets) == BASE_INDEX + 1
+        registry.append_history_and_invalidate(cfg, BASE_INDEX + 1, datasets[1])
+        assert registry.get_next_run_index(cfg, datasets) == BASE_INDEX  # wrapped
+
+    def test_token_rewrite(self, tmp_path):
+        cfg = _mk_cfg(tmp_path, n_datasets=1)
+        datasets = registry.get_dataset_list(cfg)
+        token1 = registry.append_history_and_invalidate(cfg, 1, datasets[0], "2026-01-01 00:00:00")
+        tok_file = registry.token_path_for(cfg.base_dir, cfg.data_invalidation_file)
+        assert artifacts.read_text(tok_file) == token1
+        token2 = registry.append_history_and_invalidate(cfg, 2, datasets[0], "2026-01-02 00:00:00")
+        assert artifacts.read_text(tok_file) == token2 != token1
+        history = registry.read_history(cfg)
+        assert [h[1] for h in history] == [1, 2]
+
+    def test_history_format_interop_with_reference(self, tmp_path):
+        # a history file written by the REFERENCE job (header + row layout
+        # from machine-learning/main.py:394-405) must drive our rotation
+        cfg = _mk_cfg(tmp_path, n_datasets=3)
+        datasets = registry.get_dataset_list(cfg)
+        (tmp_path / "dataset_history.csv").write_text(
+            "time,dataset_index,dataset_file\n"
+            "2025-01-10 10:30:00,2,/api-data/datasets/2023_spotify_ds2.csv\n"
+        )
+        assert registry.get_next_run_index(cfg, datasets) == 3
+        # and our appended row keeps the reference's column order
+        registry.append_history_and_invalidate(cfg, 3, datasets[2], "2025-01-10 11:00:00")
+        last = (tmp_path / "dataset_history.csv").read_text().splitlines()[-1]
+        assert last.split(",", 2)[0] == "2025-01-10 11:00:00"
+        assert last.split(",", 2)[1] == "3"
