@@ -34,6 +34,9 @@ def _atomic_write_bytes(path: str, data: bytes) -> None:
     try:
         with os.fdopen(fd, "wb") as fh:
             fh.write(data)
+        # mkstemp creates 0600; artifacts are read by the API replicas
+        # (possibly a different uid on the shared volume)
+        os.chmod(tmp_path, 0o644)
         os.replace(tmp_path, path)
     except BaseException:
         try:
@@ -77,76 +80,109 @@ def save_rule_tensors(
     *,
     vocab: list[str],
     rule_ids: np.ndarray,
-    rule_confs: np.ndarray,
+    rule_counts: np.ndarray,
+    item_counts: np.ndarray,
     n_playlists: int,
     min_support: float,
+    mode: str = "support",
+    min_confidence: float = 0.0,
 ) -> None:
     """Write the padded rule tensors + vocabulary as one ``.npz``.
 
-    ``rule_ids``   int32 (V, K_max) — consequent track ids, -1 padding.
-    ``rule_confs`` float32 (V, K_max) — the stored "confidence" (itemset
-                   support under the reference's fast-path semantics,
-                   machine-learning/main.py:284-296), 0 padding.
+    ``rule_ids``    int32 (V, K_max) — consequent track ids, -1 padding.
+    ``rule_counts`` int32 (V, K_max) — co-occurrence COUNTS (not floats:
+                    consumers re-derive confidences with the same float64
+                    arithmetic as the pickle path, so the two artifacts can
+                    never drift).
+    ``item_counts`` int32 (V,) — singleton supports; items with
+                    count ≥ ceil(min_support·P) are the rule-dict key set
+                    (including empty rows — see ops/rules.py).
     """
-    if rule_ids.shape != rule_confs.shape:
-        raise ValueError(f"rule_ids {rule_ids.shape} != rule_confs {rule_confs.shape}")
-    if rule_ids.shape[0] != len(vocab):
-        raise ValueError(f"rows {rule_ids.shape[0]} != vocab size {len(vocab)}")
+    if rule_ids.shape != rule_counts.shape:
+        raise ValueError(f"rule_ids {rule_ids.shape} != rule_counts {rule_counts.shape}")
+    if rule_ids.shape[0] != len(vocab) or len(item_counts) != len(vocab):
+        raise ValueError(
+            f"rows {rule_ids.shape[0]}/{len(item_counts)} != vocab size {len(vocab)}"
+        )
     buf = io.BytesIO()
     np.savez_compressed(
         buf,
         vocab=np.asarray(vocab, dtype=object),
         rule_ids=rule_ids.astype(np.int32),
-        rule_confs=rule_confs.astype(np.float32),
+        rule_counts=rule_counts.astype(np.int32),
+        item_counts=item_counts.astype(np.int32),
         n_playlists=np.int64(n_playlists),
         min_support=np.float64(min_support),
+        mode=np.asarray(mode),
+        min_confidence=np.float64(min_confidence),
     )
     _atomic_write_bytes(path, buf.getvalue())
 
 
 def load_rule_tensors(path: str) -> dict[str, Any]:
+    """Load the npz artifact, deriving serving-ready float32 confidences."""
     with np.load(path, allow_pickle=True) as npz:
+        rule_counts = npz["rule_counts"]
+        item_counts = npz["item_counts"]
+        n_playlists = int(npz["n_playlists"])
+        mode = str(npz["mode"])
+        if mode == "support":
+            confs = (rule_counts.astype(np.float64) / n_playlists).astype(np.float32)
+        else:
+            denom = np.maximum(item_counts, 1)[:, None].astype(np.float64)
+            confs = (rule_counts / denom).astype(np.float32)
         return {
             "vocab": [str(s) for s in npz["vocab"]],
             "rule_ids": npz["rule_ids"],
-            "rule_confs": npz["rule_confs"],
-            "n_playlists": int(npz["n_playlists"]),
+            "rule_counts": rule_counts,
+            "rule_confs": confs,
+            "item_counts": item_counts,
+            "n_playlists": n_playlists,
             "min_support": float(npz["min_support"]),
+            "mode": mode,
+            "min_confidence": float(npz["min_confidence"]),
         }
 
 
-def rules_dict_from_tensors(
-    vocab: list[str], rule_ids: np.ndarray, rule_confs: np.ndarray
-) -> dict[str, dict[str, float]]:
-    """Expand rule tensors into the reference's pickle object shape:
-    ``{song_name: {other_song_name: confidence}}``
-    (the object ``rest_api/app/main.py:68-76`` unpickles)."""
-    out: dict[str, dict[str, float]] = {}
-    for row, (ids, confs) in enumerate(zip(rule_ids, rule_confs)):
-        valid = ids >= 0
-        if not valid.any():
-            continue
-        out[vocab[row]] = {
-            vocab[int(j)]: float(c) for j, c in zip(ids[valid], confs[valid])
-        }
-    return out
+def rules_dict_from_tensors(loaded: dict[str, Any]) -> dict[str, dict[str, float]]:
+    """Expand a :func:`load_rule_tensors` result into the reference's pickle
+    object shape ``{song_name: {other_song_name: confidence}}`` (the object
+    ``rest_api/app/main.py:68-76`` unpickles), via the one canonical
+    expansion in ``ops/rules.py`` — guaranteeing npz→dict equals the dict
+    the mining job pickled."""
+    from ..ops.rules import expand_rules_dict
+
+    return expand_rules_dict(
+        loaded["vocab"],
+        loaded["rule_ids"],
+        loaded["rule_counts"],
+        loaded["item_counts"],
+        n_playlists=loaded["n_playlists"],
+        min_support=loaded["min_support"],
+        mode=loaded["mode"],
+    )
 
 
 def tensors_from_rules_dict(
     rules: dict[str, dict[str, float]],
     vocab: list[str],
     k_max: int,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Inverse of :func:`rules_dict_from_tensors` for loading legacy pickles
-    produced by the reference into the device-resident layout."""
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse direction for loading legacy pickles (e.g. written by the
+    reference job) into the device-resident layout. Returns
+    ``(rule_ids, rule_confs, known_mask)`` — ``known_mask`` marks vocab
+    entries that are dict KEYS (possibly with empty rows): the membership
+    set the serving path must honor (rest_api/app/main.py:235)."""
     index = {name: i for i, name in enumerate(vocab)}
-    V = len(vocab)
-    rule_ids = np.full((V, k_max), -1, dtype=np.int32)
-    rule_confs = np.zeros((V, k_max), dtype=np.float32)
+    v = len(vocab)
+    rule_ids = np.full((v, k_max), -1, dtype=np.int32)
+    rule_confs = np.zeros((v, k_max), dtype=np.float32)
+    known_mask = np.zeros(v, dtype=bool)
     for name, row in rules.items():
         i = index.get(name)
         if i is None:
             continue
+        known_mask[i] = True
         # resolve to known-vocab ids first, then truncate — so unknown
         # consequents neither punch -1 holes mid-row nor crowd out valid
         # lower-ranked ones
@@ -157,4 +193,4 @@ def tensors_from_rules_dict(
         for k, (j, conf) in enumerate(resolved[:k_max]):
             rule_ids[i, k] = j
             rule_confs[i, k] = conf
-    return rule_ids, rule_confs
+    return rule_ids, rule_confs, known_mask

@@ -1,0 +1,1 @@
+from . import vocab  # noqa: F401

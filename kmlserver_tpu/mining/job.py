@@ -1,0 +1,39 @@
+"""Container entrypoint for the batch mining job.
+
+Run as ``python -m kmlserver_tpu.mining.job`` — the rebuild's equivalent of
+the reference job image's ``CMD uv run main.py``
+(reference: machine-learning/Dockerfile:10, machine-learning/main.py:421-484).
+Configured entirely by environment variables (kubernetes/job.yaml contract);
+exits 0 on success like the reference's ``sys.exit(0)`` (main.py:484).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..config import MiningConfig
+from .pipeline import run_mining_job
+
+
+def main() -> int:
+    cfg = MiningConfig.from_env()
+    mesh = None
+    if cfg.mesh_shape in ("", "1x1"):
+        pass  # explicit single-device
+    elif cfg.mesh_shape == "auto":
+        import jax
+
+        if len(jax.devices()) > 1:  # default: shard over every chip present
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh("auto")
+    else:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(cfg.mesh_shape)
+    run_mining_job(cfg, mesh=mesh)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

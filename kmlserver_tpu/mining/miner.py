@@ -1,0 +1,150 @@
+"""Device mining driver: baskets → rule tensors.
+
+The TPU replacement for the reference's mlxtend call + expansion loops
+(reference: machine-learning/main.py:262-313): encode memberships on device,
+one MXU matmul for pair supports, threshold + top-k emission. Exact — not an
+approximation — per the dominance argument in ``ops/support.py``.
+
+Config wiring:
+- ``cfg.confidence_mode`` selects the reference fast path's
+  support-as-confidence semantics (``"support"``) or the dormant slow
+  path's true asymmetric confidence (``"confidence"``,
+  machine-learning/main.py:224-260).
+- ``cfg.max_itemset_len`` ≥ 3 additionally computes a frequent-itemset
+  census (per-length counts, exact via pair extension) — the reference's
+  log surface reports itemset statistics; ≥ 4 is not yet enumerated and is
+  reported as such rather than silently ignored.
+- ``cfg.bitpack_threshold_elems``: above this one-hot size the bit-packed
+  popcount path (Pallas) will take over; until that kernel lands the driver
+  WARNS and uses the dense path rather than silently pretending.
+
+Timing: the reference brackets rule generation with wall-clock timestamps and
+prints the elapsed time (machine-learning/main.py:264,306-308); ``mine`` does
+the same with ``block_until_ready`` so device work is actually inside the
+bracket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MiningConfig
+from ..ops import encode, rules, support
+from .vocab import Baskets
+
+
+@dataclasses.dataclass
+class MiningResult:
+    tensors: rules.RuleTensors
+    n_playlists: int
+    n_tracks: int
+    duration_s: float
+    itemset_census: dict[int, int] | None = None  # length → frequent-itemset count
+
+
+def pair_count_fn(baskets: Baskets, mesh: "jax.sharding.Mesh | None" = None) -> jax.Array:
+    """One-hot encode + pair-support count, single device or sharded.
+
+    The sharded path (mesh given) lives in ``parallel/``; this host-side
+    dispatcher keeps the pipeline oblivious to the mesh shape.
+    """
+    if mesh is not None:
+        from ..parallel.support import sharded_pair_counts
+
+        return sharded_pair_counts(baskets, mesh)
+    x = encode.onehot_matrix(
+        jnp.asarray(baskets.playlist_rows),
+        jnp.asarray(baskets.track_ids),
+        n_playlists=baskets.n_playlists,
+        n_tracks=baskets.n_tracks,
+    )
+    return support.pair_counts(x)
+
+
+def _itemset_census(
+    baskets: Baskets,
+    counts: jax.Array,
+    min_count: int,
+    max_len: int,
+    pair_capacity: int = 1 << 16,
+) -> dict[int, int]:
+    """Exact frequent-itemset counts per length (1, 2, and — via pair
+    extension on the MXU — 3). Lengths beyond 3 are reported as -1
+    (not yet enumerated) rather than silently dropped."""
+    item_counts = np.asarray(jnp.diagonal(counts))
+    census = {1: int((item_counts >= min_count).sum())}
+    if max_len < 2:
+        return census
+    pair_i, pair_j, _, n_pairs = support.frequent_pairs(
+        counts, jnp.int32(min_count), capacity=pair_capacity
+    )
+    n_pairs = int(n_pairs)
+    census[2] = n_pairs
+    if max_len < 3:
+        return census
+    if n_pairs > pair_capacity:
+        census[3] = -1  # overflowed the extension capacity; report honestly
+        return census
+    x = encode.onehot_matrix(
+        jnp.asarray(baskets.playlist_rows),
+        jnp.asarray(baskets.track_ids),
+        n_playlists=baskets.n_playlists,
+        n_tracks=baskets.n_tracks,
+    )
+    t = support.triple_counts(x, jnp.where(pair_i >= 0, pair_i, 0), jnp.where(pair_j >= 0, pair_j, 0))
+    t = np.asarray(t)
+    pi, pj = np.asarray(pair_i), np.asarray(pair_j)
+    valid_rows = pi >= 0
+    v = t.shape[1]
+    k_ids = np.arange(v)[None, :]
+    # a triple {i,j,k} is counted once per frequent (i,j) with k > j > i:
+    # restrict to k > j to avoid double counting across its three pairs
+    mask = valid_rows[:, None] & (k_ids > pj[:, None]) & (t >= min_count)
+    census[3] = int(mask.sum())
+    if max_len > 3:
+        census[max_len] = -1
+    return census
+
+
+def mine(
+    baskets: Baskets,
+    cfg: MiningConfig,
+    mesh: "jax.sharding.Mesh | None" = None,
+) -> MiningResult:
+    """Run the full mining compute, timed like the reference's rule step."""
+    onehot_elems = baskets.n_playlists * baskets.n_tracks
+    if mesh is None and onehot_elems > cfg.bitpack_threshold_elems:
+        print(
+            f"WARNING: one-hot matrix has {onehot_elems:.2e} elements "
+            f"(> KMLS_BITPACK_THRESHOLD_ELEMS={cfg.bitpack_threshold_elems:.2e}); "
+            f"the bit-packed popcount path is not yet wired — using dense int8"
+        )
+    t0 = time.perf_counter()
+    counts = pair_count_fn(baskets, mesh)
+    jax.block_until_ready(counts)
+    tensors = rules.mine_rules_from_counts(
+        counts,
+        n_playlists=baskets.n_playlists,
+        min_support=cfg.min_support,
+        k_max=cfg.k_max_consequents,
+        mode=cfg.confidence_mode,
+        min_confidence=cfg.min_confidence,
+    )
+    duration = time.perf_counter() - t0
+    census = None
+    if cfg.max_itemset_len >= 3:
+        census = _itemset_census(
+            baskets, counts, tensors.min_count, cfg.max_itemset_len
+        )
+    return MiningResult(
+        tensors=tensors,
+        n_playlists=baskets.n_playlists,
+        n_tracks=baskets.n_tracks,
+        duration_s=duration,
+        itemset_census=census,
+    )

@@ -1,0 +1,130 @@
+"""The batch mining job, end to end — parity with the reference's ``__main__``
+orchestration (reference: machine-learning/main.py:421-484):
+
+dataset list → rotation index → CSV read → vocab/aux artifacts →
+baskets → device mining → recommendations artifact → history append +
+invalidation-token rewrite — with the same printed progress/timing lines the
+reference's report reads off the pod logs (Sao Paulo timestamps at :423,431;
+"Time elapsed in rule generation" from :306-308; missing-songs counter
+from :298-305).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+from ..config import BASE_INDEX, MiningConfig
+from ..data.csv import read_tracks
+from ..io import artifacts, registry
+from ..utils.timeutil import get_current_time_str
+from . import vocab as vocab_mod
+from .miner import MiningResult, mine
+
+
+@dataclasses.dataclass
+class JobSummary:
+    dataset: str
+    run_index: int
+    n_rows: int
+    n_playlists: int
+    n_tracks: int
+    n_songs_missing: int
+    rule_generation_s: float
+    token: str
+    artifact_paths: dict[str, str]
+
+
+def _pickle_path(cfg: MiningConfig, filename: str) -> str:
+    return os.path.join(cfg.pickles_dir, filename)
+
+
+def run_mining_job(
+    cfg: MiningConfig, mesh: "jax.sharding.Mesh | None" = None
+) -> JobSummary:
+    print(f"Job starting at {get_current_time_str()}")
+
+    datasets = registry.get_dataset_list(cfg)
+    run_index = registry.get_next_run_index(cfg, datasets)
+    selected = datasets[run_index - BASE_INDEX]
+    print(f"Selected dataset {run_index}/{len(datasets)}: {selected}")
+
+    table = read_tracks(selected, cfg.sample_ratio)
+    print(
+        f"Loaded {len(table)} rows, {table.n_playlists} playlists, "
+        f"{table.n_tracks} unique tracks"
+    )
+
+    paths: dict[str, str] = {}
+
+    # auxiliary vocab artifacts (reference M5-M8: main.py:438-446)
+    artists = vocab_mod.validate_and_map_artists(table)
+    paths["artists_mapping"] = _pickle_path(cfg, cfg.artists_mapping_file)
+    artifacts.save_pickle(artists, paths["artists_mapping"])
+
+    repeated = vocab_mod.extract_repeated_track_names(table)
+    if repeated:  # the reference saves this one conditionally (main.py:86-109)
+        paths["repeated_tracks"] = _pickle_path(cfg, cfg.repeated_tracks_file)
+        artifacts.save_pickle(repeated, paths["repeated_tracks"])
+
+    info = vocab_mod.map_track_ids_to_info(table)
+    paths["track_info"] = _pickle_path(cfg, cfg.track_info_file)
+    artifacts.save_pickle(info, paths["track_info"])
+
+    best = vocab_mod.most_frequent_tracks(table, cfg.top_tracks_save_percentile)
+    paths["best_tracks"] = _pickle_path(cfg, cfg.best_tracks_file)
+    artifacts.save_pickle(best, paths["best_tracks"])
+    print(f"Saved {len(best)} best tracks (top {cfg.top_tracks_save_percentile:.0%})")
+
+    # the compute core
+    baskets = vocab_mod.build_baskets(table)
+    result: MiningResult = mine(baskets, cfg, mesh=mesh)
+    tensors = result.tensors
+    print(f"Songs without recommendations: {tensors.n_songs_missing}")
+    print(f"Time elapsed in rule generation: {result.duration_s:.2f}s")
+    if result.itemset_census is not None:
+        census = ", ".join(
+            f"len {k}: {'not enumerated' if v < 0 else v}"
+            for k, v in sorted(result.itemset_census.items())
+        )
+        print(f"Frequent itemsets — {census}")
+    if tensors.overflow_rows:
+        print(
+            f"WARNING: {tensors.overflow_rows} songs exceeded the "
+            f"K_max={cfg.k_max_consequents} consequent capacity (truncated "
+            f"to the highest-support rules)"
+        )
+
+    rules_dict = tensors.to_rules_dict(baskets.vocab.names)
+    paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
+    artifacts.save_pickle(rules_dict, paths["recommendations"])
+    if cfg.write_tensor_artifact:
+        paths["rule_tensors"] = artifacts.tensor_artifact_path(paths["recommendations"])
+        artifacts.save_rule_tensors(
+            paths["rule_tensors"],
+            vocab=baskets.vocab.names,
+            rule_ids=tensors.rule_ids,
+            rule_counts=tensors.rule_counts,
+            item_counts=tensors.item_counts,
+            n_playlists=result.n_playlists,
+            min_support=cfg.min_support,
+            mode=tensors.mode,
+            min_confidence=tensors.min_confidence,
+        )
+
+    token = registry.append_history_and_invalidate(cfg, run_index, selected)
+    print(f"Job finished at {get_current_time_str()}")
+
+    return JobSummary(
+        dataset=selected,
+        run_index=run_index,
+        n_rows=len(table),
+        n_playlists=result.n_playlists,
+        n_tracks=result.n_tracks,
+        n_songs_missing=tensors.n_songs_missing,
+        rule_generation_s=result.duration_s,
+        token=token,
+        artifact_paths=paths,
+    )

@@ -1,0 +1,1 @@
+from . import encode, rules, serve, support  # noqa: F401

@@ -1,0 +1,65 @@
+"""Device-side transaction encoding.
+
+Replaces mlxtend's ``TransactionEncoder`` (reference:
+machine-learning/main.py:267-269), which builds a dense boolean pandas
+DataFrame on host. Here the membership pairs go to the device once and the
+one-hot / bit-packed basket matrix is materialized there:
+
+- ``onehot_matrix``  — ``X ∈ {0,1}^{P×V}`` as int8: the MXU-friendly operand
+  for the pair-support matmul (int8×int8→int32 rides the systolic array).
+- ``bitpack_matrix`` — ``{0,1}^{P×ceil(V/32)}`` as uint32 bit-words: 32×
+  denser in HBM, operand for the popcount pair-support path (Pallas kernel)
+  when ``P×V`` wouldn't fit as int8.
+
+Membership pairs must be deduplicated (build_baskets guarantees this); the
+bit-pack uses an additive scatter, which is only equal to bitwise-or when
+every (playlist, track) bit is contributed once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def n_words(n_tracks: int) -> int:
+    return (n_tracks + WORD_BITS - 1) // WORD_BITS
+
+
+@partial(jax.jit, static_argnames=("n_playlists", "n_tracks"))
+def onehot_matrix(
+    playlist_rows: jax.Array, track_ids: jax.Array, *, n_playlists: int, n_tracks: int
+) -> jax.Array:
+    """Scatter membership pairs into a dense int8 one-hot matrix (P, V)."""
+    x = jnp.zeros((n_playlists, n_tracks), dtype=jnp.int8)
+    ones = jnp.ones_like(track_ids, dtype=jnp.int8)
+    return x.at[playlist_rows, track_ids].max(ones)
+
+
+@partial(jax.jit, static_argnames=("n_playlists", "n_tracks"))
+def bitpack_matrix(
+    playlist_rows: jax.Array, track_ids: jax.Array, *, n_playlists: int, n_tracks: int
+) -> jax.Array:
+    """Scatter membership pairs into packed uint32 bit-words (P, ceil(V/32)).
+
+    Track ``t`` occupies bit ``t % 32`` of word ``t // 32``; additive scatter
+    == bitwise-or because pairs are unique.
+    """
+    words = (track_ids // WORD_BITS).astype(jnp.int32)
+    bits = jnp.left_shift(
+        jnp.uint32(1), (track_ids % WORD_BITS).astype(jnp.uint32)
+    )
+    packed = jnp.zeros((n_playlists, n_words(n_tracks)), dtype=jnp.uint32)
+    return packed.at[playlist_rows, words].add(bits)
+
+
+def unpack_bits(packed: jax.Array, n_tracks: int | None = None) -> jax.Array:
+    """Inverse of :func:`bitpack_matrix` → int8 (P, W*32); for tests."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (packed[..., None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(packed.shape[0], -1).astype(jnp.int8)
+    return flat if n_tracks is None else flat[:, :n_tracks]

@@ -1,0 +1,217 @@
+"""Rule-tensor emission — the device-side replacement for the reference's
+pure-Python itemset→rule-dict expansion loops
+(reference: machine-learning/main.py:284-296).
+
+The output layout is a padded dense set of arrays resident in HBM:
+
+    rule_ids    int32 (V, K_max) — consequent track ids, -1 padding
+    rule_counts int32 (V, K_max) — co-occurrence counts (pair support × P)
+    item_counts int32 (V,)       — singleton supports (the matrix diagonal)
+
+Key semantic detail (reference: machine-learning/main.py:287-291): the
+reference creates a rule-dict KEY for every member of every frequent itemset
+— including frequent singletons, whose value stays an EMPTY dict. Those keys
+matter downstream: the API's seed-membership filter treats them as known (an
+all-known-but-empty request returns an empty list, NOT the static fallback —
+rest_api/app/main.py:235-238), and the printed missing-songs counter is
+``total_songs - len(keys)`` (main.py:304), i.e. it counts items below
+min_support, not items without partners. Hence ``item_counts`` (the matrix
+diagonal) travels with the rule rows: frequent items ARE the key set.
+
+Per the dominance argument in ``ops/support.py``, row *i*'s contents are
+exactly {j ≠ i : pair_count[i, j] ≥ min_count} with stored "confidence"
+pair_count[i, j] / P. Emission is one masked row-wise ``top_k``. Counts (not
+float supports) travel to host so dict expansion can reproduce the
+reference's float64 ``count / P`` arithmetic bit-for-bit.
+
+Two confidence modes:
+
+- ``"support"``   — the reference fast path's semantics: symmetric rules
+  carrying the itemset support (machine-learning/main.py:286).
+- ``"confidence"`` — the dormant slow path's true asymmetric confidence
+  (machine-learning/main.py:224-260, fpgrowth_py at :226-227):
+  conf(a→b) = support({a,b}) / support({a}), thresholded at
+  ``min_confidence``; rules are no longer symmetric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .support import min_count_for
+
+
+@partial(jax.jit, static_argnames=("k_max",))
+def emit_rule_tensors(pair_count_matrix: jax.Array, min_count: jax.Array, *, k_max: int):
+    """Threshold + per-row top-k over the pair-count matrix.
+
+    Returns ``(rule_ids, rule_counts, row_valid_counts)`` where
+    ``row_valid_counts[i]`` is the TRUE number of frequent consequents of i
+    (may exceed ``k_max``; the caller detects truncation overflow).
+    """
+    v = pair_count_matrix.shape[0]
+    offdiag = ~jnp.eye(v, dtype=bool)
+    valid = offdiag & (pair_count_matrix >= min_count)
+    row_valid_counts = valid.sum(axis=1, dtype=jnp.int32)
+    score = jnp.where(valid, pair_count_matrix, -1)
+    k = min(k_max, v)
+    top_counts, top_ids = jax.lax.top_k(score, k)
+    keep = top_counts > 0
+    rule_ids = jnp.where(keep, top_ids, -1).astype(jnp.int32)
+    rule_counts = jnp.where(keep, top_counts, 0)
+    if k < k_max:  # static pad up to the declared row capacity
+        pad = ((0, 0), (0, k_max - k))
+        rule_ids = jnp.pad(rule_ids, pad, constant_values=-1)
+        rule_counts = jnp.pad(rule_counts, pad)
+    return rule_ids, rule_counts, row_valid_counts
+
+
+@partial(jax.jit, static_argnames=("k_max",))
+def emit_confidence_rule_tensors(
+    pair_count_matrix: jax.Array,
+    min_count: jax.Array,
+    min_confidence: jax.Array,
+    *,
+    k_max: int,
+):
+    """True-confidence variant: rank row i's consequents by
+    conf(i→j) = count(i,j) / count(i), keeping frequent pairs whose
+    confidence clears ``min_confidence``. Returns the same
+    ``(rule_ids, rule_counts, row_valid_counts)`` triple — counts, so the
+    host can redo the division in float64."""
+    v = pair_count_matrix.shape[0]
+    item = jnp.diagonal(pair_count_matrix)
+    conf = pair_count_matrix.astype(jnp.float32) / jnp.maximum(item, 1)[:, None]
+    offdiag = ~jnp.eye(v, dtype=bool)
+    valid = offdiag & (pair_count_matrix >= min_count) & (conf >= min_confidence)
+    row_valid_counts = valid.sum(axis=1, dtype=jnp.int32)
+    score = jnp.where(valid, conf, -1.0)
+    k = min(k_max, v)
+    top_conf, top_ids = jax.lax.top_k(score, k)
+    keep = top_conf > 0
+    rule_ids = jnp.where(keep, top_ids, -1).astype(jnp.int32)
+    rule_counts = jnp.where(
+        keep, jnp.take_along_axis(pair_count_matrix, jnp.where(keep, top_ids, 0), axis=1), 0
+    )
+    if k < k_max:
+        pad = ((0, 0), (0, k_max - k))
+        rule_ids = jnp.pad(rule_ids, pad, constant_values=-1)
+        rule_counts = jnp.pad(rule_counts, pad)
+    return rule_ids, rule_counts, row_valid_counts
+
+
+def expand_rules_dict(
+    vocab_names: list[str],
+    rule_ids: np.ndarray,
+    rule_counts: np.ndarray,
+    item_counts: np.ndarray,
+    *,
+    n_playlists: int,
+    min_support: float,
+    mode: str = "support",
+) -> dict[str, dict[str, float]]:
+    """THE canonical tensor→dict expansion, shared by the mining artifact
+    writer and every npz consumer. Reproduces the reference pickle exactly:
+    every frequent item is a key (empty dict when it has no partners),
+    confidences are float64 ``count / P`` (support mode) or
+    ``count / item_count`` (confidence mode)."""
+    min_count = min_count_for(min_support, n_playlists)
+    out: dict[str, dict[str, float]] = {}
+    for i, name in enumerate(vocab_names):
+        denom_i = int(item_counts[i])
+        if denom_i < min_count:
+            continue  # infrequent item: not a key (reference main.py:284 loop)
+        ids, counts = rule_ids[i], rule_counts[i]
+        valid = ids >= 0
+        denom = n_playlists if mode == "support" else denom_i
+        out[name] = {
+            vocab_names[int(j)]: int(c) / denom
+            for j, c in zip(ids[valid], counts[valid])
+        }
+    return out
+
+
+@dataclasses.dataclass
+class RuleTensors:
+    """Host-side mined result + provenance."""
+
+    rule_ids: np.ndarray  # int32 (V, K_max)
+    rule_counts: np.ndarray  # int32 (V, K_max)
+    rule_confs: np.ndarray  # float32 (V, K_max), serving-ready
+    item_counts: np.ndarray  # int32 (V,)
+    n_playlists: int
+    min_support: float
+    min_count: int
+    mode: str  # "support" | "confidence"
+    min_confidence: float
+    n_frequent_items: int  # == len(keys) of the expanded dict
+    n_songs_missing: int  # total_songs - len(keys) (reference main.py:304)
+    overflow_rows: int  # rows whose true consequent set exceeded K_max
+
+    @property
+    def frequent_item_mask(self) -> np.ndarray:
+        return self.item_counts >= self.min_count
+
+    def to_rules_dict(self, vocab_names: list[str]) -> dict[str, dict[str, float]]:
+        return expand_rules_dict(
+            vocab_names,
+            self.rule_ids,
+            self.rule_counts,
+            self.item_counts,
+            n_playlists=self.n_playlists,
+            min_support=self.min_support,
+            mode=self.mode,
+        )
+
+
+def mine_rules_from_counts(
+    pair_count_matrix: jax.Array,
+    *,
+    n_playlists: int,
+    min_support: float,
+    k_max: int,
+    mode: str = "support",
+    min_confidence: float = 0.0,
+) -> RuleTensors:
+    """Full emission: device threshold/top-k, then host assembly + stats."""
+    if mode not in ("support", "confidence"):
+        raise ValueError(f"confidence mode must be 'support' or 'confidence', got {mode!r}")
+    min_count = min_count_for(min_support, n_playlists)
+    if mode == "support":
+        rule_ids, rule_counts, row_valid = emit_rule_tensors(
+            pair_count_matrix, jnp.int32(min_count), k_max=k_max
+        )
+    else:
+        rule_ids, rule_counts, row_valid = emit_confidence_rule_tensors(
+            pair_count_matrix, jnp.int32(min_count), jnp.float32(min_confidence),
+            k_max=k_max,
+        )
+    rule_ids = np.asarray(rule_ids)
+    rule_counts = np.asarray(rule_counts)
+    row_valid = np.asarray(row_valid)
+    item_counts = np.asarray(jnp.diagonal(pair_count_matrix))
+    n_frequent = int((item_counts >= min_count).sum())
+    if mode == "support":
+        confs = (rule_counts.astype(np.float64) / n_playlists).astype(np.float32)
+    else:
+        denom = np.maximum(item_counts, 1)[:, None].astype(np.float64)
+        confs = (rule_counts / denom).astype(np.float32)
+    return RuleTensors(
+        rule_ids=rule_ids,
+        rule_counts=rule_counts,
+        rule_confs=confs,
+        item_counts=item_counts,
+        n_playlists=n_playlists,
+        min_support=min_support,
+        min_count=min_count,
+        mode=mode,
+        min_confidence=min_confidence,
+        n_frequent_items=n_frequent,
+        n_songs_missing=int(pair_count_matrix.shape[0]) - n_frequent,
+        overflow_rows=int((row_valid > k_max).sum()),
+    )

@@ -1,0 +1,57 @@
+"""The serving hot path as one jitted device call.
+
+Replaces the reference's per-request pure-Python dict max-merge + sort
+(reference: rest_api/app/main.py:224-254): seed songs' rule rows are gathered
+from the HBM-resident rule tensors, max-merged by scatter-max into a dense
+per-request score vector, and the top-K names extracted — batched over B
+concurrent requests so 1k QPS rides a handful of device calls.
+
+Semantics parity notes:
+- seeds absent from the rule tensors contribute nothing (the reference
+  filters seeds by dict membership, rest_api/app/main.py:235);
+- a recommendation may be another seed song (the reference's merge does not
+  exclude seeds — only each row's own antecedent is absent from its row);
+- merge is max over per-seed confidences (defaultdict max-merge at :240-247),
+  then descending sort, then top ``K_BEST_TRACKS`` (:250-253). ``top_k``'s
+  tie order (by index) stands in for Python's stable sort order on ties; the
+  set of returned confidences is identical.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k_best",))
+def recommend_batch(
+    rule_ids: jax.Array,  # int32 (V, K_max), -1 padded
+    rule_confs: jax.Array,  # float32 (V, K_max), 0 padded
+    seed_ids: jax.Array,  # int32 (B, L), -1 padded
+    *,
+    k_best: int,
+):
+    """→ ``(top_ids int32 (B, k_best) with -1 padding, top_confs f32)``."""
+    v = rule_ids.shape[0]
+    b = seed_ids.shape[0]
+    safe_seeds = jnp.where(seed_ids >= 0, seed_ids, 0)
+    gathered_ids = rule_ids[safe_seeds]  # (B, L, K)
+    gathered_confs = rule_confs[safe_seeds]  # (B, L, K)
+    valid = (gathered_ids >= 0) & (seed_ids >= 0)[..., None]
+    # dump padding into an extra slot V, sliced off after the scatter
+    targets = jnp.where(valid, gathered_ids, v)
+    confs = jnp.where(valid, gathered_confs, 0.0)
+    scores = jnp.zeros((b, v + 1), dtype=rule_confs.dtype)
+    batch_idx = jnp.arange(b, dtype=jnp.int32)[:, None, None]
+    scores = scores.at[batch_idx, targets].max(confs)
+    scores = scores[:, :v]
+    k = min(k_best, v)
+    top_confs, top_ids = jax.lax.top_k(scores, k)
+    top_ids = jnp.where(top_confs > 0, top_ids, -1)
+    if k < k_best:  # static pad so callers always see k_best columns
+        pad = ((0, 0), (0, k_best - k))
+        top_ids = jnp.pad(top_ids, pad, constant_values=-1)
+        top_confs = jnp.pad(top_confs, pad)
+    return top_ids, top_confs

@@ -1,0 +1,118 @@
+"""Frequent-itemset support counting — the mining compute core.
+
+Replaces mlxtend's FP-Growth call (reference: machine-learning/main.py:272).
+An FP-tree is a pointer-chasing recursion over conditional pattern bases —
+hostile to XLA's static-shape compilation model — so this module uses an
+exact dense formulation that lives on the MXU instead:
+
+    pair_counts[i, j] = Σ_p X[p, i]·X[p, j]  =  (XᵀX)[i, j]
+
+one int8×int8→int32 matmul. Higher-order itemsets extend frequent pairs by a
+second matmul over masked column products (``triple_counts``).
+
+**Why pairs are sufficient for output parity** (the dominance argument): the
+reference's fast path walks every frequent itemset and max-merges the
+*itemset support* into each member's recommendation row symmetrically
+(reference: machine-learning/main.py:284-296 — note ``row.support`` at :286
+is stored as the "confidence"). For any itemset S with |S| ≥ 2 and any two
+members a, b ∈ S, the pair {a, b} ⊇-dominates S in support
+(support({a,b}) ≥ support(S)) and is itself frequent whenever S is. Under a
+max-merge, every contribution from S to (a → b) is therefore already covered
+by the pair {a, b}. Singletons contribute nothing (no "other" member —
+reference main.py:288 yields an empty loop). Hence the thresholded pair-
+support matrix IS the reference's final rule mapping, exactly. Triples and
+beyond only matter for the itemset census and for the dormant slow path's
+true-confidence semantics (machine-learning/main.py:224-260), both of which
+``triple_counts`` serves.
+
+Support thresholding is done in INTEGER counts (``min_count``) computed on
+host in float64, so device float32 rounding can never flip a frequency
+decision vs. the CPU oracle.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def min_count_for(min_support: float, n_playlists: int) -> int:
+    """Smallest integer count c with c / n_playlists >= min_support, computed
+    in float64 exactly as a CPU oracle would compare (mlxtend keeps itemsets
+    with support >= min_support). Clamped to at least 1."""
+    c = int(math.ceil(min_support * n_playlists))
+    # ceil can overshoot when min_support * n is an exact integer in f64
+    while c > 1 and (c - 1) / n_playlists >= min_support:
+        c -= 1
+    return max(c, 1)
+
+
+@jax.jit
+def pair_counts(x_onehot: jax.Array) -> jax.Array:
+    """``XᵀX`` over the playlist axis: int8 (P, V) → int32 (V, V).
+
+    Diagonal = singleton supports; off-diagonal = pair supports. Contraction
+    over P is dimension 0 of both operands, emitted as a single MXU matmul
+    with int32 accumulation.
+    """
+    return jax.lax.dot_general(
+        x_onehot,
+        x_onehot,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+@jax.jit
+def item_counts(x_onehot: jax.Array) -> jax.Array:
+    """Per-item singleton supports: int32 (V,)."""
+    return jnp.sum(x_onehot.astype(jnp.int32), axis=0)
+
+
+@jax.jit
+def triple_counts(x_onehot: jax.Array, pair_i: jax.Array, pair_j: jax.Array) -> jax.Array:
+    """Supports of {i, j, k} for E candidate pairs × all k: int32 (E, V).
+
+    ``Y[p, e] = X[p, i_e]·X[p, j_e]`` (elementwise on the VPU), then
+    ``YᵀX`` on the MXU. Rows for invalid (padded) pairs are garbage and must
+    be masked by the caller; columns k ∈ {i_e, j_e} hold pair supports, not
+    proper triples, and must likewise be masked.
+    """
+    y = x_onehot[:, pair_i] * x_onehot[:, pair_j]  # (P, E) int8
+    return jax.lax.dot_general(
+        y,
+        x_onehot,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def frequent_pairs(counts: jax.Array, min_count: jax.Array, *, capacity: int):
+    """Extract up to ``capacity`` frequent off-diagonal pairs (i < j) from the
+    pair-count matrix, XLA-shape-statically.
+
+    Returns ``(pair_i, pair_j, pair_count, n_frequent)``; entries past
+    ``n_frequent`` are -1/-1/0 padding. ``n_frequent`` may exceed
+    ``capacity`` — the caller must check for overflow.
+    """
+    v = counts.shape[0]
+    upper = jnp.triu(jnp.ones((v, v), dtype=bool), k=1)
+    valid = upper & (counts >= min_count)
+    n_frequent = valid.sum(dtype=jnp.int32)
+    flat_score = jnp.where(valid, counts, -1).reshape(-1)
+    k = min(capacity, v * v)
+    top_counts, top_idx = jax.lax.top_k(flat_score, k)
+    keep = top_counts > 0
+    pair_i = jnp.where(keep, top_idx // v, -1).astype(jnp.int32)
+    pair_j = jnp.where(keep, top_idx % v, -1).astype(jnp.int32)
+    top_counts = jnp.where(keep, top_counts, 0)
+    if k < capacity:  # static pad so the declared capacity shape holds
+        pad = capacity - k
+        pair_i = jnp.pad(pair_i, (0, pad), constant_values=-1)
+        pair_j = jnp.pad(pair_j, (0, pad), constant_values=-1)
+        top_counts = jnp.pad(top_counts, (0, pad))
+    return pair_i, pair_j, top_counts, n_frequent

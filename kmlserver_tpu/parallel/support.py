@@ -1,0 +1,141 @@
+"""Sharded pair-support counting over a ``(dp, tp)`` device mesh.
+
+The distributed replacement for what the reference cannot do at all (its
+mining is single-process CPU — SURVEY.md §2.4): the one-hot basket matrix
+``X (P, V)`` is laid out ``P('dp', 'tp')`` — transactions sharded over
+``dp``, vocabulary columns over ``tp`` — and the pair-count matrix
+``C = XᵀX`` is produced column-sharded ``P(None, 'tp')``.
+
+Three interchangeable implementations, all exact:
+
+- ``impl="gspmd"`` — annotate shardings on the plain matmul and let XLA's
+  SPMD partitioner insert the collectives. The idiomatic default.
+- ``impl="allgather"`` — explicit ``shard_map``: ``all_gather`` the column
+  shards over ``tp`` (one ICI hop, Ulysses-style all-to-all analogue), one
+  local matmul, ``psum`` partial counts over ``dp``.
+- ``impl="ring"`` — explicit ``shard_map`` ring: column blocks rotate around
+  the ``tp`` axis via ``ppermute`` (ring-attention-style neighbor exchange),
+  computing one ``(V_loc, V_loc)`` output block per step, overlapping
+  compute with neighbor transfers and never materializing the full ``X`` on
+  any chip. Peak per-chip memory O(P/dp · V/tp), vs O(P/dp · V) for
+  all-gather — the path for 1M-track vocabularies.
+
+All variants ``psum`` over ``dp``, so the collective volume rides ICI, and
+pad P to a multiple of dp and V to a multiple of tp with zero rows/columns
+(zero rows/columns contribute zero counts; padding columns are sliced off).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mining.vocab import Baskets
+from ..ops import encode
+from .mesh import AXIS_DP, AXIS_TP, round_up
+
+
+def _onehot_padded(baskets: Baskets, p_pad: int, v_pad: int, mesh: Mesh) -> jax.Array:
+    """Build the one-hot matrix directly into the ``P('dp','tp')`` layout."""
+    build = jax.jit(
+        partial(encode.onehot_matrix, n_playlists=p_pad, n_tracks=v_pad),
+        out_shardings=NamedSharding(mesh, P(AXIS_DP, AXIS_TP)),
+    )
+    return build(
+        jnp.asarray(baskets.playlist_rows), jnp.asarray(baskets.track_ids)
+    )
+
+
+def _dot_pt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Contract dim 0 (playlists) of both operands → int32 counts."""
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def _gspmd_counts(mesh: Mesh):
+    return jax.jit(
+        _dot_pt,
+        in_shardings=(
+            NamedSharding(mesh, P(AXIS_DP, AXIS_TP)),
+            NamedSharding(mesh, P(AXIS_DP, AXIS_TP)),
+        ),
+        out_shardings=NamedSharding(mesh, P(None, AXIS_TP)),
+    )
+
+
+def _allgather_counts(mesh: Mesh):
+    def local(x_local: jax.Array) -> jax.Array:
+        # (P_loc, V_loc) → gather full columns (P_loc, V), one matmul,
+        # psum partials over dp → (V, V_loc)
+        x_cols = jax.lax.all_gather(x_local, AXIS_TP, axis=1, tiled=True)
+        c_local = _dot_pt(x_cols, x_local)
+        return jax.lax.psum(c_local, AXIS_DP)
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=P(AXIS_DP, AXIS_TP),
+            out_specs=P(None, AXIS_TP),
+        )
+    )
+
+
+def _ring_counts(mesh: Mesh):
+    tp = mesh.shape[AXIS_TP]
+
+    def local(x_local: jax.Array) -> jax.Array:
+        v_loc = x_local.shape[1]
+        my = jax.lax.axis_index(AXIS_TP)
+        perm = [(j, (j + 1) % tp) for j in range(tp)]
+
+        def step(i, carry):
+            block, out = carry
+            # `block` currently holds shard (my - i) mod tp's columns
+            src = jax.lax.rem(my - i + tp, tp)
+            c = _dot_pt(block, x_local)  # (V_loc, V_loc) block of C
+            out = jax.lax.dynamic_update_slice(out, c, (src * v_loc, 0))
+            block = jax.lax.ppermute(block, AXIS_TP, perm)
+            return block, out
+
+        # mark the accumulator device-varying so the fori_loop carry type
+        # matches after blocks of `c` (which varies per shard) land in it
+        out0 = jax.lax.pcast(
+            jnp.zeros((v_loc * tp, v_loc), dtype=jnp.int32),
+            (AXIS_DP, AXIS_TP), to="varying",
+        )
+        _, out = jax.lax.fori_loop(0, tp, step, (x_local, out0))
+        return jax.lax.psum(out, AXIS_DP)
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=P(AXIS_DP, AXIS_TP),
+            out_specs=P(None, AXIS_TP),
+        )
+    )
+
+
+_IMPLS = {
+    "gspmd": _gspmd_counts,
+    "allgather": _allgather_counts,
+    "ring": _ring_counts,
+}
+
+
+def sharded_pair_counts(
+    baskets: Baskets, mesh: Mesh, impl: str = "gspmd"
+) -> jax.Array:
+    """Pair-count matrix (V, V) int32, computed over the mesh. The result
+    keeps its ``P(None, 'tp')`` sharding; downstream rule emission is a
+    row/column-local threshold+top-k that composes under the same jit."""
+    if impl not in _IMPLS:
+        raise ValueError(f"impl must be one of {sorted(_IMPLS)}, got {impl!r}")
+    p_pad = round_up(max(baskets.n_playlists, 1), mesh.shape[AXIS_DP])
+    v_pad = round_up(max(baskets.n_tracks, 1), mesh.shape[AXIS_TP])
+    x = _onehot_padded(baskets, p_pad, v_pad, mesh)
+    counts = _IMPLS[impl](mesh)(x) if impl != "gspmd" else _IMPLS[impl](mesh)(x, x)
+    v = baskets.n_tracks
+    return counts[:v, :v]

@@ -1,16 +1,29 @@
 """Test harness: force JAX onto a virtual 8-device CPU platform.
 
-Multi-chip sharding is tested without TPU hardware by asking XLA's host
-platform for 8 virtual devices — this must happen before jax is imported
-anywhere in the test process.
+Multi-chip sharding is tested without TPU hardware via XLA's host platform
+with 8 virtual devices. Two mechanisms, both needed:
+
+- ``XLA_FLAGS`` must be in the environment before the first backend
+  initialization (conftest import is early enough);
+- the platform must be forced to "cpu" via ``jax.config`` — an environment
+  variable is NOT sufficient here because this image's site hook registers a
+  remote TPU ("axon") backend at interpreter startup and pins the platform
+  selection programmatically; re-updating the config keeps the remote TPU
+  client from ever being constructed inside the test process.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# for any python subprocess a test may spawn
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

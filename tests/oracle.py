@@ -1,0 +1,94 @@
+"""Brute-force CPU oracle for the mining semantics.
+
+An independent from-scratch implementation of the reference fast path's
+OBSERVABLE behavior (machine-learning/main.py:262-313): enumerate ALL frequent
+itemsets (every length) by explicit subset counting, then walk every itemset
+and max-merge its support into each member's recommendation row symmetrically.
+mlxtend is not in this image; on the tiny inputs used in tests exhaustive
+enumeration is exact, which is all an oracle needs.
+
+Deliberately naive (itertools + dicts, float64 arithmetic like mlxtend) so it
+shares no code and no failure modes with the device path under test.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+
+
+def itemset_supports(
+    baskets: list[list[str]], max_len: int | None = None
+) -> dict[frozenset, int]:
+    """Counts of every itemset (up to max_len) that occurs in >= 1 basket."""
+    counts: dict[frozenset, int] = {}
+    for basket in baskets:
+        items = sorted(set(basket))
+        top = len(items) if max_len is None else min(max_len, len(items))
+        for size in range(1, top + 1):
+            for combo in combinations(items, size):
+                key = frozenset(combo)
+                counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def frequent_itemsets(
+    baskets: list[list[str]], min_support: float, max_len: int | None = None
+) -> dict[frozenset, int]:
+    """Itemsets with support count/P >= min_support (float64, mlxtend-style)."""
+    p = len(baskets)
+    return {
+        s: c
+        for s, c in itemset_supports(baskets, max_len).items()
+        if c / p >= min_support
+    }
+
+
+def reference_fast_rules(
+    baskets: list[list[str]], min_support: float, max_len: int | None = None
+) -> dict[str, dict[str, float]]:
+    """The reference fast path's rule dict: for every frequent itemset, every
+    member recommends every other member with the ITEMSET SUPPORT stored as
+    the confidence, max-merged across itemsets
+    (machine-learning/main.py:284-296, support-as-confidence quirk at :286)."""
+    p = len(baskets)
+    rules: dict[str, dict[str, float]] = {}
+    for itemset, count in frequent_itemsets(baskets, min_support, max_len).items():
+        support = count / p
+        for a in itemset:
+            # every member of every frequent itemset becomes a KEY — a
+            # frequent singleton yields an empty row (main.py:289-291)
+            row = rules.setdefault(a, {})
+            for b in itemset:
+                if a == b:
+                    continue
+                if support > row.get(b, 0.0):
+                    row[b] = support
+    return rules
+
+
+def reference_recommend(
+    rules: dict[str, dict[str, float]], seeds: list[str], k_best: int
+) -> list[tuple[str, float]]:
+    """The serving max-merge + sort + top-k (rest_api/app/main.py:224-254),
+    returning (name, confidence) pairs sorted by confidence descending."""
+    merged: dict[str, float] = {}
+    for seed in seeds:
+        for other, conf in rules.get(seed, {}).items():
+            if conf > merged.get(other, 0.0):
+                merged[other] = conf
+    ranked = sorted(merged.items(), key=lambda kv: -kv[1])
+    return ranked[:k_best]
+
+
+def random_baskets(rng, n_playlists: int, n_tracks: int, mean_len: float):
+    """Random transaction DB with a popularity skew (quadratic rank decay)."""
+    names = [f"s{i:03d}" for i in range(n_tracks)]
+    weights = 1.0 / (1.0 + (rng.permutation(n_tracks) ** 1.5))
+    weights = weights / weights.sum()
+    baskets = []
+    for _ in range(n_playlists):
+        size = max(1, rng.poisson(mean_len))
+        size = min(size, n_tracks)
+        chosen = rng.choice(n_tracks, size=size, replace=False, p=weights)
+        baskets.append([names[i] for i in chosen])
+    return baskets

@@ -75,35 +75,33 @@ class TestArtifacts:
     def test_rule_tensor_roundtrip(self, tmp_path):
         vocab = ["a", "b", "c"]
         rule_ids = np.array([[1, -1], [0, 2], [-1, -1]], dtype=np.int32)
-        rule_confs = np.array([[0.5, 0.0], [0.5, 0.25], [0.0, 0.0]], dtype=np.float32)
+        rule_counts = np.array([[2, 0], [2, 1], [0, 0]], dtype=np.int32)
+        # c is frequent-but-partnerless (count 1 >= min_count 1): empty KEY
+        item_counts = np.array([3, 2, 1], dtype=np.int32)
         path = str(tmp_path / "r.npz")
         artifacts.save_rule_tensors(
-            path, vocab=vocab, rule_ids=rule_ids, rule_confs=rule_confs,
-            n_playlists=4, min_support=0.05,
+            path, vocab=vocab, rule_ids=rule_ids, rule_counts=rule_counts,
+            item_counts=item_counts, n_playlists=4, min_support=0.25,
         )
         loaded = artifacts.load_rule_tensors(path)
         assert loaded["vocab"] == vocab
         np.testing.assert_array_equal(loaded["rule_ids"], rule_ids)
-        np.testing.assert_array_equal(loaded["rule_confs"], rule_confs)
+        np.testing.assert_array_equal(loaded["rule_counts"], rule_counts)
+        np.testing.assert_allclose(loaded["rule_confs"][0, 0], 0.5)
         assert loaded["n_playlists"] == 4
+        # expansion: confidences re-derived in float64, empty keys preserved
+        d = artifacts.rules_dict_from_tensors(loaded)
+        assert d == {"a": {"b": 0.5}, "b": {"a": 0.5, "c": 0.25}, "c": {}}
 
-    def test_dict_tensor_inverse(self):
+    def test_tensors_from_dict_legacy_pickle(self):
         vocab = ["a", "b", "c"]
-        rule_ids = np.array([[1, -1], [0, 2], [-1, -1]], dtype=np.int32)
-        rule_confs = np.array([[0.5, 0.0], [0.5, 0.25], [0.0, 0.0]], dtype=np.float32)
-        d = artifacts.rules_dict_from_tensors(vocab, rule_ids, rule_confs)
-        assert d == {"a": {"b": 0.5}, "b": {"a": 0.5, "c": 0.25}}
-        ids2, confs2 = artifacts.tensors_from_rules_dict(d, vocab, k_max=2)
-        d2 = artifacts.rules_dict_from_tensors(vocab, ids2, confs2)
-        assert d2 == d
-
-    def test_tensors_from_dict_unknown_consequents(self):
+        d = {"a": {"zz-not-in-vocab": 0.9, "b": 0.5, "c": 0.4}, "c": {}}
+        ids, confs, known = artifacts.tensors_from_rules_dict(d, vocab, k_max=2)
         # unknown consequents must not punch holes or crowd out valid ones
-        vocab = ["a", "b", "c"]
-        d = {"a": {"zz-not-in-vocab": 0.9, "b": 0.5, "c": 0.4}}
-        ids, confs = artifacts.tensors_from_rules_dict(d, vocab, k_max=2)
         np.testing.assert_array_equal(ids[0], [1, 2])
         np.testing.assert_allclose(confs[0], [0.5, 0.4])
+        # empty-dict keys are still KNOWN seeds (rest_api/app/main.py:235)
+        np.testing.assert_array_equal(known, [True, False, True])
 
 
 def _mk_cfg(tmp_path, n_datasets=3) -> MiningConfig:

@@ -1,0 +1,275 @@
+"""Compute-core tests: encoding, support counting, rule emission, and the
+serving kernel — all cross-checked against the brute-force oracle.
+
+The load-bearing test is ``test_dominance_pairs_reproduce_reference_rules``:
+the device path only counts PAIRS, while the oracle enumerates frequent
+itemsets of EVERY length and applies the reference's symmetric
+support-as-confidence max-merge — they must agree exactly (the dominance
+argument in ops/support.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kmlserver_tpu.data.csv import TrackTable
+from kmlserver_tpu.mining.vocab import Vocab, build_baskets
+from kmlserver_tpu.ops import encode, rules, serve, support
+
+from .oracle import (
+    frequent_itemsets,
+    random_baskets,
+    reference_fast_rules,
+    reference_recommend,
+)
+
+
+def table_from_baskets(baskets) -> TrackTable:
+    pids, names = [], []
+    for pid, basket in enumerate(baskets):
+        for name in basket:
+            pids.append(pid)
+            names.append(name)
+    return TrackTable(pid=np.array(pids), track_name=np.array(names, dtype=object))
+
+
+def onehot_np(baskets, vocab: Vocab) -> np.ndarray:
+    x = np.zeros((len(baskets), len(vocab)), dtype=np.int8)
+    for p, basket in enumerate(baskets):
+        for name in basket:
+            x[p, vocab.index[name]] = 1
+    return x
+
+
+class TestEncode:
+    def test_onehot_matches_manual(self, tiny_baskets):
+        b = build_baskets(table_from_baskets(tiny_baskets))
+        x = encode.onehot_matrix(
+            jnp.asarray(b.playlist_rows), jnp.asarray(b.track_ids),
+            n_playlists=b.n_playlists, n_tracks=b.n_tracks,
+        )
+        np.testing.assert_array_equal(np.asarray(x), onehot_np(tiny_baskets, b.vocab))
+
+    def test_duplicate_membership_rows_counted_once(self):
+        # same (pid, track) appearing twice in the CSV must still one-hot to 1
+        table = TrackTable(
+            pid=np.array([7, 7, 7]),
+            track_name=np.array(["a", "a", "b"], dtype=object),
+        )
+        b = build_baskets(table)
+        x = encode.onehot_matrix(
+            jnp.asarray(b.playlist_rows), jnp.asarray(b.track_ids),
+            n_playlists=b.n_playlists, n_tracks=b.n_tracks,
+        )
+        np.testing.assert_array_equal(np.asarray(x), [[1, 1]])
+
+    def test_bitpack_roundtrip(self, rng):
+        baskets = random_baskets(rng, n_playlists=20, n_tracks=70, mean_len=5)
+        b = build_baskets(table_from_baskets(baskets))
+        rows, ids = jnp.asarray(b.playlist_rows), jnp.asarray(b.track_ids)
+        x = encode.onehot_matrix(rows, ids, n_playlists=b.n_playlists, n_tracks=b.n_tracks)
+        packed = encode.bitpack_matrix(rows, ids, n_playlists=b.n_playlists, n_tracks=b.n_tracks)
+        assert packed.shape == (b.n_playlists, encode.n_words(b.n_tracks))
+        unpacked = encode.unpack_bits(packed, b.n_tracks)
+        np.testing.assert_array_equal(np.asarray(unpacked), np.asarray(x))
+
+
+class TestSupport:
+    def test_pair_counts_equal_numpy(self, rng):
+        baskets = random_baskets(rng, n_playlists=30, n_tracks=15, mean_len=4)
+        b = build_baskets(table_from_baskets(baskets))
+        x_np = onehot_np(baskets, b.vocab)
+        counts = support.pair_counts(jnp.asarray(x_np))
+        np.testing.assert_array_equal(
+            np.asarray(counts), x_np.astype(np.int64).T @ x_np.astype(np.int64)
+        )
+
+    def test_min_count_for_matches_float64_threshold(self):
+        # c/P >= s in float64 must be equivalent to c >= min_count_for(s, P)
+        for p in (1, 3, 5, 7, 20, 100, 2246):
+            for s in (0.01, 0.05, 0.1, 1 / 3, 0.5, 0.2):
+                mc = support.min_count_for(s, p)
+                for c in range(0, p + 1):
+                    assert (c / p >= s) == (c >= mc), (p, s, c, mc)
+
+    def test_frequent_pairs_match_oracle(self, rng):
+        baskets = random_baskets(rng, n_playlists=40, n_tracks=12, mean_len=4)
+        min_support = 0.1
+        b = build_baskets(table_from_baskets(baskets))
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        counts = support.pair_counts(x)
+        mc = support.min_count_for(min_support, len(baskets))
+        pi, pj, pc, n_freq = support.frequent_pairs(counts, jnp.int32(mc), capacity=256)
+        got = {
+            (b.vocab.names[int(i)], b.vocab.names[int(j)]): int(c)
+            for i, j, c in zip(np.asarray(pi), np.asarray(pj), np.asarray(pc))
+            if i >= 0
+        }
+        expected = {
+            tuple(sorted(s)): c
+            for s, c in frequent_itemsets(baskets, min_support, max_len=2).items()
+            if len(s) == 2
+        }
+        assert got == expected
+        assert int(n_freq) == len(expected)
+
+    def test_triple_counts_match_oracle(self, rng):
+        baskets = random_baskets(rng, n_playlists=40, n_tracks=10, mean_len=5)
+        b = build_baskets(table_from_baskets(baskets))
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        all_supports = frequent_itemsets(baskets, min_support=0.0, max_len=3)
+        # pick a few concrete pairs to extend
+        pair_i = jnp.asarray([0, 1, 2], dtype=jnp.int32)
+        pair_j = jnp.asarray([1, 2, 3], dtype=jnp.int32)
+        t = np.asarray(support.triple_counts(x, pair_i, pair_j))
+        for e, (i, j) in enumerate(zip([0, 1, 2], [1, 2, 3])):
+            for k in range(len(b.vocab)):
+                if k in (i, j):
+                    continue
+                key = frozenset(
+                    {b.vocab.names[i], b.vocab.names[j], b.vocab.names[k]}
+                )
+                assert t[e, k] == all_supports.get(key, 0), (i, j, k)
+
+
+class TestRuleEmission:
+    def test_dominance_pairs_reproduce_reference_rules(self, rng):
+        """Pairs-only device mining == oracle over ALL itemset lengths."""
+        for trial in range(3):
+            baskets = random_baskets(rng, n_playlists=50, n_tracks=14, mean_len=5)
+            min_support = [0.05, 0.1, 0.16][trial]
+            b = build_baskets(table_from_baskets(baskets))
+            x = jnp.asarray(onehot_np(baskets, b.vocab))
+            mined = rules.mine_rules_from_counts(
+                support.pair_counts(x),
+                n_playlists=len(baskets),
+                min_support=min_support,
+                k_max=64,
+            )
+            got = mined.to_rules_dict(b.vocab.names)
+            expected = reference_fast_rules(baskets, min_support)  # all lengths
+            assert got == expected, f"trial {trial}"
+
+    def test_missing_songs_counter(self, rng):
+        baskets = random_baskets(rng, n_playlists=50, n_tracks=14, mean_len=4)
+        min_support = 0.12
+        b = build_baskets(table_from_baskets(baskets))
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        mined = rules.mine_rules_from_counts(
+            support.pair_counts(x), n_playlists=len(baskets),
+            min_support=min_support, k_max=64,
+        )
+        expected = reference_fast_rules(baskets, min_support)
+        # reference: total_songs - len(rules) (machine-learning/main.py:298-305)
+        # — keys include frequent singletons with empty rows
+        assert mined.n_frequent_items == len(expected)
+        assert mined.n_songs_missing == len(b.vocab) - len(expected)
+
+    def test_true_confidence_mode_matches_oracle(self, rng):
+        """confidence_mode="confidence" = the dormant slow path's semantics
+        (machine-learning/main.py:224-260): conf(a→b) = s(ab)/s(a),
+        asymmetric, thresholded at min_confidence."""
+        baskets = random_baskets(rng, n_playlists=60, n_tracks=12, mean_len=5)
+        min_support, min_confidence = 0.05, 0.3
+        b = build_baskets(table_from_baskets(baskets))
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        mined = rules.mine_rules_from_counts(
+            support.pair_counts(x), n_playlists=len(baskets),
+            min_support=min_support, k_max=32,
+            mode="confidence", min_confidence=min_confidence,
+        )
+        got = mined.to_rules_dict(b.vocab.names)
+        # independent oracle: brute-force pair + singleton counts
+        supports = frequent_itemsets(baskets, min_support)
+        expected: dict[str, dict[str, float]] = {}
+        for s, c in supports.items():
+            if len(s) == 1:
+                expected.setdefault(next(iter(s)), {})
+            elif len(s) == 2:
+                a_, b_ = sorted(s)
+                for x_, y_ in ((a_, b_), (b_, a_)):
+                    conf = c / supports[frozenset({x_})]
+                    if conf >= min_confidence:
+                        expected.setdefault(x_, {})[y_] = max(
+                            expected.get(x_, {}).get(y_, 0.0), conf
+                        )
+        # singletons of frequent pairs are themselves frequent → keys exist
+        assert got == expected
+
+    def test_k_max_truncation_and_overflow(self, tiny_baskets):
+        b = build_baskets(table_from_baskets(tiny_baskets))
+        x = jnp.asarray(onehot_np(tiny_baskets, b.vocab))
+        # min_support 1/5 keeps every co-occurring pair; t0 has 4 partners
+        mined = rules.mine_rules_from_counts(
+            support.pair_counts(x), n_playlists=5, min_support=0.2, k_max=2,
+        )
+        assert mined.overflow_rows > 0
+        t0 = b.vocab.index["t0"]
+        kept = mined.rule_ids[t0]
+        assert (kept >= 0).sum() == 2
+        # truncation keeps the highest-support partners: t1 (3) first
+        assert b.vocab.names[kept[0]] == "t1"
+
+
+class TestServeKernel:
+    def _mined(self, baskets, min_support, k_max=64):
+        b = build_baskets(table_from_baskets(baskets))
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        mined = rules.mine_rules_from_counts(
+            support.pair_counts(x), n_playlists=len(baskets),
+            min_support=min_support, k_max=k_max,
+        )
+        return b, mined
+
+    def test_matches_reference_recommend(self, rng):
+        baskets = random_baskets(rng, n_playlists=60, n_tracks=14, mean_len=5)
+        b, mined = self._mined(baskets, min_support=0.05)
+        rules_dict = mined.to_rules_dict(b.vocab.names)
+        k_best = 5
+        seed_sets = [
+            [b.vocab.names[0]],
+            [b.vocab.names[1], b.vocab.names[3], b.vocab.names[5]],
+            [b.vocab.names[2], "not-a-song"],
+            ["nope", "also-nope"],
+        ]
+        max_len = 4
+        seed_ids = np.full((len(seed_sets), max_len), -1, dtype=np.int32)
+        for r, seeds in enumerate(seed_sets):
+            for c, s in enumerate(seeds):
+                seed_ids[r, c] = b.vocab.index.get(s, -1)
+        top_ids, top_confs = serve.recommend_batch(
+            jnp.asarray(mined.rule_ids),
+            jnp.asarray(mined.rule_confs),
+            jnp.asarray(seed_ids),
+            k_best=k_best,
+        )
+        top_ids, top_confs = np.asarray(top_ids), np.asarray(top_confs)
+        for r, seeds in enumerate(seed_sets):
+            known = [s for s in seeds if s in b.vocab.index]
+            expected = reference_recommend(rules_dict, known, k_best)
+            full_merged = dict(reference_recommend(rules_dict, known, 10**6))
+            got = [
+                (b.vocab.names[int(i)], float(c))
+                for i, c in zip(top_ids[r], top_confs[r])
+                if i >= 0
+            ]
+            # every returned (name, conf) must be a true merged entry ...
+            for name, conf in got:
+                assert full_merged[name] == pytest.approx(conf, rel=1e-6), (r, name)
+            # ... and the confidence multiset must equal the oracle top-k's
+            # (ties at the k-th slot may legitimately pick different names
+            # than python's stable sort — reference: rest_api/app/main.py:250)
+            got_confs = sorted((c for _, c in got), reverse=True)
+            exp_confs = sorted((c for _, c in expected), reverse=True)
+            assert got_confs == pytest.approx(exp_confs, rel=1e-6), r
+
+    def test_empty_and_unknown_seeds_give_no_recs(self, rng):
+        baskets = random_baskets(rng, n_playlists=30, n_tracks=10, mean_len=4)
+        b, mined = self._mined(baskets, min_support=0.1)
+        seed_ids = jnp.asarray([[-1, -1]], dtype=jnp.int32)
+        top_ids, top_confs = serve.recommend_batch(
+            jnp.asarray(mined.rule_ids), jnp.asarray(mined.rule_confs),
+            seed_ids, k_best=3,
+        )
+        assert (np.asarray(top_ids) == -1).all()
+        assert (np.asarray(top_confs) == 0).all()

@@ -1,0 +1,171 @@
+"""End-to-end mining-job tests against a tmpdir standing in for the PVC:
+artifact contract, oracle parity of the recommendations pickle, dataset
+rotation across runs, duplicate-artist validation failure."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.csv import TrackTable, write_tracks_csv
+from kmlserver_tpu.io import artifacts, registry
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.mining.vocab import DuplicateArtistURIError
+from kmlserver_tpu.parallel.mesh import make_mesh
+
+from .oracle import random_baskets, reference_fast_rules
+
+
+def table_with_metadata(baskets) -> TrackTable:
+    """Membership table with track_uri/artist/album columns derived
+    deterministically from the track name."""
+    pids, names, uris, artists, artist_uris, albums = [], [], [], [], [], []
+    for pid, basket in enumerate(baskets):
+        for name in basket:
+            pids.append(pid)
+            names.append(name)
+            uris.append(f"spotify:track:{name}")
+            artists.append(f"artist-of-{name[-1]}")
+            artist_uris.append(f"spotify:artist:{name[-1]}")
+            albums.append(f"album-{name}")
+    return TrackTable(
+        pid=np.array(pids),
+        track_name=np.array(names, dtype=object),
+        track_uri=np.array(uris, dtype=object),
+        artist_name=np.array(artists, dtype=object),
+        artist_uri=np.array(artist_uris, dtype=object),
+        album_name=np.array(albums, dtype=object),
+    )
+
+
+@pytest.fixture
+def pvc(tmp_path, rng):
+    """A fake PVC with two datasets of random baskets."""
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    basket_sets = []
+    for i in (1, 2):
+        baskets = random_baskets(rng, n_playlists=40, n_tracks=16, mean_len=5)
+        basket_sets.append(baskets)
+        write_tracks_csv(
+            str(ds_dir / f"2023_spotify_ds{i}.csv"), table_with_metadata(baskets)
+        )
+    cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.1,
+        k_max_consequents=32, top_tracks_save_percentile=0.25,
+    )
+    return cfg, basket_sets
+
+
+class TestMiningJob:
+    def test_end_to_end_artifacts_and_oracle_parity(self, pvc):
+        cfg, basket_sets = pvc
+        summary = run_mining_job(cfg)
+        assert summary.run_index == 1
+        assert summary.dataset.endswith("ds1.csv")
+
+        # pickle artifact contract (reference object shapes)
+        recs = artifacts.load_pickle(os.path.join(cfg.pickles_dir, cfg.recommendations_file))
+        expected = reference_fast_rules(basket_sets[0], cfg.min_support)
+        assert recs == expected  # exact float64 parity
+
+        best = artifacts.load_pickle(os.path.join(cfg.pickles_dir, cfg.best_tracks_file))
+        assert isinstance(best, list) and best
+        assert set(best[0]) == {"track_name", "count"}
+        counts = [b["count"] for b in best]
+        assert counts == sorted(counts, reverse=True)
+
+        info = artifacts.load_pickle(os.path.join(cfg.pickles_dir, cfg.track_info_file))
+        some_uri = next(iter(info))
+        assert set(info[some_uri]) == {"track_name", "artist_name", "album_name"}
+
+        mapping = artifacts.load_pickle(os.path.join(cfg.pickles_dir, cfg.artists_mapping_file))
+        assert all(v.startswith("spotify:artist:") for v in mapping.values())
+
+        # tensor-native artifact must expand to EXACTLY the pickle dict
+        tensors = artifacts.load_rule_tensors(
+            artifacts.tensor_artifact_path(
+                os.path.join(cfg.pickles_dir, cfg.recommendations_file)
+            )
+        )
+        assert artifacts.rules_dict_from_tensors(tensors) == expected
+
+        # invalidation token written and matches the history row
+        token = artifacts.read_text(
+            registry.token_path_for(cfg.base_dir, cfg.data_invalidation_file)
+        )
+        assert token == summary.token
+
+    def test_rotation_across_runs(self, pvc):
+        cfg, _ = pvc
+        s1 = run_mining_job(cfg)
+        s2 = run_mining_job(cfg)
+        s3 = run_mining_job(cfg)
+        assert (s1.run_index, s2.run_index, s3.run_index) == (1, 2, 1)
+        assert s2.dataset.endswith("ds2.csv")
+        assert s1.token != s2.token != s3.token
+
+    def test_meshed_run_matches_single_device(self, pvc):
+        cfg, basket_sets = pvc
+        mesh = make_mesh("4x2")
+        run_mining_job(cfg, mesh=mesh)
+        recs = artifacts.load_pickle(os.path.join(cfg.pickles_dir, cfg.recommendations_file))
+        assert recs == reference_fast_rules(basket_sets[0], cfg.min_support)
+
+    def test_duplicate_artist_uri_raises(self, tmp_path):
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        table = TrackTable(
+            pid=np.array([0, 0]),
+            track_name=np.array(["a", "b"], dtype=object),
+            track_uri=np.array(["u:a", "u:b"], dtype=object),
+            artist_name=np.array(["same-artist", "same-artist"], dtype=object),
+            artist_uri=np.array(["uri1", "uri2"], dtype=object),
+            album_name=np.array(["x", "y"], dtype=object),
+        )
+        write_tracks_csv(str(ds_dir / "2023_spotify_ds1.csv"), table)
+        cfg = MiningConfig(base_dir=str(tmp_path), datasets_dir=str(ds_dir))
+        with pytest.raises(DuplicateArtistURIError):
+            run_mining_job(cfg)
+
+    def test_itemset_census_matches_oracle(self, pvc, rng):
+        from dataclasses import replace
+
+        from kmlserver_tpu.mining.miner import mine
+        from kmlserver_tpu.mining.vocab import build_baskets
+        from kmlserver_tpu.data.csv import read_tracks
+
+        from .oracle import frequent_itemsets
+
+        cfg, basket_sets = pvc
+        cfg = replace(cfg, max_itemset_len=3)
+        table = read_tracks(os.path.join(cfg.datasets_dir, "2023_spotify_ds1.csv"))
+        result = mine(build_baskets(table), cfg)
+        by_len = {1: 0, 2: 0, 3: 0}
+        for s in frequent_itemsets(basket_sets[0], cfg.min_support, max_len=3):
+            by_len[len(s)] += 1
+        assert result.itemset_census == by_len
+
+    def test_best_tracks_floor_semantics(self):
+        # reference keeps int(N*pct) — truncation, possibly zero
+        from kmlserver_tpu.mining.vocab import most_frequent_tracks
+
+        table = TrackTable(
+            pid=np.arange(10), track_name=np.array(list("abcdefghij"), dtype=object)
+        )
+        assert most_frequent_tracks(table, 0.03) == []  # int(0.3) == 0
+        assert len(most_frequent_tracks(table, 0.25)) == 2  # int(2.5) == 2
+
+    def test_job_entrypoint_env_contract(self, pvc, monkeypatch, capsys):
+        cfg, _ = pvc
+        # run exactly as the k8s Job would: env vars only
+        monkeypatch.setenv("BASE_DIR", cfg.base_dir)
+        monkeypatch.setenv("DATASETS_DIR", cfg.datasets_dir)
+        monkeypatch.setenv("MIN_SUPPORT", "0.1")
+        from kmlserver_tpu.mining.job import main
+
+        assert main() == 0
+        out = capsys.readouterr().out
+        assert "Time elapsed in rule generation" in out
