@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Headline benchmark: FP-Growth rule generation on a ds2-shaped workload.
+
+The reference's published number (BASELINE.md): 20.31 s of rule generation —
+mlxtend TransactionEncoder + FP-Growth + Python dict-expansion loops — on
+ds2 (240,249 membership rows, 2,246 playlists, 2,171 tracks, min_support
+0.05) on a CPU cluster node (relatorio.pdf p.6; timer bracket at
+machine-learning/main.py:264,306-308).
+
+This benchmark reproduces the same workload shape synthetically (the real
+ds2 CSV is not distributed with the reference repo) and times the SAME
+bracket for the TPU path: device one-hot encode + MXU pair-support matmul +
+rule-tensor emission + host rule-dict expansion. Median of repeated runs,
+compile excluded via warm-up (the reference's 20.31 s excludes Python/lib
+import too).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <median seconds>, "unit": "s",
+     "vs_baseline": <baseline_s / value = speedup factor>}
+
+Extra context (per-phase timings, serving p50) goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_baskets
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.ops.serve import recommend_batch
+
+BASELINE_RULE_GEN_S = 20.31  # relatorio.pdf p.6 (BASELINE.md row 1)
+MIN_SUPPORT = 0.05
+REPEATS = 5
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    baskets = synthetic_baskets(**DS2_SHAPE, seed=123)
+    log(
+        f"workload: {len(baskets.playlist_rows)} memberships, "
+        f"{baskets.n_playlists} playlists, {baskets.n_tracks} tracks, "
+        f"min_support {MIN_SUPPORT} (ds2 shape)"
+    )
+    cfg = MiningConfig(min_support=MIN_SUPPORT, k_max_consequents=256)
+
+    # warm-up: compile every kernel in the bracket
+    result = mine(baskets, cfg)
+    result.tensors.to_rules_dict(baskets.vocab.names)
+    log(f"warm-up mine: {result.duration_s:.3f}s (includes compile)")
+
+    times = []
+    for i in range(REPEATS):
+        t0 = time.perf_counter()
+        result = mine(baskets, cfg)
+        rules_dict = result.tensors.to_rules_dict(baskets.vocab.names)
+        times.append(time.perf_counter() - t0)
+        log(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)")
+    median_s = statistics.median(times)
+
+    # serving context number (stderr only): batch-32 recommend p50
+    rule_ids = jax.device_put(jnp.asarray(result.tensors.rule_ids))
+    rule_confs = jax.device_put(jnp.asarray(result.tensors.rule_confs))
+    rng = np.random.default_rng(0)
+    seeds = jnp.asarray(
+        rng.integers(0, baskets.n_tracks, size=(32, 8), dtype=np.int32)
+    )
+    recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
+    lat = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    log(
+        f"serving: batch-32 recommend p50 {lat[len(lat) // 2] * 1e3:.3f}ms "
+        f"({lat[len(lat) // 2] / 32 * 1e6:.1f}us/request)"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "fpgrowth_ds2_rule_generation_time",
+                "value": round(median_s, 4),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_RULE_GEN_S / median_s, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build + push both workload images (the reference keeps one-line
+# buildAndPushToDockerhub.sh scripts per workload; same role here).
+set -euo pipefail
+REGISTRY="${REGISTRY:-ghcr.io/example}"
+TAG="${TAG:-latest}"
+cd "$(dirname "$0")/.."
+docker build -f docker/Dockerfile.mining -t "$REGISTRY/kmlserver-tpu-mining:$TAG" .
+docker build -f docker/Dockerfile.api -t "$REGISTRY/kmlserver-tpu-api:$TAG" .
+docker push "$REGISTRY/kmlserver-tpu-mining:$TAG"
+docker push "$REGISTRY/kmlserver-tpu-api:$TAG"

@@ -121,16 +121,14 @@ def save_rule_tensors(
 
 def load_rule_tensors(path: str) -> dict[str, Any]:
     """Load the npz artifact, deriving serving-ready float32 confidences."""
+    from ..ops.rules import derive_confs
+
     with np.load(path, allow_pickle=True) as npz:
         rule_counts = npz["rule_counts"]
         item_counts = npz["item_counts"]
         n_playlists = int(npz["n_playlists"])
         mode = str(npz["mode"])
-        if mode == "support":
-            confs = (rule_counts.astype(np.float64) / n_playlists).astype(np.float32)
-        else:
-            denom = np.maximum(item_counts, 1)[:, None].astype(np.float64)
-            confs = (rule_counts / denom).astype(np.float32)
+        confs = derive_confs(rule_counts, item_counts, n_playlists, mode)
         return {
             "vocab": [str(s) for s in npz["vocab"]],
             "rule_ids": npz["rule_ids"],

@@ -47,35 +47,41 @@ class MiningResult:
     itemset_census: dict[int, int] | None = None  # length → frequent-itemset count
 
 
-def pair_count_fn(baskets: Baskets, mesh: "jax.sharding.Mesh | None" = None) -> jax.Array:
+def pair_count_fn(
+    baskets: Baskets, mesh: "jax.sharding.Mesh | None" = None
+) -> tuple[jax.Array, jax.Array | None]:
     """One-hot encode + pair-support count, single device or sharded.
 
-    The sharded path (mesh given) lives in ``parallel/``; this host-side
-    dispatcher keeps the pipeline oblivious to the mesh shape.
+    Returns ``(counts, x_onehot_or_None)`` — the one-hot matrix is handed
+    back on the single-device path so downstream steps (itemset census)
+    reuse it instead of re-encoding; on the sharded path the full matrix
+    deliberately never exists on one device (that's the point of sharding),
+    so ``None`` is returned.
     """
     if mesh is not None:
         from ..parallel.support import sharded_pair_counts
 
-        return sharded_pair_counts(baskets, mesh)
+        return sharded_pair_counts(baskets, mesh), None
     x = encode.onehot_matrix(
         jnp.asarray(baskets.playlist_rows),
         jnp.asarray(baskets.track_ids),
         n_playlists=baskets.n_playlists,
         n_tracks=baskets.n_tracks,
     )
-    return support.pair_counts(x)
+    return support.pair_counts(x), x
 
 
 def _itemset_census(
-    baskets: Baskets,
+    x: jax.Array | None,
     counts: jax.Array,
     min_count: int,
     max_len: int,
     pair_capacity: int = 1 << 16,
 ) -> dict[int, int]:
     """Exact frequent-itemset counts per length (1, 2, and — via pair
-    extension on the MXU — 3). Lengths beyond 3 are reported as -1
-    (not yet enumerated) rather than silently dropped."""
+    extension on the MXU over the already-built one-hot ``x`` — 3). Lengths
+    beyond 3, and length 3 when ``x`` isn't materialized (sharded mining),
+    are reported as -1 (not enumerated) rather than silently dropped."""
     item_counts = np.asarray(jnp.diagonal(counts))
     census = {1: int((item_counts >= min_count).sum())}
     if max_len < 2:
@@ -87,15 +93,9 @@ def _itemset_census(
     census[2] = n_pairs
     if max_len < 3:
         return census
-    if n_pairs > pair_capacity:
-        census[3] = -1  # overflowed the extension capacity; report honestly
+    if n_pairs > pair_capacity or x is None:
+        census[3] = -1  # capacity overflow / sharded x: report honestly
         return census
-    x = encode.onehot_matrix(
-        jnp.asarray(baskets.playlist_rows),
-        jnp.asarray(baskets.track_ids),
-        n_playlists=baskets.n_playlists,
-        n_tracks=baskets.n_tracks,
-    )
     t = support.triple_counts(x, jnp.where(pair_i >= 0, pair_i, 0), jnp.where(pair_j >= 0, pair_j, 0))
     t = np.asarray(t)
     pi, pj = np.asarray(pair_i), np.asarray(pair_j)
@@ -125,7 +125,7 @@ def mine(
             f"the bit-packed popcount path is not yet wired — using dense int8"
         )
     t0 = time.perf_counter()
-    counts = pair_count_fn(baskets, mesh)
+    counts, x = pair_count_fn(baskets, mesh)
     jax.block_until_ready(counts)
     tensors = rules.mine_rules_from_counts(
         counts,
@@ -138,9 +138,7 @@ def mine(
     duration = time.perf_counter() - t0
     census = None
     if cfg.max_itemset_len >= 3:
-        census = _itemset_census(
-            baskets, counts, tensors.min_count, cfg.max_itemset_len
-        )
+        census = _itemset_census(x, counts, tensors.min_count, cfg.max_itemset_len)
     return MiningResult(
         tensors=tensors,
         n_playlists=baskets.n_playlists,

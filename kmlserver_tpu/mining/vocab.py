@@ -37,10 +37,15 @@ class Vocab:
         return len(self.names)
 
     def encode(self, track_names: np.ndarray) -> np.ndarray:
-        """Vectorized name→id (int32). Unknown names map to -1."""
-        return np.asarray(
-            [self.index.get(n, -1) for n in track_names], dtype=np.int32
-        )
+        """Vectorized name→id (int32) via binary search over the sorted name
+        array (the per-row Python dict loop costs seconds at reference CSV
+        scale). Unknown names map to -1."""
+        names_arr = np.asarray(self.names, dtype=object)
+        queries = np.asarray(track_names, dtype=object)
+        pos = np.searchsorted(names_arr, queries)
+        pos = np.clip(pos, 0, len(names_arr) - 1)
+        ids = np.where(names_arr[pos] == queries, pos, -1)
+        return ids.astype(np.int32)
 
 
 def validate_and_map_artists(table: TrackTable) -> dict[str, str]:

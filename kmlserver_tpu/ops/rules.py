@@ -71,38 +71,18 @@ def emit_rule_tensors(pair_count_matrix: jax.Array, min_count: jax.Array, *, k_m
     return rule_ids, rule_counts, row_valid_counts
 
 
-@partial(jax.jit, static_argnames=("k_max",))
-def emit_confidence_rule_tensors(
-    pair_count_matrix: jax.Array,
-    min_count: jax.Array,
-    min_confidence: jax.Array,
-    *,
-    k_max: int,
-):
-    """True-confidence variant: rank row i's consequents by
-    conf(i→j) = count(i,j) / count(i), keeping frequent pairs whose
-    confidence clears ``min_confidence``. Returns the same
-    ``(rule_ids, rule_counts, row_valid_counts)`` triple — counts, so the
-    host can redo the division in float64."""
-    v = pair_count_matrix.shape[0]
-    item = jnp.diagonal(pair_count_matrix)
-    conf = pair_count_matrix.astype(jnp.float32) / jnp.maximum(item, 1)[:, None]
-    offdiag = ~jnp.eye(v, dtype=bool)
-    valid = offdiag & (pair_count_matrix >= min_count) & (conf >= min_confidence)
-    row_valid_counts = valid.sum(axis=1, dtype=jnp.int32)
-    score = jnp.where(valid, conf, -1.0)
-    k = min(k_max, v)
-    top_conf, top_ids = jax.lax.top_k(score, k)
-    keep = top_conf > 0
-    rule_ids = jnp.where(keep, top_ids, -1).astype(jnp.int32)
-    rule_counts = jnp.where(
-        keep, jnp.take_along_axis(pair_count_matrix, jnp.where(keep, top_ids, 0), axis=1), 0
-    )
-    if k < k_max:
-        pad = ((0, 0), (0, k_max - k))
-        rule_ids = jnp.pad(rule_ids, pad, constant_values=-1)
-        rule_counts = jnp.pad(rule_counts, pad)
-    return rule_ids, rule_counts, row_valid_counts
+def derive_confs(
+    rule_counts: np.ndarray,
+    item_counts: np.ndarray,
+    n_playlists: int,
+    mode: str,
+) -> np.ndarray:
+    """THE count→confidence arithmetic, shared by the miner and every npz
+    consumer (float64 division, then float32 for the serving tensors)."""
+    if mode == "support":
+        return (rule_counts.astype(np.float64) / n_playlists).astype(np.float32)
+    denom = np.maximum(item_counts, 1)[:, None].astype(np.float64)
+    return (rule_counts / denom).astype(np.float32)
 
 
 def expand_rules_dict(
@@ -182,25 +162,26 @@ def mine_rules_from_counts(
     if mode not in ("support", "confidence"):
         raise ValueError(f"confidence mode must be 'support' or 'confidence', got {mode!r}")
     min_count = min_count_for(min_support, n_playlists)
-    if mode == "support":
-        rule_ids, rule_counts, row_valid = emit_rule_tensors(
-            pair_count_matrix, jnp.int32(min_count), k_max=k_max
-        )
-    else:
-        rule_ids, rule_counts, row_valid = emit_confidence_rule_tensors(
-            pair_count_matrix, jnp.int32(min_count), jnp.float32(min_confidence),
-            k_max=k_max,
-        )
+    rule_ids, rule_counts, row_valid = emit_rule_tensors(
+        pair_count_matrix, jnp.int32(min_count), k_max=k_max
+    )
     rule_ids = np.asarray(rule_ids)
     rule_counts = np.asarray(rule_counts)
     row_valid = np.asarray(row_valid)
     item_counts = np.asarray(jnp.diagonal(pair_count_matrix))
     n_frequent = int((item_counts >= min_count).sum())
-    if mode == "support":
-        confs = (rule_counts.astype(np.float64) / n_playlists).astype(np.float32)
-    else:
-        denom = np.maximum(item_counts, 1)[:, None].astype(np.float64)
-        confs = (rule_counts / denom).astype(np.float32)
+    if mode == "confidence":
+        # confidence filter applied HOST-SIDE in float64, so device float32
+        # rounding can never flip a min_confidence decision (the same
+        # no-float-flip rule integer min_count enforces for support). Within
+        # a row, conf ordering == count ordering (fixed denominator), so the
+        # device top-k's ranking is already correct and the filter removes a
+        # suffix of each row.
+        conf64 = rule_counts / np.maximum(item_counts, 1)[:, None].astype(np.float64)
+        keep = (rule_ids >= 0) & (conf64 >= min_confidence)
+        rule_ids = np.where(keep, rule_ids, -1).astype(np.int32)
+        rule_counts = np.where(keep, rule_counts, 0)
+    confs = derive_confs(rule_counts, item_counts, n_playlists, mode)
     return RuleTensors(
         rule_ids=rule_ids,
         rule_counts=rule_counts,

@@ -1,0 +1,321 @@
+"""The REST serving app — the reference's FastAPI surface rebuilt on the
+stdlib (this image ships no web framework), same routes, same schemas:
+
+- ``POST /api/recommend/`` (reference: rest_api/app/main.py:176-187):
+  body ``{"songs": [...]}`` → ``{"songs": [...], "model_date": <token>,
+  "version": <VERSION>}``; empty song list → 400; malformed body → 422
+  (FastAPI's validation status).
+- ``GET /`` (reference: :190-203): HTML test client with a seed sample.
+- ``GET /test`` (reference: :150-153): 307 redirect to the docs.
+- ``GET /docs`` + ``GET /openapi.json``: interactive-docs equivalent with
+  the reference's three canned request examples (:158-174) — rendered
+  without external CDN assets (this environment is egress-free).
+- ``GET /healthz`` / ``GET /readyz``: liveness + fail-soft readiness — the
+  fix for the reference's documented crash-loop-on-empty-PVC (its report
+  risk #2; SURVEY.md §5): the pod comes up, readiness holds traffic until
+  the first artifacts land.
+- ``GET /metrics``: Prometheus text (absent in the reference; SURVEY.md §5).
+
+The app core is transport-independent (``handle()`` maps a request tuple to
+a response tuple) with a thin ``ThreadingHTTPServer`` adapter — testable
+in-process, multi-threaded under load, no framework dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..config import ServingConfig
+from .engine import RecommendEngine
+from .metrics import ServingMetrics
+
+logger = logging.getLogger("kmlserver_tpu.serving")
+
+_TEMPLATE_PATH = os.path.join(os.path.dirname(__file__), "templates", "client.html")
+
+# The reference documents three canned request examples in its OpenAPI
+# metadata (rest_api/app/main.py:158-174): typical seeds, uncommon seeds,
+# and seeds absent from the rules (exercising the static fallback).
+CANNED_EXAMPLES = {
+    "normal": {
+        "summary": "Typical seed songs",
+        "value": {"songs": ["Yesterday", "Bohemian Rhapsody"]},
+    },
+    "uncommon": {
+        "summary": "Uncommon seed songs (sparse rules)",
+        "value": {"songs": ["Some Deep Cut B-Side"]},
+    },
+    "absent": {
+        "summary": "Songs absent from the rules (static fallback)",
+        "value": {"songs": ["Definitely Not A Real Song 123"]},
+    },
+}
+
+Response = tuple[int, dict[str, str], bytes]
+
+
+def _json_response(status: int, obj) -> Response:
+    body = json.dumps(obj).encode("utf-8")
+    return status, {"Content-Type": "application/json"}, body
+
+
+def _html_response(status: int, html: str) -> Response:
+    return status, {"Content-Type": "text/html; charset=utf-8"}, html.encode("utf-8")
+
+
+class RecommendApp:
+    """Transport-independent app core."""
+
+    def __init__(self, cfg: ServingConfig, engine: RecommendEngine | None = None):
+        self.cfg = cfg
+        self.engine = engine or RecommendEngine(cfg)
+        self.metrics = ServingMetrics()
+        with open(_TEMPLATE_PATH, "r", encoding="utf-8") as fh:
+            self._template = fh.read()
+
+    # ---------- routing ----------
+
+    def handle(self, method: str, path: str, body: bytes | None) -> Response:
+        path = path.split("?", 1)[0]
+        if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
+            return self._post_recommend(body)
+        if method == "GET":
+            if path == "/":
+                return self._get_client()
+            if path == "/test":
+                # reference: /test deep-links into the interactive docs
+                return 307, {"Location": "/docs#post-api-recommend"}, b""
+            if path == "/docs":
+                return self._get_docs()
+            if path == "/openapi.json":
+                return _json_response(200, self._openapi())
+            if path == "/healthz":
+                return _json_response(200, {"status": "alive"})
+            if path == "/readyz":
+                if self.engine.finished_loading:
+                    return _json_response(200, {"status": "ready"})
+                return _json_response(
+                    503, {"status": "awaiting first artifacts"}
+                )
+            if path == "/metrics":
+                text = self.metrics.render(
+                    self.engine.reload_counter, self.engine.finished_loading
+                )
+                return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
+        return _json_response(404, {"detail": "Not Found"})
+
+    # ---------- endpoints ----------
+
+    def _post_recommend(self, body: bytes | None) -> Response:
+        t0 = time.perf_counter()
+        try:
+            payload = json.loads(body or b"")
+        except json.JSONDecodeError:
+            return _json_response(
+                422, {"detail": [{"msg": "request body is not valid JSON"}]}
+            )
+        songs = payload.get("songs") if isinstance(payload, dict) else None
+        if not isinstance(songs, list) or not all(isinstance(s, str) for s in songs):
+            return _json_response(
+                422,
+                {"detail": [{"loc": ["body", "songs"],
+                             "msg": "field 'songs' must be a list of strings"}]},
+            )
+        if not songs:
+            # reference: empty request → 400 (rest_api/app/main.py:178-179)
+            return _json_response(400, {"detail": "Request with no songs"})
+        try:
+            recs, source = self.engine.recommend(songs)
+        except Exception:
+            logger.exception("recommendation failed")
+            self.metrics.record_error()
+            return _json_response(500, {"detail": "Internal Server Error"})
+        self.metrics.record(source, time.perf_counter() - t0)
+        return _json_response(
+            200,
+            {
+                "songs": recs,
+                "model_date": self.engine.cache_value,
+                "version": self.cfg.version,
+            },
+        )
+
+    def _get_client(self) -> Response:
+        """Render the HTML test client with a sampled seed + static sample
+        (reference: rest_api/app/main.py:190-203 — which sleeps 2 s when data
+        isn't loaded yet; here the page renders immediately with a notice)."""
+        best = self.engine.best_tracks
+        if not best:
+            page = (
+                self._template
+                .replace("{{version}}", self.cfg.version)
+                .replace("{{model_date}}", str(self.engine.cache_value))
+                .replace("{{track_checkboxes}}",
+                         "<p><em>Model artifacts not loaded yet — retry shortly.</em></p>")
+                .replace("{{sample_seed}}", "—")
+                .replace("{{sample_recommendations}}", "")
+            )
+            return _html_response(200, page)
+        names = [b["track_name"] for b in best]
+        sample_pool = random.sample(names, min(12, len(names)))
+        seed = random.choice(names)
+        sample = self.engine.static_recommendation([seed])
+        checkboxes = "\n".join(
+            f'<label><input type="checkbox" value="{_esc(n)}"> {_esc(n)}</label>'
+            for n in sample_pool
+        )
+        sample_html = "\n".join(f"<li>{_esc(s)}</li>" for s in sample)
+        page = (
+            self._template
+            .replace("{{version}}", self.cfg.version)
+            .replace("{{model_date}}", str(self.engine.cache_value))
+            .replace("{{track_checkboxes}}", checkboxes)
+            .replace("{{sample_seed}}", _esc(seed))
+            .replace("{{sample_recommendations}}", sample_html)
+        )
+        return _html_response(200, page)
+
+    def _get_docs(self) -> Response:
+        examples = "\n".join(
+            f"<h3>{_esc(ex['summary'])}</h3>"
+            f"<pre>POST /api/recommend/\n{json.dumps(ex['value'], indent=2)}</pre>"
+            for ex in CANNED_EXAMPLES.values()
+        )
+        html = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>API docs — Playlist Recommender</title>
+<style>body{{font-family:system-ui;max-width:760px;margin:2rem auto;padding:0 1rem}}
+pre{{background:#8881;padding:.8rem;border-radius:6px;overflow-x:auto}}</style></head>
+<body><h1>Playlist Recommender API {_esc(self.cfg.version)}</h1>
+<p>Machine-readable spec: <a href="/openapi.json">/openapi.json</a></p>
+<h2 id="post-api-recommend">POST /api/recommend/</h2>
+<p>Request: <code>{{"songs": ["...", ...]}}</code> — at least one song
+(empty → 400). Response: <code>{{"songs": [...], "model_date": "...",
+"version": "..."}}</code>. Seeds found in the mined rules yield rule-based
+recommendations; fully unknown seed sets fall back to a deterministic
+popular-tracks sample.</p>
+{examples}
+<h2>Other endpoints</h2>
+<ul>
+<li><code>GET /</code> — HTML test client</li>
+<li><code>GET /test</code> — redirect here</li>
+<li><code>GET /healthz</code>, <code>GET /readyz</code> — probes</li>
+<li><code>GET /metrics</code> — Prometheus text metrics</li>
+</ul></body></html>"""
+        return _html_response(200, html)
+
+    def _openapi(self) -> dict:
+        return {
+            "openapi": "3.1.0",
+            "info": {
+                "title": "Playlist Recommender (TPU rebuild)",
+                "version": self.cfg.version,
+            },
+            "paths": {
+                "/api/recommend/": {
+                    "post": {
+                        "summary": "Recommend songs from seed songs",
+                        "requestBody": {
+                            "required": True,
+                            "content": {
+                                "application/json": {
+                                    "schema": {
+                                        "type": "object",
+                                        "required": ["songs"],
+                                        "properties": {
+                                            "songs": {
+                                                "type": "array",
+                                                "items": {"type": "string"},
+                                                "minItems": 1,
+                                            }
+                                        },
+                                    },
+                                    "examples": CANNED_EXAMPLES,
+                                }
+                            },
+                        },
+                        "responses": {
+                            "200": {
+                                "description": "Recommendations",
+                                "content": {
+                                    "application/json": {
+                                        "schema": {
+                                            "type": "object",
+                                            "properties": {
+                                                "songs": {
+                                                    "type": "array",
+                                                    "items": {"type": "string"},
+                                                },
+                                                "model_date": {"type": "string"},
+                                                "version": {"type": "string"},
+                                            },
+                                        }
+                                    }
+                                },
+                            },
+                            "400": {"description": "Empty song list"},
+                            "422": {"description": "Malformed body"},
+                        },
+                    }
+                }
+            },
+        }
+
+
+def _esc(s: str) -> str:
+    return (
+        str(s).replace("&", "&amp;").replace("<", "&lt;")
+        .replace(">", "&gt;").replace('"', "&quot;")
+    )
+
+
+# ---------- stdlib HTTP adapter ----------
+
+
+def make_handler(app: RecommendApp):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _dispatch(self, method: str) -> None:
+            body = None
+            if method == "POST":
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+            try:
+                status, headers, payload = app.handle(method, self.path, body)
+            except Exception:
+                logger.exception("unhandled error for %s %s", method, self.path)
+                app.metrics.record_error()
+                status, headers, payload = 500, {"Content-Type": "application/json"}, (
+                    b'{"detail": "Internal Server Error"}'
+                )
+            self.send_response(status)
+            for key, value in headers.items():
+                self.send_header(key, value)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            self._dispatch("GET")
+
+        def do_POST(self) -> None:  # noqa: N802
+            self._dispatch("POST")
+
+        def log_message(self, fmt: str, *args) -> None:
+            logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+def serve(app: RecommendApp, port: int | None = None) -> ThreadingHTTPServer:
+    """Bind + return the server (caller runs ``serve_forever``); port 0 picks
+    an ephemeral port (used by tests and local dev)."""
+    server = ThreadingHTTPServer(
+        ("0.0.0.0", port if port is not None else app.cfg.port), make_handler(app)
+    )
+    return server

@@ -1,0 +1,80 @@
+"""Serving observability — the counters the reference lacks.
+
+The reference's only observability is log lines and one in-memory
+``reload_counter`` (rest_api/app/main.py:18-29,120,143; SURVEY.md §5 calls
+out the absence of a metrics endpoint). This adds latency/QPS counters with a
+bounded reservoir so the p50-at-QPS target is measurable, exposed in
+Prometheus text format at ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class LatencyReservoir:
+    """Fixed-size ring of recent latencies; cheap percentile reads."""
+
+    def __init__(self, size: int = 4096):
+        self._buf = [0.0] * size
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = seconds
+            self._n += 1
+
+    def percentiles(self, *qs: float) -> list[float]:
+        with self._lock:
+            live = sorted(self._buf[: min(self._n, len(self._buf))])
+        if not live:
+            return [0.0 for _ in qs]
+        return [live[min(int(q * len(live)), len(live) - 1)] for q in qs]
+
+
+class ServingMetrics:
+    def __init__(self):
+        self.started_at = time.time()
+        self.requests_total = 0
+        self.requests_by_source = {"rules": 0, "fallback": 0, "empty": 0}
+        self.errors_total = 0
+        self.latency = LatencyReservoir()
+        self._lock = threading.Lock()
+
+    def record(self, source: str, seconds: float) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self.requests_by_source[source] = self.requests_by_source.get(source, 0) + 1
+        self.latency.observe(seconds)
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors_total += 1
+
+    def render(self, reload_counter: int, finished_loading: bool) -> str:
+        p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)
+        uptime = time.time() - self.started_at
+        lines = [
+            "# TYPE kmls_requests_total counter",
+            f"kmls_requests_total {self.requests_total}",
+            "# TYPE kmls_request_errors_total counter",
+            f"kmls_request_errors_total {self.errors_total}",
+            "# TYPE kmls_requests_by_source counter",
+        ]
+        for source, count in sorted(self.requests_by_source.items()):
+            lines.append(f'kmls_requests_by_source{{source="{source}"}} {count}')
+        lines += [
+            "# TYPE kmls_request_latency_seconds summary",
+            f'kmls_request_latency_seconds{{quantile="0.5"}} {p50:.6f}',
+            f'kmls_request_latency_seconds{{quantile="0.95"}} {p95:.6f}',
+            f'kmls_request_latency_seconds{{quantile="0.99"}} {p99:.6f}',
+            "# TYPE kmls_reloads_total counter",
+            f"kmls_reloads_total {reload_counter}",
+            "# TYPE kmls_finished_loading gauge",
+            f"kmls_finished_loading {int(finished_loading)}",
+            "# TYPE kmls_uptime_seconds gauge",
+            f"kmls_uptime_seconds {uptime:.1f}",
+        ]
+        return "\n".join(lines) + "\n"

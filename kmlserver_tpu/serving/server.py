@@ -1,0 +1,44 @@
+"""Container entrypoint for the online API.
+
+Run as ``python -m kmlserver_tpu.serving.server`` — the rebuild's equivalent
+of the reference API image's ``CMD fastapi run app/main.py --port 80``
+(reference: rest_api/Dockerfile:28). Env-var configured
+(kubernetes/deployment.yaml contract); logs to stdout with the same
+timestamped format intent as the reference's logging setup
+(rest_api/app/main.py:18-29).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..config import ServingConfig
+from .app import RecommendApp, serve
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.DEBUG,
+        stream=sys.stdout,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    cfg = ServingConfig.from_env()
+    app = RecommendApp(cfg)
+    app.engine.start_polling()
+    server = serve(app)
+    host, port = server.server_address[:2]
+    logging.getLogger("kmlserver_tpu.serving").info(
+        "serving on %s:%d (version %s)", host, port, cfg.version
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Deployment-layer sanity: manifests parse, reference env-var contract is
+bound, the PVC/volume wiring matches, and probes point at real endpoints."""
+
+import glob
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    with open(os.path.join(REPO, "kubernetes", name)) as fh:
+        return yaml.safe_load(fh)
+
+
+def test_all_manifests_parse():
+    paths = glob.glob(os.path.join(REPO, "kubernetes", "*.yaml"))
+    assert len(paths) == 4
+    for p in paths + [os.path.join(REPO, "argocd_manifest.yaml")]:
+        with open(p) as fh:
+            assert yaml.safe_load(fh) is not None, p
+
+
+def _env_names(container):
+    return {e["name"] for e in container["env"]}
+
+
+def test_job_env_contract_and_volume():
+    job = _load("job.yaml")
+    spec = job["spec"]["template"]["spec"]
+    container = spec["containers"][0]
+    # the reference job's env names (kubernetes/job.yaml:24-40) must all bind
+    assert {
+        "BASE_DIR", "DATASETS_DIR", "REGEX_FILENAME", "MIN_SUPPORT",
+        "RECOMMENDATIONS_FILE", "BEST_TRACKS_FILE", "DATA_INVALIDATION_FILE",
+        "TOP_TRACKS_SAVE_PERCENTILE",
+    } <= _env_names(container)
+    assert job["spec"]["ttlSecondsAfterFinished"] == 1200  # pseudo-cron TTL
+    assert "Force=true" in job["metadata"]["annotations"][
+        "argocd.argoproj.io/sync-options"]
+    claims = [v["persistentVolumeClaim"]["claimName"] for v in spec["volumes"]]
+    assert claims == ["fast-api-claim"]
+    assert container["resources"]["requests"]["google.com/tpu"]
+
+
+def test_deployment_env_contract_probes_and_tpu():
+    dep = _load("deployment.yaml")
+    spec = dep["spec"]["template"]["spec"]
+    container = spec["containers"][0]
+    assert {
+        "VERSION", "BASE_DIR", "PICKLE_DIR", "RECOMMENDATIONS_FILE",
+        "BEST_TRACKS_FILE", "DATA_INVALIDATION_FILE", "K_BEST_TRACKS",
+        "POLLING_WAIT_IN_MINUTES", "ARGO_CD_SYNC_BUSTER",
+    } <= _env_names(container)
+    assert dep["spec"]["replicas"] == 3
+    # the crash-loop fix: readiness gates on /readyz
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["resources"]["requests"]["google.com/tpu"]
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "fast-api-claim"
+
+
+def test_service_nodeport():
+    svc = _load("service.yaml")
+    port = svc["spec"]["ports"][0]
+    assert svc["spec"]["type"] == "NodePort"
+    assert (port["port"], port["targetPort"], port["nodePort"]) == (80, 80, 31000)
+    assert svc["spec"]["selector"] == {"app": "fast-api"}
+
+
+def test_pvc_rwx():
+    pvc = _load("pvc.yaml")
+    assert pvc["metadata"]["name"] == "fast-api-claim"
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+
+
+def test_argocd_automated_sync():
+    with open(os.path.join(REPO, "argocd_manifest.yaml")) as fh:
+        app = yaml.safe_load(fh)
+    sync = app["spec"]["syncPolicy"]["automated"]
+    assert sync["prune"] is True and sync["selfHeal"] is True
+    assert app["spec"]["source"]["path"].rstrip("/") == "kubernetes"

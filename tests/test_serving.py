@@ -1,0 +1,278 @@
+"""Serving-layer tests: engine semantics vs the oracle, artifact hot reload,
+and the HTTP surface (routing unit tests + a real socket round-trip),
+exercising the real mining-job → PVC → API handoff."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.io import artifacts, registry
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp, serve
+from kmlserver_tpu.serving.engine import RecommendEngine, stable_seed
+
+from .oracle import random_baskets, reference_recommend
+from .test_pipeline import table_with_metadata
+
+
+@pytest.fixture
+def mined_pvc(tmp_path, rng):
+    """A PVC populated by one real mining run; returns (serving_cfg, baskets)."""
+    from kmlserver_tpu.data.csv import write_tracks_csv
+
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    baskets = random_baskets(rng, n_playlists=60, n_tracks=18, mean_len=5)
+    write_tracks_csv(str(ds_dir / "2023_spotify_ds1.csv"), table_with_metadata(baskets))
+    mining_cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.08,
+        k_max_consequents=32, top_tracks_save_percentile=0.5,
+    )
+    run_mining_job(mining_cfg)
+    serving_cfg = ServingConfig(
+        base_dir=str(tmp_path), pickle_dir="pickles/", k_best_tracks=5,
+        polling_wait_in_minutes=0.001,
+    )
+    return serving_cfg, baskets, mining_cfg
+
+
+class TestEngine:
+    def test_load_and_recommend_matches_reference(self, mined_pvc):
+        cfg, baskets, mining_cfg = mined_pvc
+        engine = RecommendEngine(cfg)
+        assert engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        # seeds with known rules
+        seeds_with_rules = [s for s, row in rules_dict.items() if row][:3]
+        got, source = engine.recommend(seeds_with_rules)
+        assert source == "rules"
+        expected = reference_recommend(rules_dict, seeds_with_rules, cfg.k_best_tracks)
+        merged = dict(reference_recommend(rules_dict, seeds_with_rules, 10**6))
+        for name in got:
+            assert name in merged
+        assert len(got) == len(expected)
+
+    def test_known_but_empty_returns_empty_not_fallback(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        empties = [s for s, row in rules_dict.items() if not row]
+        if not empties:
+            pytest.skip("no frequent-singleton-only songs in this draw")
+        got, source = engine.recommend(empties[:1])
+        # reference: seed IS a dict key → merge of empty rows → [] (no fallback)
+        assert got == [] and source == "empty"
+
+    def test_unknown_seeds_fall_back_deterministically(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        a, src_a = engine.recommend(["definitely-unknown-1", "unknown-2"])
+        b, src_b = engine.recommend(["unknown-2", "definitely-unknown-1"])
+        assert src_a == src_b == "fallback"
+        assert a == b  # stable across seed ORDER (sorted inside the hash)
+        # and across engine instances (process-stable hash, unlike builtin hash())
+        engine2 = RecommendEngine(cfg)
+        engine2.load()
+        c, _ = engine2.recommend(["definitely-unknown-1", "unknown-2"])
+        assert c == a
+
+    def test_fail_soft_on_empty_pvc(self, tmp_path):
+        cfg = ServingConfig(base_dir=str(tmp_path))
+        engine = RecommendEngine(cfg)
+        assert engine.load() is False  # no exception — the crash-loop fix
+        assert engine.finished_loading is False
+        got, source = engine.recommend(["anything"])
+        assert got == [] and source == "fallback"
+
+    def test_hot_reload_on_token_change(self, mined_pvc):
+        cfg, _, mining_cfg = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        first_token = engine.cache_value
+        assert engine.is_data_stale() is False
+        # a second mining run rewrites artifacts + token
+        run_mining_job(mining_cfg)
+        assert engine.is_data_stale() is True
+        engine.reload_if_required()
+        assert engine.reload_counter == 2
+        assert engine.cache_value != first_token
+        assert engine.bundle.model_token == engine.cache_value
+
+    def test_legacy_pickle_only_load(self, mined_pvc):
+        """A PVC written by the REFERENCE job has no npz — pickle path must
+        serve identically."""
+        import os
+
+        cfg, _, _ = mined_pvc
+        npz = artifacts.tensor_artifact_path(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        seeds = [s for s, row in rules_dict.items() if row][:2]
+        engine_npz = RecommendEngine(cfg)
+        engine_npz.load()
+        got_npz, _ = engine_npz.recommend(seeds)
+        os.remove(npz)
+        engine_pickle = RecommendEngine(cfg)
+        engine_pickle.load()
+        got_pickle, _ = engine_pickle.recommend(seeds)
+        assert set(got_npz) == set(got_pickle)
+
+    def test_recommend_many_matches_single(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        seed_sets = [[s] for s, row in rules_dict.items() if row][:4]
+        batched = engine.recommend_many(seed_sets)
+        for seeds, got in zip(seed_sets, batched):
+            single, _ = engine.recommend(seeds)
+            assert set(got) == set(single)
+
+    def test_stable_seed_order_independent(self):
+        assert stable_seed(["b", "a"]) == stable_seed(["a", "b"])
+        assert stable_seed(["a"]) != stable_seed(["b"])
+
+
+class TestAppRouting:
+    @pytest.fixture
+    def app(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        app.engine.load()
+        return app
+
+    def _post(self, app, body) -> tuple[int, dict]:
+        status, _, payload = app.handle(
+            "POST", "/api/recommend/",
+            body if isinstance(body, bytes) else json.dumps(body).encode(),
+        )
+        return status, json.loads(payload)
+
+    def test_recommend_roundtrip(self, app):
+        rules_dict = artifacts.load_pickle(
+            f"{app.cfg.base_dir}/pickles/{app.cfg.recommendations_file}"
+        )
+        seeds = [s for s, row in rules_dict.items() if row][:2]
+        status, data = self._post(app, {"songs": seeds})
+        assert status == 200
+        assert set(data) == {"songs", "model_date", "version"}
+        assert data["version"] == app.cfg.version
+        assert data["model_date"] == app.engine.cache_value
+        assert data["songs"]
+
+    def test_empty_songs_400(self, app):
+        status, data = self._post(app, {"songs": []})
+        assert status == 400 and "detail" in data
+
+    def test_malformed_422(self, app):
+        assert self._post(app, b"{not json")[0] == 422
+        assert self._post(app, {"songs": "not-a-list"})[0] == 422
+        assert self._post(app, {"songs": [1, 2]})[0] == 422
+        assert self._post(app, {"other": True})[0] == 422
+
+    def test_no_trailing_slash_accepted(self, app):
+        status, _, _ = app.handle("POST", "/api/recommend", b'{"songs": ["x"]}')
+        assert status == 200
+
+    def test_client_page(self, app):
+        status, headers, payload = app.handle("GET", "/", None)
+        html = payload.decode()
+        assert status == 200 and "checkbox" in html
+        assert app.cfg.version in html
+
+    def test_docs_and_openapi(self, app):
+        assert app.handle("GET", "/docs", None)[0] == 200
+        status, _, payload = app.handle("GET", "/openapi.json", None)
+        spec = json.loads(payload)
+        assert status == 200
+        assert "/api/recommend/" in spec["paths"]
+        examples = spec["paths"]["/api/recommend/"]["post"]["requestBody"][
+            "content"]["application/json"]["examples"]
+        assert len(examples) == 3  # the reference's three canned examples
+
+    def test_test_redirects_to_docs(self, app):
+        status, headers, _ = app.handle("GET", "/test", None)
+        assert status == 307 and headers["Location"].startswith("/docs")
+
+    def test_readyz_gates_until_loaded(self, tmp_path):
+        app = RecommendApp(ServingConfig(base_dir=str(tmp_path)))
+        assert app.handle("GET", "/readyz", None)[0] == 503
+        assert app.handle("GET", "/healthz", None)[0] == 200
+
+    def test_metrics(self, app):
+        self._post(app, {"songs": ["whatever"]})
+        status, _, payload = app.handle("GET", "/metrics", None)
+        text = payload.decode()
+        assert status == 200
+        assert "kmls_requests_total 1" in text
+        assert "kmls_reloads_total 1" in text
+
+    def test_unknown_route_404(self, app):
+        assert app.handle("GET", "/nope", None)[0] == 404
+
+
+class TestHTTPServer:
+    def test_real_socket_roundtrip(self, mined_pvc):
+        cfg, _, mining_cfg = mined_pvc
+        app = RecommendApp(cfg)
+        app.engine.start_polling()
+        server = serve(app, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.time() + 10
+            while not app.engine.finished_loading and time.time() < deadline:
+                time.sleep(0.05)
+            assert app.engine.finished_loading
+
+            rules_dict = artifacts.load_pickle(
+                f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+            )
+            seeds = [s for s, row in rules_dict.items() if row][:2]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/recommend/",
+                data=json.dumps({"songs": seeds}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                data = json.loads(resp.read())
+            assert data["songs"]
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert b"checkbox" in resp.read()
+
+            # hot reload through the real polling thread: new mining run
+            old_token = data["model_date"]
+            run_mining_job(mining_cfg)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    new_token = json.loads(resp.read())["model_date"]
+                if new_token != old_token:
+                    break
+                time.sleep(0.1)
+            assert new_token != old_token
+        finally:
+            server.shutdown()
+            server.server_close()
