@@ -65,14 +65,14 @@ def main() -> int:
 
     # warm-up: compile every kernel in the bracket
     result = mine(baskets, cfg)
-    result.tensors.to_rules_dict(baskets.vocab.names)
+    result.tensors.to_rules_dict(result.vocab_names)
     log(f"warm-up mine: {result.duration_s:.3f}s (includes compile)")
 
     times = []
     for i in range(REPEATS):
         t0 = time.perf_counter()
         result = mine(baskets, cfg)
-        rules_dict = result.tensors.to_rules_dict(baskets.vocab.names)
+        rules_dict = result.tensors.to_rules_dict(result.vocab_names)
         times.append(time.perf_counter() - t0)
         log(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)")
     median_s = statistics.median(times)

@@ -85,6 +85,10 @@ class MiningConfig:
     # Use the bit-packed popcount path instead of int8 matmul when the
     # one-hot matrix would exceed this many elements.
     bitpack_threshold_elems: int = 1 << 28
+    # Above this vocabulary size, prune infrequent items (exact, by the
+    # Apriori property) before pair counting — the path that makes the
+    # 1M-track configs feasible (a dense 1M x 1M count matrix is 4 TB).
+    prune_vocab_threshold: int = 4096
     # Write the tensor-native artifact (rules npz) alongside the pickles.
     write_tensor_artifact: bool = True
 
@@ -119,6 +123,7 @@ class MiningConfig:
             min_confidence=_getenv_float("KMLS_MIN_CONFIDENCE", 0.04),
             mesh_shape=os.getenv("KMLS_MESH_SHAPE", "auto"),
             bitpack_threshold_elems=_getenv_int("KMLS_BITPACK_THRESHOLD_ELEMS", 1 << 28),
+            prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 4096),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
         )
 

@@ -14,9 +14,12 @@ Config wiring:
   census (per-length counts, exact via pair extension) — the reference's
   log surface reports itemset statistics; ≥ 4 is not yet enumerated and is
   reported as such rather than silently ignored.
-- ``cfg.bitpack_threshold_elems``: above this one-hot size the bit-packed
-  popcount path (Pallas) will take over; until that kernel lands the driver
-  WARNS and uses the dense path rather than silently pretending.
+- ``cfg.bitpack_threshold_elems``: above this one-hot element count the
+  bit-packed Pallas popcount path (ops/popcount.py) replaces the dense int8
+  matmul — 32× denser in HBM, exact.
+- ``cfg.prune_vocab_threshold``: above this vocabulary size, infrequent
+  items are pruned before pair counting (exact by the Apriori property) —
+  the step that makes 1M-track vocabularies feasible.
 
 Timing: the reference brackets rule generation with wall-clock timestamps and
 prints the elapsed time (machine-learning/main.py:264,306-308); ``mine`` does
@@ -35,33 +38,48 @@ import numpy as np
 
 from ..config import MiningConfig
 from ..ops import encode, rules, support
-from .vocab import Baskets
+from .vocab import Baskets, Vocab
 
 
 @dataclasses.dataclass
 class MiningResult:
     tensors: rules.RuleTensors
+    # names for the tensor rows — the (possibly Apriori-pruned) vocabulary
+    vocab_names: list[str]
     n_playlists: int
-    n_tracks: int
+    n_tracks: int  # full dataset unique-track count (pre-pruning)
     duration_s: float
+    pruned_vocab: int | None = None  # size after pruning, when it ran
     itemset_census: dict[int, int] | None = None  # length → frequent-itemset count
 
 
 def pair_count_fn(
-    baskets: Baskets, mesh: "jax.sharding.Mesh | None" = None
+    baskets: Baskets,
+    mesh: "jax.sharding.Mesh | None" = None,
+    bitpack_threshold_elems: int | None = None,
 ) -> tuple[jax.Array, jax.Array | None]:
-    """One-hot encode + pair-support count, single device or sharded.
+    """One-hot encode + pair-support count: sharded, bit-packed, or dense.
 
     Returns ``(counts, x_onehot_or_None)`` — the one-hot matrix is handed
-    back on the single-device path so downstream steps (itemset census)
-    reuse it instead of re-encoding; on the sharded path the full matrix
-    deliberately never exists on one device (that's the point of sharding),
-    so ``None`` is returned.
+    back on the dense single-device path so downstream steps (itemset
+    census) reuse it instead of re-encoding; on the sharded and bit-packed
+    paths the full int8 matrix deliberately never exists (that's their
+    point), so ``None`` is returned.
     """
     if mesh is not None:
         from ..parallel.support import sharded_pair_counts
 
         return sharded_pair_counts(baskets, mesh), None
+    elems = baskets.n_playlists * baskets.n_tracks
+    if bitpack_threshold_elems is not None and elems > bitpack_threshold_elems:
+        # 32x denser operand: Pallas popcount over playlist bitsets
+        from ..ops.popcount import popcount_pair_counts
+
+        counts = popcount_pair_counts(
+            baskets.playlist_rows, baskets.track_ids,
+            n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+        )
+        return counts, None
     x = encode.onehot_matrix(
         jnp.asarray(baskets.playlist_rows),
         jnp.asarray(baskets.track_ids),
@@ -111,29 +129,54 @@ def _itemset_census(
     return census
 
 
+def prune_infrequent(baskets: Baskets, min_count: int) -> tuple[Baskets, np.ndarray]:
+    """Apriori pre-filter: drop items whose SINGLETON support is below
+    min_count before pair counting. Exact — an infrequent item cannot occur
+    in any frequent itemset — and the step that collapses a 1M-track
+    vocabulary (dense pair matrix: 4 TB) to the few thousand frequent items
+    that can actually form rules. Host cost is one bincount + remap over the
+    membership rows. Returns (reduced baskets, kept original ids)."""
+    item_counts = np.bincount(baskets.track_ids, minlength=baskets.n_tracks)
+    keep_ids = np.flatnonzero(item_counts >= min_count)
+    remap = np.full(baskets.n_tracks, -1, dtype=np.int32)
+    remap[keep_ids] = np.arange(len(keep_ids), dtype=np.int32)
+    selected = remap[baskets.track_ids] >= 0
+    names = [baskets.vocab.names[i] for i in keep_ids]
+    reduced = Baskets(
+        playlist_rows=baskets.playlist_rows[selected],
+        track_ids=remap[baskets.track_ids[selected]],
+        n_playlists=baskets.n_playlists,  # denominator stays ALL playlists
+        vocab=Vocab(names=names, index={n: i for i, n in enumerate(names)}),
+    )
+    return reduced, keep_ids
+
+
 def mine(
     baskets: Baskets,
     cfg: MiningConfig,
     mesh: "jax.sharding.Mesh | None" = None,
 ) -> MiningResult:
     """Run the full mining compute, timed like the reference's rule step."""
-    onehot_elems = baskets.n_playlists * baskets.n_tracks
-    if mesh is None and onehot_elems > cfg.bitpack_threshold_elems:
-        print(
-            f"WARNING: one-hot matrix has {onehot_elems:.2e} elements "
-            f"(> KMLS_BITPACK_THRESHOLD_ELEMS={cfg.bitpack_threshold_elems:.2e}); "
-            f"the bit-packed popcount path is not yet wired — using dense int8"
-        )
     t0 = time.perf_counter()
-    counts, x = pair_count_fn(baskets, mesh)
+    n_total = baskets.n_tracks
+    pruned_vocab = None
+    mined_baskets = baskets
+    if baskets.n_tracks > cfg.prune_vocab_threshold:
+        min_count = support.min_count_for(cfg.min_support, baskets.n_playlists)
+        mined_baskets, _ = prune_infrequent(baskets, min_count)
+        pruned_vocab = mined_baskets.n_tracks
+    counts, x = pair_count_fn(
+        mined_baskets, mesh, bitpack_threshold_elems=cfg.bitpack_threshold_elems
+    )
     jax.block_until_ready(counts)
     tensors = rules.mine_rules_from_counts(
         counts,
-        n_playlists=baskets.n_playlists,
+        n_playlists=mined_baskets.n_playlists,
         min_support=cfg.min_support,
         k_max=cfg.k_max_consequents,
         mode=cfg.confidence_mode,
         min_confidence=cfg.min_confidence,
+        n_total_songs=n_total,
     )
     duration = time.perf_counter() - t0
     census = None
@@ -141,8 +184,10 @@ def mine(
         census = _itemset_census(x, counts, tensors.min_count, cfg.max_itemset_len)
     return MiningResult(
         tensors=tensors,
-        n_playlists=baskets.n_playlists,
-        n_tracks=baskets.n_tracks,
+        vocab_names=list(mined_baskets.vocab.names),
+        n_playlists=mined_baskets.n_playlists,
+        n_tracks=n_total,
         duration_s=duration,
+        pruned_vocab=pruned_vocab,
         itemset_census=census,
     )

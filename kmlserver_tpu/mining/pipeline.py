@@ -82,6 +82,11 @@ def run_mining_job(
     baskets = vocab_mod.build_baskets(table)
     result: MiningResult = mine(baskets, cfg, mesh=mesh)
     tensors = result.tensors
+    if result.pruned_vocab is not None:
+        print(
+            f"Apriori pruning: {result.n_tracks} -> {result.pruned_vocab} "
+            f"candidate tracks before pair counting"
+        )
     print(f"Songs without recommendations: {tensors.n_songs_missing}")
     print(f"Time elapsed in rule generation: {result.duration_s:.2f}s")
     if result.itemset_census is not None:
@@ -97,14 +102,14 @@ def run_mining_job(
             f"to the highest-support rules)"
         )
 
-    rules_dict = tensors.to_rules_dict(baskets.vocab.names)
+    rules_dict = tensors.to_rules_dict(result.vocab_names)
     paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
     artifacts.save_pickle(rules_dict, paths["recommendations"])
     if cfg.write_tensor_artifact:
         paths["rule_tensors"] = artifacts.tensor_artifact_path(paths["recommendations"])
         artifacts.save_rule_tensors(
             paths["rule_tensors"],
-            vocab=baskets.vocab.names,
+            vocab=result.vocab_names,
             rule_ids=tensors.rule_ids,
             rule_counts=tensors.rule_counts,
             item_counts=tensors.item_counts,

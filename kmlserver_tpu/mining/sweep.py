@@ -1,0 +1,125 @@
+"""Support-sweep experiment harness — the reference's disabled
+``experiment_supports`` loop resurrected as a first-class benchmark driver
+(reference: machine-learning/main.py:450-473; its output chart — coverage vs
+min_support vs runtime — appears in the project report p.5).
+
+Reference behavior: loop min_support over ``arange(0.03, 0.2, 0.0025)``,
+re-run rule generation per support, record (missing songs, duration) to
+``fp_growth_experiment_results.csv``.
+
+TPU-first improvement: the pair-count matrix does not depend on min_support,
+so it's computed ONCE and only the (cheap, device-side) threshold + top-k
+emission re-runs per support point — turning the reference's
+full-re-mine-per-point sweep into one matmul plus N emissions. Both phases
+are timed separately and recorded honestly.
+
+Run: ``python -m kmlserver_tpu.mining.sweep`` (env: BASE_DIR/DATASETS_DIR
+as the job, plus KMLS_SWEEP_START/STOP/STEP).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+from ..config import BASE_INDEX, MiningConfig
+from ..data.csv import read_tracks
+from ..io import registry
+from ..io.artifacts import atomic_write_text
+from ..ops import rules as rules_mod
+from .miner import pair_count_fn, prune_infrequent
+from .vocab import build_baskets
+
+RESULTS_FILE = "fp_growth_experiment_results.csv"
+
+
+def run_sweep(
+    cfg: MiningConfig,
+    supports: np.ndarray,
+    dataset: str | None = None,
+) -> list[dict]:
+    """→ one record per support point:
+    ``{min_support, missing_songs, frequent_items, duration_s}``."""
+    if dataset is None:
+        datasets = registry.get_dataset_list(cfg)
+        index = registry.get_next_run_index(cfg, datasets)
+        dataset = datasets[index - BASE_INDEX]
+    table = read_tracks(dataset, cfg.sample_ratio)
+    baskets = build_baskets(table)
+    n_total = baskets.n_tracks
+
+    t0 = time.perf_counter()
+    # pruning must use the SMALLEST support in the sweep to stay exact for
+    # every point
+    mined_baskets = baskets
+    if baskets.n_tracks > cfg.prune_vocab_threshold:
+        from ..ops.support import min_count_for
+
+        mined_baskets, _ = prune_infrequent(
+            baskets, min_count_for(float(supports.min()), baskets.n_playlists)
+        )
+    counts, _ = pair_count_fn(
+        mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems
+    )
+    jax.block_until_ready(counts)
+    count_s = time.perf_counter() - t0
+    print(f"pair counts once: {count_s:.3f}s (shared across the sweep)")
+
+    records = []
+    for s in supports:
+        t0 = time.perf_counter()
+        tensors = rules_mod.mine_rules_from_counts(
+            counts,
+            n_playlists=mined_baskets.n_playlists,
+            min_support=float(s),
+            k_max=cfg.k_max_consequents,
+            n_total_songs=n_total,
+        )
+        duration = time.perf_counter() - t0
+        records.append(
+            {
+                # full precision: rounding here would change min_count_for
+                # at exact-threshold points (rounded only for CSV display)
+                "min_support": float(s),
+                "missing_songs": tensors.n_songs_missing,
+                "frequent_items": tensors.n_frequent_items,
+                "duration_s": round(duration, 6),
+            }
+        )
+        print(
+            f"min_support {s:.4f}: missing {tensors.n_songs_missing}, "
+            f"emission {duration * 1e3:.1f}ms"
+        )
+    return records
+
+
+def write_results_csv(cfg: MiningConfig, records: list[dict]) -> str:
+    path = os.path.join(cfg.base_dir, RESULTS_FILE)
+    header = "min_support,missing_songs,frequent_items,duration_s"
+    lines = [header] + [
+        f'{round(r["min_support"], 6)},{r["missing_songs"]},'
+        f'{r["frequent_items"]},{r["duration_s"]}'
+        for r in records
+    ]
+    atomic_write_text(path, "\n".join(lines) + "\n")
+    return path
+
+
+def main() -> int:
+    cfg = MiningConfig.from_env()
+    start = float(os.getenv("KMLS_SWEEP_START", "0.03"))
+    stop = float(os.getenv("KMLS_SWEEP_STOP", "0.2"))
+    step = float(os.getenv("KMLS_SWEEP_STEP", "0.0025"))
+    supports = np.arange(start, stop, step)  # reference grid (main.py:452)
+    records = run_sweep(cfg, supports)
+    path = write_results_csv(cfg, records)
+    print(f"wrote {len(records)} sweep points to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

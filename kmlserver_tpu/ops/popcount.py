@@ -1,0 +1,140 @@
+"""Pallas TPU kernel: pair-support counting over bit-packed baskets.
+
+The dense int8 ``XᵀX`` path (ops/support.py) stores one byte per
+(playlist, track) cell — at BASELINE.json config 4 scale (10M playlists ×
+1M tracks) that's 10 TB and infeasible. Packing the PLAYLIST axis into
+uint32 bit-words shrinks the operand 32× and turns pair counting into
+
+    C[i, j] = Σ_w popcount(Bt[i, w] & Bt[j, w])
+
+where ``Bt (V, ceil(P/32)) uint32`` holds track i's playlist membership as a
+bitset. This kernel tiles that computation for the VPU:
+
+- grid ``(i_tile, j_tile, w_chunk)``: output tile ``(TI, TJ) int32`` revisited
+  across the trailing ``w_chunk`` dimension and accumulated in place
+  (zero-initialized at the first chunk via ``@pl.when``);
+- per step, row block A ``(TI, WK)`` and column block B ``(TJ, WK)`` live in
+  VMEM; a ``fori_loop`` over the TI rows does AND + ``population_count`` +
+  word-sum on the VPU — no MXU involvement, no unpacking;
+- V is padded to the 128-lane tile and P to 32·WK word chunks with zero
+  bits, which contribute zero counts and are sliced away by the caller.
+
+On non-TPU backends the kernel runs in interpreter mode (tests); the public
+entry point falls back gracefully.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import encode
+
+TILE_I = 32
+TILE_J = 128
+WORD_CHUNK = 512  # uint32 words per grid step (= 16,384 playlists)
+
+
+def _popcount_kernel(a_ref, b_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    b_block = b_ref[:]  # (TJ, WK) uint32
+
+    def row(i, _):
+        anded = jnp.bitwise_and(a_ref[i, :], b_block)  # broadcast (TJ, WK)
+        counts = jax.lax.population_count(anded).astype(jnp.int32)
+        out_ref[i, :] += jnp.sum(counts, axis=1)
+        return 0
+
+    jax.lax.fori_loop(0, a_ref.shape[0], row, 0)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def popcount_pair_counts_padded(bt: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Pair counts from an already-padded bitset matrix
+    ``bt (V_pad, W_pad) uint32`` with V_pad % TILE_J == 0 and
+    W_pad % WORD_CHUNK == 0. → int32 (V_pad, V_pad)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    v_pad, w_pad = bt.shape
+    grid = (v_pad // TILE_I, v_pad // TILE_J, w_pad // WORD_CHUNK)
+    return pl.pallas_call(
+        _popcount_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (TILE_I, WORD_CHUNK),
+                lambda i, j, k: (i, k),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (TILE_J, WORD_CHUNK),
+                lambda i, j, k: (j, k),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_I, TILE_J), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((v_pad, v_pad), jnp.int32),
+        interpret=interpret,
+    )(bt, bt)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def bitpack_by_track(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    v_pad: int,
+    w_pad: int,
+) -> jax.Array:
+    """Bitset matrix (v_pad, w_pad) uint32: bit p of word ``Bt[t, p // 32]``
+    set iff playlist p contains track t. The packer is the same scatter as
+    ``encode.bitpack_matrix`` with the axes' roles swapped."""
+    if n_playlists > w_pad * encode.WORD_BITS:
+        raise ValueError(f"w_pad {w_pad} too small for {n_playlists} playlists")
+    return encode.bitpack_matrix(
+        jnp.asarray(track_ids),  # rows = tracks
+        jnp.asarray(playlist_rows),  # bits = playlists
+        n_playlists=v_pad,
+        n_tracks=w_pad * encode.WORD_BITS,
+    )
+
+
+def popcount_pair_counts(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Public entry: membership pairs → (V, V) int32 pair counts via the
+    bit-packed popcount kernel. Interpreter mode auto-enabled off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v_pad = _round_up(max(n_tracks, TILE_J), max(TILE_I, TILE_J))
+    w_pad = _round_up(
+        (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, WORD_CHUNK
+    )
+    bt = bitpack_by_track(
+        playlist_rows, track_ids,
+        n_playlists=n_playlists, n_tracks=n_tracks,
+        v_pad=v_pad, w_pad=w_pad,
+    )
+    counts = popcount_pair_counts_padded(bt, interpret=interpret)
+    return counts[:n_tracks, :n_tracks]

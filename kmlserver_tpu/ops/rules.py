@@ -157,8 +157,14 @@ def mine_rules_from_counts(
     k_max: int,
     mode: str = "support",
     min_confidence: float = 0.0,
+    n_total_songs: int | None = None,
 ) -> RuleTensors:
-    """Full emission: device threshold/top-k, then host assembly + stats."""
+    """Full emission: device threshold/top-k, then host assembly + stats.
+
+    ``n_total_songs``: the dataset's full unique-track count when the count
+    matrix covers a PRUNED vocabulary (Apriori pre-filter) — keeps the
+    missing-songs counter meaning what the reference prints
+    (total_songs - frequent keys, machine-learning/main.py:304)."""
     if mode not in ("support", "confidence"):
         raise ValueError(f"confidence mode must be 'support' or 'confidence', got {mode!r}")
     min_count = min_count_for(min_support, n_playlists)
@@ -193,6 +199,8 @@ def mine_rules_from_counts(
         mode=mode,
         min_confidence=min_confidence,
         n_frequent_items=n_frequent,
-        n_songs_missing=int(pair_count_matrix.shape[0]) - n_frequent,
+        n_songs_missing=(
+            n_total_songs if n_total_songs is not None else int(pair_count_matrix.shape[0])
+        ) - n_frequent,
         overflow_rows=int((row_valid > k_max).sum()),
     )

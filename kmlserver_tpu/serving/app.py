@@ -75,6 +75,14 @@ class RecommendApp:
         self.cfg = cfg
         self.engine = engine or RecommendEngine(cfg)
         self.metrics = ServingMetrics()
+        self.batcher = None
+        if cfg.batch_window_ms > 0:
+            from .batcher import MicroBatcher
+
+            self.batcher = MicroBatcher(
+                self.engine, max_size=cfg.batch_max_size,
+                window_ms=cfg.batch_window_ms,
+            )
         with open(_TEMPLATE_PATH, "r", encoding="utf-8") as fh:
             self._template = fh.read()
 
@@ -130,7 +138,10 @@ class RecommendApp:
             # reference: empty request → 400 (rest_api/app/main.py:178-179)
             return _json_response(400, {"detail": "Request with no songs"})
         try:
-            recs, source = self.engine.recommend(songs)
+            if self.batcher is not None:
+                recs, source = self.batcher.recommend(songs)
+            else:
+                recs, source = self.engine.recommend(songs)
         except Exception:
             logger.exception("recommendation failed")
             self.metrics.record_error()

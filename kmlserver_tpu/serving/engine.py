@@ -181,20 +181,12 @@ class RecommendEngine:
         )
 
     def _warmup(self, bundle: RuleBundle) -> None:
-        length = 1
-        while True:
-            seeds = jnp.zeros((1, length), dtype=jnp.int32)
-            jax.block_until_ready(
-                self._kernel(bundle.rule_ids, bundle.rule_confs, seeds)
-            )
-            if length >= self.cfg.max_seed_tracks:
-                break
-            length <<= 1
-        # the batched QPS path's canonical shape
-        seeds = jnp.zeros((self.cfg.batch_max_size, 8), dtype=jnp.int32)
-        jax.block_until_ready(
-            self._kernel(bundle.rule_ids, bundle.rule_confs, seeds)
-        )
+        for length in self._len_buckets():
+            for batch in (1, self.cfg.batch_max_size):
+                seeds = jnp.zeros((batch, length), dtype=jnp.int32)
+                jax.block_until_ready(
+                    self._kernel(bundle.rule_ids, bundle.rule_confs, seeds)
+                )
 
     def reload_if_required(self) -> None:
         """Reference: reload when stale or never fully loaded
@@ -204,11 +196,20 @@ class RecommendEngine:
 
     # ---------- lookups ----------
 
+    def _len_buckets(self) -> list[int]:
+        """Coarse seed-length buckets: every (batch, length) shape a request
+        can produce is warmed at load time, so no request ever pays a
+        compile. The cap itself is always a member — a >128-seed bucket must
+        be warmable too."""
+        cap = self.cfg.max_seed_tracks
+        return sorted({min(b, cap) for b in (1, 8, 32, 128)} | {cap})
+
     def _bucket_len(self, n: int) -> int:
-        b = 1
-        while b < n:
-            b <<= 1
-        return min(b, self.cfg.max_seed_tracks)
+        buckets = self._len_buckets()
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
 
     def recommend(self, seed_tracks: list[str]) -> tuple[list[str], str]:
         """→ (songs, source) where source ∈ {"rules", "fallback", "empty"}.
@@ -242,15 +243,26 @@ class RecommendEngine:
         songs = [bundle.vocab[int(i)] for i in ids if i >= 0]
         return songs, ("rules" if songs else "empty")
 
-    def recommend_many(self, seed_sets: list[list[str]]) -> list[list[str]]:
-        """Batched device call over pre-resolved requests (the QPS path)."""
+    def recommend_many(
+        self, seed_sets: list[list[str]]
+    ) -> list[tuple[list[str], str]]:
+        """Batched device call over aggregated concurrent requests (the QPS
+        path): ONE kernel invocation serves the whole batch. Per-request
+        semantics identical to :meth:`recommend`."""
         bundle = self.bundle
         if bundle is None:
-            return [self.static_recommendation(s) for s in seed_sets]
+            # same late-load nudge as the single-request path
+            threading.Thread(target=self.reload_if_required, daemon=True).start()
+            return [(self.static_recommendation(s), "fallback") for s in seed_sets]
         length = self._bucket_len(
             max((len(s) for s in seed_sets), default=1)
         )
-        arr = np.full((len(seed_sets), length), -1, dtype=np.int32)
+        # pad the batch to its canonical size: a varying batch dimension
+        # would compile a fresh kernel per distinct size
+        n_rows = max(len(seed_sets), 1)
+        if n_rows <= self.cfg.batch_max_size:
+            n_rows = self.cfg.batch_max_size
+        arr = np.full((n_rows, length), -1, dtype=np.int32)
         for r, seeds in enumerate(seed_sets):
             ids = [
                 bundle.index[s]
@@ -260,12 +272,13 @@ class RecommendEngine:
             arr[r, : len(ids)] = ids
         top_ids, _ = self._kernel(bundle.rule_ids, bundle.rule_confs, jnp.asarray(arr))
         top_ids = np.asarray(top_ids)
-        out: list[list[str]] = []
+        out: list[tuple[list[str], str]] = []
         for r, seeds in enumerate(seed_sets):
             if (arr[r] >= 0).any():
-                out.append([bundle.vocab[int(i)] for i in top_ids[r] if i >= 0])
+                songs = [bundle.vocab[int(i)] for i in top_ids[r] if i >= 0]
+                out.append((songs, "rules" if songs else "empty"))
             else:
-                out.append(self.static_recommendation(seeds))
+                out.append((self.static_recommendation(seeds), "fallback"))
         return out
 
     def static_recommendation(self, seed_tracks: list[str]) -> list[str]:

@@ -139,10 +139,49 @@ class TestEngine:
             f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
         )
         seed_sets = [[s] for s, row in rules_dict.items() if row][:4]
+        seed_sets.append(["totally-unknown-track"])  # fallback inside a batch
         batched = engine.recommend_many(seed_sets)
-        for seeds, got in zip(seed_sets, batched):
-            single, _ = engine.recommend(seeds)
+        for seeds, (got, source) in zip(seed_sets, batched):
+            single, single_source = engine.recommend(seeds)
             assert set(got) == set(single)
+            assert source == single_source
+
+    def test_microbatcher_aggregates_into_one_device_call(self, mined_pvc):
+        from kmlserver_tpu.serving.batcher import MicroBatcher
+
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        seeds = [s for s, row in rules_dict.items() if row]
+        calls = []
+        original = engine.recommend_many
+
+        def counting(seed_sets):
+            calls.append(len(seed_sets))
+            return original(seed_sets)
+
+        engine.recommend_many = counting
+        batcher = MicroBatcher(engine, max_size=8, window_ms=50.0)
+        results = {}
+
+        def worker(i):
+            results[i] = batcher.recommend([seeds[i % len(seeds)]])
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 8 concurrent requests within one 50ms window → far fewer device
+        # calls than requests (usually 1-2 batches)
+        assert sum(calls) == 8
+        assert len(calls) <= 4
+        for i in range(8):
+            single, _ = engine.recommend([seeds[i % len(seeds)]])
+            assert set(results[i][0]) == set(single)
 
     def test_stable_seed_order_independent(self):
         assert stable_seed(["b", "a"]) == stable_seed(["a", "b"])

@@ -1,0 +1,107 @@
+"""Sweep harness (M14 resurrection) + the Apriori-pruned large-vocab path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import synthetic_baskets, synthetic_table
+from kmlserver_tpu.mining.miner import mine, prune_infrequent
+from kmlserver_tpu.mining.sweep import run_sweep, write_results_csv
+from kmlserver_tpu.mining.vocab import build_baskets
+from kmlserver_tpu.ops.support import min_count_for
+
+from .oracle import random_baskets, reference_fast_rules
+from .test_ops import table_from_baskets
+
+
+class TestPrunedMining:
+    def test_pruned_equals_unpruned(self, rng):
+        baskets = build_baskets(
+            table_from_baskets(
+                random_baskets(rng, n_playlists=80, n_tracks=40, mean_len=6)
+            )
+        )
+        plain = mine(baskets, MiningConfig(min_support=0.08, k_max_consequents=32))
+        pruned = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.08, k_max_consequents=32, prune_vocab_threshold=1
+            ),
+        )
+        assert pruned.pruned_vocab is not None
+        assert pruned.pruned_vocab < baskets.n_tracks
+        d1 = plain.tensors.to_rules_dict(plain.vocab_names)
+        d2 = pruned.tensors.to_rules_dict(pruned.vocab_names)
+        assert d1 == d2
+        assert plain.tensors.n_songs_missing == pruned.tensors.n_songs_missing
+
+    def test_pruned_matches_oracle(self, rng):
+        baskets_list = random_baskets(rng, n_playlists=60, n_tracks=30, mean_len=5)
+        baskets = build_baskets(table_from_baskets(baskets_list))
+        result = mine(
+            baskets,
+            MiningConfig(min_support=0.1, k_max_consequents=32, prune_vocab_threshold=1),
+        )
+        got = result.tensors.to_rules_dict(result.vocab_names)
+        assert got == reference_fast_rules(baskets_list, 0.1)
+
+    def test_large_vocab_smoke(self):
+        """50k-track vocabulary: dense (V,V) would be 10 GB; pruning must
+        collapse it to the frequent few hundred."""
+        baskets = synthetic_baskets(
+            n_playlists=2000, n_tracks=50_000, target_rows=60_000, seed=3
+        )
+        cfg = MiningConfig(min_support=0.01, k_max_consequents=16)
+        result = mine(baskets, cfg)
+        assert result.pruned_vocab is not None
+        assert result.pruned_vocab < 2000  # collapsed far below 50k
+        assert result.tensors.rule_ids.shape[0] == result.pruned_vocab
+        assert len(result.vocab_names) == result.pruned_vocab
+        # missing counter still speaks about the FULL vocabulary
+        assert (
+            result.tensors.n_songs_missing
+            == 50_000 - result.tensors.n_frequent_items
+        )
+
+    def test_prune_keeps_playlist_denominator(self, rng):
+        baskets = build_baskets(
+            table_from_baskets(
+                random_baskets(rng, n_playlists=30, n_tracks=20, mean_len=4)
+            )
+        )
+        reduced, kept = prune_infrequent(
+            baskets, min_count_for(0.2, baskets.n_playlists)
+        )
+        assert reduced.n_playlists == baskets.n_playlists
+        assert reduced.n_tracks == len(kept)
+
+
+class TestSweep:
+    def test_sweep_monotone_and_csv(self, tmp_path, rng):
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        table = synthetic_table(
+            n_playlists=120, n_tracks=60, target_rows=1500, seed=5
+        )
+        write_tracks_csv(str(ds_dir / "2023_spotify_ds1.csv"), table)
+        cfg = MiningConfig(base_dir=str(tmp_path), datasets_dir=str(ds_dir))
+        supports = np.arange(0.03, 0.2, 0.02)
+        records = run_sweep(cfg, supports)
+        assert len(records) == len(supports)
+        # coverage degrades monotonically with support (reference chart p.5)
+        missing = [r["missing_songs"] for r in records]
+        assert missing == sorted(missing)
+        # per-point parity with a full fresh mine
+        baskets = build_baskets(table)
+        for r in records[:: max(len(records) // 3, 1)]:
+            full = mine(
+                baskets, MiningConfig(min_support=r["min_support"])
+            )
+            assert full.tensors.n_songs_missing == r["missing_songs"]
+        path = write_results_csv(cfg, records)
+        lines = open(path).read().splitlines()
+        assert lines[0] == "min_support,missing_songs,frequent_items,duration_s"
+        assert len(lines) == len(records) + 1
