@@ -52,7 +52,17 @@ def read_tracks(path: str, sample_ratio: float = 1.0) -> TrackTable:
     """Read a membership CSV, optionally head-sampling ``sample_ratio`` of the
     rows, and drop ``duration_ms`` (reference: read_tracks main.py:152-166 +
     clean_df main.py:148-150 — there sampling is also a head-slice, not random).
+
+    Uses the native C++ dictionary-encoding loader (native/kmls_csv.cpp)
+    when its .so is available, falling back to pandas' parser.
     """
+    from . import native
+
+    if native.available():
+        try:
+            return _table_from_native(native.read_csv_native(path), sample_ratio)
+        except ValueError:
+            pass  # malformed for the strict native parser → pandas fallback
     df = pd.read_csv(path)
     missing = [c for c in REQUIRED_COLUMNS if c not in df.columns]
     if missing:
@@ -67,6 +77,30 @@ def read_tracks(path: str, sample_ratio: float = 1.0) -> TrackTable:
     return TrackTable(
         pid=df["pid"].to_numpy(),
         track_name=df["track_name"].astype(str).to_numpy(),
+        track_uri=col("track_uri"),
+        artist_name=col("artist_name"),
+        artist_uri=col("artist_uri"),
+        album_name=col("album_name"),
+    )
+
+
+def _table_from_native(nt, sample_ratio: float) -> TrackTable:
+    n = len(nt)
+    if "track_name" not in nt.columns:
+        raise ValueError("missing required column track_name")
+    stop = n
+    if 0 < sample_ratio < 1.0:
+        stop = max(1, int(n * sample_ratio))
+
+    def col(name: str) -> np.ndarray | None:
+        dc = nt.columns.get(name)
+        if dc is None or name in DROP_COLUMNS:
+            return None
+        return dc.materialize()[:stop]
+
+    return TrackTable(
+        pid=nt.pids[:stop],
+        track_name=col("track_name"),
         track_uri=col("track_uri"),
         artist_name=col("artist_name"),
         artist_uri=col("artist_uri"),

@@ -1,0 +1,149 @@
+"""ctypes bindings for the native CSV loader (native/kmls_csv.cpp).
+
+The native layer goes mmap → dictionary-encoded int columns in one C++
+pass: int64 pids plus, per string column, int32 codes and a first-occurrence
+vocabulary (blob + offsets). That is already the shape the device pipeline
+wants; ``DictColumn.materialize`` produces numpy object arrays (vectorized
+fancy-indexing) only where the host-side aux builders need strings —
+``data/csv.py`` adapts a :class:`NativeTable` into the ``TrackTable`` facade.
+
+Build: ``make -C native`` (or :func:`ensure_built`, which shells out to the
+same Makefile). Loading falls back gracefully — callers check
+:func:`available` and use the pandas path otherwise; set ``KMLS_NATIVE=0``
+to force the fallback off explicitly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libkmls_csv.so")
+
+_lib: ctypes.CDLL | None = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.kmls_read_csv.restype = ctypes.c_void_p
+    lib.kmls_read_csv.argtypes = [ctypes.c_char_p]
+    lib.kmls_table_error.restype = ctypes.c_char_p
+    lib.kmls_table_error.argtypes = [ctypes.c_void_p]
+    lib.kmls_table_nrows.restype = ctypes.c_int64
+    lib.kmls_table_nrows.argtypes = [ctypes.c_void_p]
+    lib.kmls_table_pids.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.kmls_table_pids.argtypes = [ctypes.c_void_p]
+    lib.kmls_table_ncols.restype = ctypes.c_int32
+    lib.kmls_table_ncols.argtypes = [ctypes.c_void_p]
+    lib.kmls_table_col_name.restype = ctypes.c_char_p
+    lib.kmls_table_col_name.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.kmls_table_col_codes.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.kmls_table_col_codes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.kmls_table_col_vocab_size.restype = ctypes.c_int32
+    lib.kmls_table_col_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.kmls_table_col_vocab_blob.restype = ctypes.POINTER(ctypes.c_char)
+    lib.kmls_table_col_vocab_blob.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)
+    ]
+    lib.kmls_table_col_vocab_offsets.restype = ctypes.POINTER(ctypes.c_uint64)
+    lib.kmls_table_col_vocab_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.kmls_table_free.restype = None
+    lib.kmls_table_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the .so if missing; returns availability."""
+    if os.path.exists(_SO_PATH):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    return os.path.exists(_SO_PATH)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("KMLS_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SO_PATH) and not ensure_built():
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+@dataclasses.dataclass
+class DictColumn:
+    """Dictionary-encoded string column: ``values = vocab[codes]``."""
+
+    codes: np.ndarray  # int32 (N,)
+    vocab: list[str]
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self.vocab, dtype=object)[self.codes]
+
+
+@dataclasses.dataclass
+class NativeTable:
+    pids: np.ndarray  # int64 (N,)
+    columns: dict[str, DictColumn]
+
+    def __len__(self) -> int:
+        return len(self.pids)
+
+
+def read_csv_native(path: str) -> NativeTable:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV loader unavailable (build native/ first)")
+    handle = lib.kmls_read_csv(path.encode())
+    if not handle:
+        raise MemoryError("kmls_read_csv allocation failed")
+    try:
+        err = lib.kmls_table_error(handle)
+        if err:
+            raise ValueError(f"{path}: {err.decode()}")
+        n = lib.kmls_table_nrows(handle)
+        pids = np.ctypeslib.as_array(lib.kmls_table_pids(handle), shape=(n,)).copy()
+        columns: dict[str, DictColumn] = {}
+        for i in range(lib.kmls_table_ncols(handle)):
+            name = lib.kmls_table_col_name(handle, i).decode()
+            codes = np.ctypeslib.as_array(
+                lib.kmls_table_col_codes(handle, i), shape=(n,)
+            ).copy()
+            vsize = lib.kmls_table_col_vocab_size(handle, i)
+            nbytes = ctypes.c_int64()
+            blob_ptr = lib.kmls_table_col_vocab_blob(handle, i, ctypes.byref(nbytes))
+            blob = ctypes.string_at(blob_ptr, nbytes.value) if nbytes.value else b""
+            offsets = np.ctypeslib.as_array(
+                lib.kmls_table_col_vocab_offsets(handle, i), shape=(vsize + 1,)
+            ).copy()
+            vocab = [
+                blob[offsets[j]: offsets[j + 1]].decode("utf-8", "replace")
+                for j in range(vsize)
+            ]
+            columns[name] = DictColumn(codes=codes, vocab=vocab)
+        return NativeTable(pids=pids, columns=columns)
+    finally:
+        lib.kmls_table_free(handle)

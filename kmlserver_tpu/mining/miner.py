@@ -72,14 +72,23 @@ def pair_count_fn(
         return sharded_pair_counts(baskets, mesh), None
     elems = baskets.n_playlists * baskets.n_tracks
     if bitpack_threshold_elems is not None and elems > bitpack_threshold_elems:
-        # 32x denser operand: Pallas popcount over playlist bitsets
-        from ..ops.popcount import popcount_pair_counts
+        if jax.default_backend() == "tpu":
+            # 32x denser operand: Pallas popcount over playlist bitsets
+            from ..ops.popcount import popcount_pair_counts
 
-        counts = popcount_pair_counts(
-            baskets.playlist_rows, baskets.track_ids,
-            n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+            counts = popcount_pair_counts(
+                baskets.playlist_rows, baskets.track_ids,
+                n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+            )
+            return counts, None
+        # off-TPU the Pallas kernel would run in Python-level interpreter
+        # mode — a massive perf cliff on exactly the large inputs this
+        # threshold targets; the dense path is the right fallback there
+        print(
+            f"NOTE: one-hot has {elems:.2e} elements but backend is "
+            f"{jax.default_backend()!r}; bit-packed popcount is TPU-only — "
+            f"using the dense int8 path"
         )
-        return counts, None
     x = encode.onehot_matrix(
         jnp.asarray(baskets.playlist_rows),
         jnp.asarray(baskets.track_ids),

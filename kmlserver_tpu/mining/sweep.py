@@ -77,6 +77,8 @@ def run_sweep(
             n_playlists=mined_baskets.n_playlists,
             min_support=float(s),
             k_max=cfg.k_max_consequents,
+            mode=cfg.confidence_mode,
+            min_confidence=cfg.min_confidence,
             n_total_songs=n_total,
         )
         duration = time.perf_counter() - t0

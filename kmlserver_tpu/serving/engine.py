@@ -257,11 +257,11 @@ class RecommendEngine:
         length = self._bucket_len(
             max((len(s) for s in seed_sets), default=1)
         )
-        # pad the batch to its canonical size: a varying batch dimension
-        # would compile a fresh kernel per distinct size
-        n_rows = max(len(seed_sets), 1)
-        if n_rows <= self.cfg.batch_max_size:
-            n_rows = self.cfg.batch_max_size
+        # pad the batch dimension to a multiple of the canonical size: a
+        # varying batch dimension would compile a fresh kernel per distinct
+        # size (oversized batches round UP, keeping the shape set bounded)
+        step = self.cfg.batch_max_size
+        n_rows = ((max(len(seed_sets), 1) + step - 1) // step) * step
         arr = np.full((n_rows, length), -1, dtype=np.int32)
         for r, seeds in enumerate(seed_sets):
             ids = [

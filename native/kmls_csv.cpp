@@ -1,0 +1,278 @@
+// kmls_csv — native CSV → dictionary-encoded columnar loader.
+//
+// The mining pipeline consumes integer-ID tensors, not strings: playlist ids
+// and interned track/artist/album ids (kmlserver_tpu/data/csv.py is the
+// Python facade; the reference ingests via polars' native engine,
+// machine-learning/main.py:153). This loader goes straight from the mmap'd
+// file to that representation in one pass:
+//
+//   - RFC-4180 field scanning (quoted fields, "" escapes, embedded commas
+//     and newlines, \r\n);
+//   - int64 parse for `pid`;
+//   - string interning for every other requested column: per column, an
+//     open-addressing hash table over an append-only byte arena produces
+//     int32 codes + a first-occurrence vocabulary.
+//
+// C ABI only (consumed via ctypes — no pybind11 in this image). All memory
+// is owned by the kmls_table and freed with kmls_table_free.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Arena {
+  std::vector<char> bytes;
+  std::vector<uint64_t> offsets;  // offsets.size() == count+1
+
+  Arena() { offsets.push_back(0); }
+
+  int32_t add(const char* data, size_t len) {
+    bytes.insert(bytes.end(), data, data + len);
+    offsets.push_back(bytes.size());
+    return static_cast<int32_t>(offsets.size() - 2);
+  }
+  size_t count() const { return offsets.size() - 1; }
+  const char* at(size_t i, size_t* len) const {
+    *len = offsets[i + 1] - offsets[i];
+    return bytes.data() + offsets[i];
+  }
+};
+
+// open-addressing intern table over an Arena
+struct Interner {
+  Arena arena;
+  std::vector<int32_t> slots;  // -1 empty, else string id
+  size_t mask = 0;
+
+  Interner() { rehash(1 << 12); }
+
+  static uint64_t hash(const char* s, size_t n) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a
+    for (size_t i = 0; i < n; ++i) {
+      h ^= static_cast<unsigned char>(s[i]);
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+
+  void rehash(size_t n) {
+    std::vector<int32_t> fresh(n, -1);
+    for (int32_t id = 0; id < static_cast<int32_t>(arena.count()); ++id) {
+      size_t len;
+      const char* s = arena.at(id, &len);
+      size_t slot = hash(s, len) & (n - 1);
+      while (fresh[slot] != -1) slot = (slot + 1) & (n - 1);
+      fresh[slot] = id;
+    }
+    slots.swap(fresh);
+    mask = n - 1;
+  }
+
+  int32_t intern(const char* s, size_t n) {
+    if (arena.count() * 2 >= slots.size()) rehash(slots.size() * 2);
+    size_t slot = hash(s, n) & mask;
+    while (true) {
+      int32_t id = slots[slot];
+      if (id == -1) {
+        int32_t fresh_id = arena.add(s, n);
+        slots[slot] = fresh_id;
+        return fresh_id;
+      }
+      size_t len;
+      const char* existing = arena.at(id, &len);
+      if (len == n && std::memcmp(existing, s, n) == 0) return id;
+      slot = (slot + 1) & mask;
+    }
+  }
+};
+
+struct Column {
+  std::string name;
+  Interner interner;
+  std::vector<int32_t> codes;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct kmls_table {
+  std::vector<int64_t> pids;
+  std::vector<Column> columns;
+  std::string error;
+};
+
+static void parse_field(const char* p, const char* end, std::string* out,
+                        const char** next) {
+  out->clear();
+  if (p < end && *p == '"') {
+    ++p;
+    while (p < end) {
+      if (*p == '"') {
+        if (p + 1 < end && p[1] == '"') {  // escaped quote
+          out->push_back('"');
+          p += 2;
+        } else {
+          ++p;
+          break;
+        }
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    // trailing junk until delimiter is ignored per RFC leniency
+    while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
+  } else {
+    const char* start = p;
+    while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
+    out->assign(start, p - start);
+  }
+  *next = p;
+}
+
+// Parse `path`, interning every column except `pid`. Returns NULL only on
+// allocation failure; check kmls_table_error() for parse errors.
+kmls_table* kmls_read_csv(const char* path) {
+  auto* table = new kmls_table();
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    table->error = std::string("cannot open ") + path;
+    return table;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    close(fd);
+    table->error = std::string("empty or unreadable ") + path;
+    return table;
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  const char* data =
+      static_cast<const char*>(mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0));
+  close(fd);
+  if (data == MAP_FAILED) {
+    table->error = std::string("mmap failed for ") + path;
+    return table;
+  }
+  const char* p = data;
+  const char* end = data + size;
+
+  // header
+  std::vector<std::string> header;
+  std::string field;
+  int pid_index = -1;
+  while (p < end) {
+    parse_field(p, end, &field, &p);
+    header.push_back(field);
+    if (p < end && *p == ',') {
+      ++p;
+      continue;
+    }
+    break;
+  }
+  while (p < end && (*p == '\r' || *p == '\n')) ++p;
+  for (size_t i = 0; i < header.size(); ++i) {
+    if (header[i] == "pid") {
+      pid_index = static_cast<int>(i);
+    } else {
+      table->columns.push_back(Column{});
+      table->columns.back().name = header[i];
+    }
+  }
+  if (pid_index < 0) {
+    table->error = "missing required column 'pid'";
+    munmap(const_cast<char*>(data), size);
+    return table;
+  }
+
+  // rows
+  const int ncols = static_cast<int>(header.size());
+  std::string scratch;
+  while (p < end) {
+    int col = 0;
+    int out_col = 0;
+    bool row_has_data = false;
+    while (p < end && col < ncols) {
+      parse_field(p, end, &scratch, &p);
+      if (!scratch.empty()) row_has_data = true;
+      if (col == pid_index) {
+        table->pids.push_back(strtoll(scratch.c_str(), nullptr, 10));
+      } else {
+        Column& c = table->columns[out_col++];
+        c.codes.push_back(c.interner.intern(scratch.data(), scratch.size()));
+      }
+      ++col;
+      if (p < end && *p == ',') ++p;
+      else break;
+    }
+    while (p < end && (*p == '\r' || *p == '\n')) ++p;
+    if (!row_has_data && col <= 1) {  // blank trailing line: undo
+      if (col == 1) {
+        if (pid_index == 0) table->pids.pop_back();
+        else {
+          Column& c = table->columns[0];
+          c.codes.pop_back();  // interned empty string stays in vocab; harmless
+        }
+      }
+      continue;
+    }
+    if (col != ncols) {
+      char msg[128];
+      snprintf(msg, sizeof(msg), "row %zu has %d fields, expected %d",
+               table->pids.size(), col, ncols);
+      table->error = msg;
+      break;
+    }
+  }
+  munmap(const_cast<char*>(data), size);
+  return table;
+}
+
+const char* kmls_table_error(kmls_table* t) {
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+
+int64_t kmls_table_nrows(kmls_table* t) {
+  return static_cast<int64_t>(t->pids.size());
+}
+
+const int64_t* kmls_table_pids(kmls_table* t) { return t->pids.data(); }
+
+int32_t kmls_table_ncols(kmls_table* t) {
+  return static_cast<int32_t>(t->columns.size());
+}
+
+const char* kmls_table_col_name(kmls_table* t, int32_t i) {
+  return t->columns[i].name.c_str();
+}
+
+const int32_t* kmls_table_col_codes(kmls_table* t, int32_t i) {
+  return t->columns[i].codes.data();
+}
+
+int32_t kmls_table_col_vocab_size(kmls_table* t, int32_t i) {
+  return static_cast<int32_t>(t->columns[i].interner.arena.count());
+}
+
+// vocabulary as one concatenated blob + uint64 offsets (count+1 entries)
+const char* kmls_table_col_vocab_blob(kmls_table* t, int32_t i, int64_t* nbytes) {
+  *nbytes = static_cast<int64_t>(t->columns[i].interner.arena.bytes.size());
+  return t->columns[i].interner.arena.bytes.data();
+}
+
+const uint64_t* kmls_table_col_vocab_offsets(kmls_table* t, int32_t i) {
+  return t->columns[i].interner.arena.offsets.data();
+}
+
+void kmls_table_free(kmls_table* t) { delete t; }
+
+}  // extern "C"

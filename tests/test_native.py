@@ -1,0 +1,79 @@
+"""Native C++ CSV loader: parse correctness vs the pandas path, tricky
+RFC-4180 inputs, and the facade fallback."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.data import native
+from kmlserver_tpu.data.csv import read_tracks, write_tracks_csv
+from kmlserver_tpu.data.synthetic import synthetic_table
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="no C++ toolchain to build native/"
+)
+
+
+def test_tricky_rfc4180(tmp_path):
+    path = tmp_path / "tricky.csv"
+    path.write_text(
+        "pid,track_name,artist_name\n"
+        '1,"Hello, World","A ""quoted"" artist"\n'
+        "2,Simple,Nome çedilha\n"
+        '1,"Multi\nline title",Artist2\n'
+    )
+    t = native.read_csv_native(str(path))
+    assert t.pids.tolist() == [1, 2, 1]
+    assert t.columns["track_name"].materialize().tolist() == [
+        "Hello, World", "Simple", "Multi\nline title",
+    ]
+    assert t.columns["artist_name"].materialize().tolist() == [
+        'A "quoted" artist', "Nome çedilha", "Artist2",
+    ]
+
+
+def test_matches_pandas_on_synthetic(tmp_path):
+    table = synthetic_table(n_playlists=50, n_tracks=40, target_rows=600, seed=11)
+    path = str(tmp_path / "ds.csv")
+    write_tracks_csv(path, table)
+    nt = native.read_csv_native(path)
+    import pandas as pd
+
+    df = pd.read_csv(path)
+    np.testing.assert_array_equal(nt.pids, df["pid"].to_numpy())
+    for col in ("track_name", "artist_name", "album_name", "track_uri"):
+        np.testing.assert_array_equal(
+            nt.columns[col].materialize(), df[col].astype(str).to_numpy()
+        )
+
+
+def test_facade_uses_native_and_matches(tmp_path, monkeypatch):
+    table = synthetic_table(n_playlists=30, n_tracks=25, target_rows=300, seed=12)
+    path = str(tmp_path / "ds.csv")
+    write_tracks_csv(path, table)
+    via_native = read_tracks(path)
+    monkeypatch.setenv("KMLS_NATIVE", "0")
+    monkeypatch.setattr(native, "_lib", None)
+    via_pandas = read_tracks(path)
+    np.testing.assert_array_equal(via_native.pid, via_pandas.pid)
+    np.testing.assert_array_equal(via_native.track_name, via_pandas.track_name)
+    np.testing.assert_array_equal(via_native.artist_uri, via_pandas.artist_uri)
+
+
+def test_missing_pid_column_errors(tmp_path):
+    path = tmp_path / "bad.csv"
+    path.write_text("a,b\n1,2\n")
+    with pytest.raises(ValueError, match="pid"):
+        native.read_csv_native(str(path))
+
+
+def test_sample_ratio_head_slice(tmp_path):
+    table = synthetic_table(n_playlists=30, n_tracks=25, target_rows=300, seed=13)
+    path = str(tmp_path / "ds.csv")
+    write_tracks_csv(path, table)
+    full = read_tracks(path)
+    half = read_tracks(path, sample_ratio=0.5)
+    assert len(half) == max(1, len(full) // 2)
+    np.testing.assert_array_equal(half.track_name, full.track_name[: len(half)])
