@@ -60,22 +60,33 @@ def read_tracks(path: str, sample_ratio: float = 1.0) -> TrackTable:
 
     if native.available():
         try:
-            return _table_from_native(native.read_csv_native(path), sample_ratio)
+            return _table_from_native(
+                native.read_csv_native(path, skip_columns=tuple(DROP_COLUMNS)),
+                sample_ratio,
+            )
         except ValueError:
             pass  # malformed for the strict native parser → pandas fallback
-    df = pd.read_csv(path)
+    # keep_default_na=False: empty cells stay "" exactly as the native path
+    # produces them (pandas' default would turn them into NaN → "nan")
+    df = pd.read_csv(path, keep_default_na=False)
     missing = [c for c in REQUIRED_COLUMNS if c not in df.columns]
     if missing:
         raise ValueError(f"{path}: missing required columns {missing}; has {list(df.columns)}")
     if 0 < sample_ratio < 1.0:
         df = df.head(max(1, int(len(df) * sample_ratio)))
     df = df.drop(columns=[c for c in DROP_COLUMNS if c in df.columns])
+    # same contract as the native parser: non-numeric pids are a parse error,
+    # never silently-wrong data (pandas leaves them as an object column)
+    try:
+        pid = pd.to_numeric(df["pid"], errors="raise").astype(np.int64).to_numpy()
+    except (ValueError, TypeError) as exc:
+        raise ValueError(f"{path}: invalid pid column: {exc}") from None
 
     def col(name: str) -> np.ndarray | None:
         return df[name].to_numpy() if name in df.columns else None
 
     return TrackTable(
-        pid=df["pid"].to_numpy(),
+        pid=pid,
         track_name=df["track_name"].astype(str).to_numpy(),
         track_uri=col("track_uri"),
         artist_name=col("artist_name"),
@@ -94,7 +105,7 @@ def _table_from_native(nt, sample_ratio: float) -> TrackTable:
 
     def col(name: str) -> np.ndarray | None:
         dc = nt.columns.get(name)
-        if dc is None or name in DROP_COLUMNS:
+        if dc is None:
             return None
         return dc.materialize()[:stop]
 

@@ -33,7 +33,7 @@ _lib: ctypes.CDLL | None = None
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.kmls_read_csv.restype = ctypes.c_void_p
-    lib.kmls_read_csv.argtypes = [ctypes.c_char_p]
+    lib.kmls_read_csv.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.kmls_table_error.restype = ctypes.c_char_p
     lib.kmls_table_error.argtypes = [ctypes.c_void_p]
     lib.kmls_table_nrows.restype = ctypes.c_int64
@@ -76,10 +76,11 @@ def ensure_built(quiet: bool = True) -> bool:
 
 def _load() -> ctypes.CDLL | None:
     global _lib
-    if _lib is not None:
-        return _lib
+    # the kill switch is honored on every call, not just before first load
     if os.environ.get("KMLS_NATIVE", "1") == "0":
         return None
+    if _lib is not None:
+        return _lib
     if not os.path.exists(_SO_PATH) and not ensure_built():
         return None
     try:
@@ -113,11 +114,15 @@ class NativeTable:
         return len(self.pids)
 
 
-def read_csv_native(path: str) -> NativeTable:
+def read_csv_native(
+    path: str, skip_columns: tuple[str, ...] = ()
+) -> NativeTable:
+    """Load `path`; `skip_columns` are scanned but never interned/returned
+    (saves the dictionary-encoding work for columns the caller will drop)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native CSV loader unavailable (build native/ first)")
-    handle = lib.kmls_read_csv(path.encode())
+    handle = lib.kmls_read_csv(path.encode(), ",".join(skip_columns).encode())
     if not handle:
         raise MemoryError("kmls_read_csv allocation failed")
     try:
@@ -125,13 +130,20 @@ def read_csv_native(path: str) -> NativeTable:
         if err:
             raise ValueError(f"{path}: {err.decode()}")
         n = lib.kmls_table_nrows(handle)
-        pids = np.ctypeslib.as_array(lib.kmls_table_pids(handle), shape=(n,)).copy()
+        # empty vectors hand back nullptr data(); as_array would balk at it
+        pids = (
+            np.ctypeslib.as_array(lib.kmls_table_pids(handle), shape=(n,)).copy()
+            if n else np.empty(0, dtype=np.int64)
+        )
         columns: dict[str, DictColumn] = {}
         for i in range(lib.kmls_table_ncols(handle)):
             name = lib.kmls_table_col_name(handle, i).decode()
-            codes = np.ctypeslib.as_array(
-                lib.kmls_table_col_codes(handle, i), shape=(n,)
-            ).copy()
+            codes = (
+                np.ctypeslib.as_array(
+                    lib.kmls_table_col_codes(handle, i), shape=(n,)
+                ).copy()
+                if n else np.empty(0, dtype=np.int32)
+            )
             vsize = lib.kmls_table_col_vocab_size(handle, i)
             nbytes = ctypes.c_int64()
             blob_ptr = lib.kmls_table_col_vocab_blob(handle, i, ctypes.byref(nbytes))

@@ -16,6 +16,7 @@
 // C ABI only (consumed via ctypes — no pybind11 in this image). All memory
 // is owned by the kmls_table and freed with kmls_table_free.
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -140,9 +141,22 @@ static void parse_field(const char* p, const char* end, std::string* out,
   *next = p;
 }
 
-// Parse `path`, interning every column except `pid`. Returns NULL only on
-// allocation failure; check kmls_table_error() for parse errors.
-kmls_table* kmls_read_csv(const char* path) {
+// Parse `path`, interning every column except `pid` and any name in the
+// comma-separated `skip_cols` list (those are scanned but neither interned
+// nor returned — e.g. duration_ms, which the pipeline drops immediately).
+// Returns NULL only on allocation failure; check kmls_table_error() for
+// parse errors.
+kmls_table* kmls_read_csv(const char* path, const char* skip_cols) {
+  std::vector<std::string> skip;
+  if (skip_cols != nullptr) {
+    const char* s = skip_cols;
+    while (*s) {
+      const char* comma = std::strchr(s, ',');
+      size_t len = comma ? static_cast<size_t>(comma - s) : std::strlen(s);
+      if (len > 0) skip.emplace_back(s, len);
+      s += len + (comma ? 1 : 0);
+    }
+  }
   auto* table = new kmls_table();
   int fd = open(path, O_RDONLY);
   if (fd < 0) {
@@ -166,8 +180,12 @@ kmls_table* kmls_read_csv(const char* path) {
   const char* p = data;
   const char* end = data + size;
 
-  // header
+  // header. Per-column action: COL_PID parses int64, COL_SKIP is scanned
+  // but discarded, >=0 interns into table->columns[action].
+  constexpr int COL_PID = -1;
+  constexpr int COL_SKIP = -2;
   std::vector<std::string> header;
+  std::vector<int> action;
   std::string field;
   int pid_index = -1;
   while (p < end) {
@@ -181,9 +199,15 @@ kmls_table* kmls_read_csv(const char* path) {
   }
   while (p < end && (*p == '\r' || *p == '\n')) ++p;
   for (size_t i = 0; i < header.size(); ++i) {
+    bool skipped = false;
+    for (const std::string& s : skip) skipped = skipped || s == header[i];
     if (header[i] == "pid") {
       pid_index = static_cast<int>(i);
+      action.push_back(COL_PID);
+    } else if (skipped) {
+      action.push_back(COL_SKIP);
     } else {
+      action.push_back(static_cast<int>(table->columns.size()));
       table->columns.push_back(Column{});
       table->columns.back().name = header[i];
     }
@@ -194,43 +218,59 @@ kmls_table* kmls_read_csv(const char* path) {
     return table;
   }
 
-  // rows
+  // rows — buffered per row so nothing is committed until the row's field
+  // count and pid both validate (a malformed row must error, not corrupt).
   const int ncols = static_cast<int>(header.size());
-  std::string scratch;
+  std::vector<std::string> fields(ncols);
+  size_t row_no = 0;
   while (p < end) {
     int col = 0;
-    int out_col = 0;
     bool row_has_data = false;
+    bool trailing_comma = false;
     while (p < end && col < ncols) {
-      parse_field(p, end, &scratch, &p);
-      if (!scratch.empty()) row_has_data = true;
-      if (col == pid_index) {
-        table->pids.push_back(strtoll(scratch.c_str(), nullptr, 10));
-      } else {
-        Column& c = table->columns[out_col++];
-        c.codes.push_back(c.interner.intern(scratch.data(), scratch.size()));
-      }
+      parse_field(p, end, &fields[col], &p);
+      if (!fields[col].empty()) row_has_data = true;
       ++col;
-      if (p < end && *p == ',') ++p;
-      else break;
-    }
-    while (p < end && (*p == '\r' || *p == '\n')) ++p;
-    if (!row_has_data && col <= 1) {  // blank trailing line: undo
-      if (col == 1) {
-        if (pid_index == 0) table->pids.pop_back();
-        else {
-          Column& c = table->columns[0];
-          c.codes.pop_back();  // interned empty string stays in vocab; harmless
-        }
+      if (p < end && *p == ',') {
+        ++p;
+        trailing_comma = true;
+      } else {
+        trailing_comma = false;
+        break;
       }
-      continue;
     }
-    if (col != ncols) {
+    // a well-formed row ends exactly at EOL/EOF; extra fields after the
+    // ncols-th are an error, including a lone trailing empty one (the comma
+    // consumed after the last field with nothing but EOL behind it)
+    bool at_eol = (p >= end || *p == '\n' || *p == '\r');
+    while (p < end && (*p == '\r' || *p == '\n')) ++p;
+    if (!row_has_data && col <= 1) continue;  // blank trailing line
+    ++row_no;
+    if (col != ncols || !at_eol || trailing_comma) {
       char msg[128];
-      snprintf(msg, sizeof(msg), "row %zu has %d fields, expected %d",
-               table->pids.size(), col, ncols);
+      snprintf(msg, sizeof(msg), "row %zu has %s fields, expected %d",
+               row_no, col != ncols ? "too few" : "too many", ncols);
       table->error = msg;
       break;
+    }
+    const std::string& pid_str = fields[pid_index];
+    errno = 0;
+    char* endp = nullptr;
+    long long pid = strtoll(pid_str.c_str(), &endp, 10);
+    if (pid_str.empty() || errno == ERANGE || *endp != '\0') {
+      char msg[160];
+      snprintf(msg, sizeof(msg), "row %zu: invalid pid '%.64s'",
+               row_no, pid_str.c_str());
+      table->error = msg;
+      break;
+    }
+    table->pids.push_back(pid);
+    for (int i = 0; i < ncols; ++i) {
+      int act = action[i];
+      if (act >= 0) {
+        Column& c = table->columns[act];
+        c.codes.push_back(c.interner.intern(fields[i].data(), fields[i].size()));
+      }
     }
   }
   munmap(const_cast<char*>(data), size);

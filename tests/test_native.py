@@ -69,6 +69,88 @@ def test_missing_pid_column_errors(tmp_path):
         native.read_csv_native(str(path))
 
 
+def test_too_many_fields_errors_not_phantom_rows(tmp_path):
+    # a row with MORE fields than the header must error, not silently spill
+    # the extra fields into phantom rows
+    path = tmp_path / "spill.csv"
+    path.write_text("pid,track_name,artist_name\n1,a,b,X,Y,Z\n2,c,d\n")
+    with pytest.raises(ValueError, match="too many"):
+        native.read_csv_native(str(path))
+
+
+def test_too_few_fields_errors_with_right_row(tmp_path):
+    path = tmp_path / "short.csv"
+    path.write_text("pid,track_name,artist_name\n1,a,b\n2,c\n")
+    with pytest.raises(ValueError, match="row 2 has too few"):
+        native.read_csv_native(str(path))
+
+
+def test_invalid_pid_errors_not_zero(tmp_path):
+    # non-numeric pid must be a parse error, not a silent 0 that collapses
+    # bad rows into playlist 0
+    path = tmp_path / "badpid.csv"
+    path.write_text("pid,track_name\nabc,x\n7,y\n")
+    with pytest.raises(ValueError, match="invalid pid 'abc'"):
+        native.read_csv_native(str(path))
+
+
+def test_empty_cell_parity_with_pandas(tmp_path, monkeypatch):
+    # empty string cells must read identically ("") on both loader paths
+    path = tmp_path / "empty.csv"
+    path.write_text("pid,track_name,artist_name\n1,,z\n2,y,\n")
+    via_native = read_tracks(str(path))
+    monkeypatch.setenv("KMLS_NATIVE", "0")
+    monkeypatch.setattr(native, "_lib", None)
+    via_pandas = read_tracks(str(path))
+    assert via_native.track_name.tolist() == ["", "y"]
+    np.testing.assert_array_equal(via_native.track_name, via_pandas.track_name)
+    np.testing.assert_array_equal(via_native.artist_name, via_pandas.artist_name)
+
+
+def test_trailing_comma_errors(tmp_path):
+    # a single trailing extra EMPTY field must error like any other extra
+    path = tmp_path / "trail.csv"
+    path.write_text("pid,track_name,artist_name\n1,a,b,\n")
+    with pytest.raises(ValueError, match="too many"):
+        native.read_csv_native(str(path))
+
+
+def test_header_only_csv_is_empty_table(tmp_path):
+    path = tmp_path / "empty_rows.csv"
+    path.write_text("pid,track_name\n")
+    nt = native.read_csv_native(str(path))
+    assert len(nt) == 0
+    assert nt.columns["track_name"].codes.tolist() == []
+
+
+def test_bad_pid_surfaces_on_both_paths(tmp_path, monkeypatch):
+    # the pandas fallback must not turn a detected parse error into
+    # silently-wrong string pids
+    path = tmp_path / "badpid2.csv"
+    path.write_text("pid,track_name\nabc,x\n7,y\n")
+    with pytest.raises(ValueError, match="pid"):
+        read_tracks(str(path))
+    monkeypatch.setenv("KMLS_NATIVE", "0")
+    with pytest.raises(ValueError, match="pid"):
+        read_tracks(str(path))
+
+
+def test_kmls_native_env_honored_after_first_load(tmp_path, monkeypatch):
+    # the kill switch must work even once the library handle is cached
+    assert native.available()
+    monkeypatch.setenv("KMLS_NATIVE", "0")
+    assert not native.available()
+
+
+def test_skip_columns_not_interned(tmp_path):
+    path = tmp_path / "skip.csv"
+    path.write_text("pid,track_name,duration_ms\n1,a,111\n2,b,222\n")
+    nt = native.read_csv_native(str(path), skip_columns=("duration_ms",))
+    assert "duration_ms" not in nt.columns
+    assert nt.columns["track_name"].materialize().tolist() == ["a", "b"]
+    assert nt.pids.tolist() == [1, 2]
+
+
 def test_sample_ratio_head_slice(tmp_path):
     table = synthetic_table(n_playlists=30, n_tracks=25, target_rows=300, seed=13)
     path = str(tmp_path / "ds.csv")
