@@ -14,6 +14,12 @@ rule-tensor emission + host rule-dict expansion. Median of repeated runs,
 compile excluded via warm-up (the reference's 20.31 s excludes Python/lib
 import too).
 
+Structure: this parent process never imports jax. The mining phase and the
+serving phase each run in their OWN subprocess, sequentially — matching
+deployment (batch job pod vs API server pod are separate processes) and
+keeping the two phases from contending for the single TPU chip (libtpu is
+one-process-per-chip on real hardware).
+
 Prints ONE JSON line:
     {"metric": ..., "value": <median seconds>, "unit": "s",
      "vs_baseline": <baseline_s / value = speedup factor>}
@@ -25,77 +31,130 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
+import subprocess
 import sys
-import time
-
-if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from kmlserver_tpu.config import MiningConfig
-from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_baskets
-from kmlserver_tpu.mining.miner import mine
-from kmlserver_tpu.ops.serve import recommend_batch
+import tempfile
 
 BASELINE_RULE_GEN_S = 20.31  # relatorio.pdf p.6 (BASELINE.md row 1)
 MIN_SUPPORT = 0.05
 REPEATS = 5
+
+if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} ({dev.device_kind})")
+_MINING_BENCH = r"""
+import json, statistics, sys, time
+import numpy as np
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_baskets
+from kmlserver_tpu.mining.miner import mine
 
-    baskets = synthetic_baskets(**DS2_SHAPE, seed=123)
-    log(
-        f"workload: {len(baskets.playlist_rows)} memberships, "
-        f"{baskets.n_playlists} playlists, {baskets.n_tracks} tracks, "
-        f"min_support {MIN_SUPPORT} (ds2 shape)"
-    )
-    cfg = MiningConfig(min_support=MIN_SUPPORT, k_max_consequents=256)
+out_npz, min_support, repeats = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
 
-    # warm-up: compile every kernel in the bracket
+import jax
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+
+baskets = synthetic_baskets(**DS2_SHAPE, seed=123)
+print(
+    f"workload: {len(baskets.playlist_rows)} memberships, "
+    f"{baskets.n_playlists} playlists, {baskets.n_tracks} tracks, "
+    f"min_support {min_support} (ds2 shape)", file=sys.stderr, flush=True,
+)
+cfg = MiningConfig(min_support=min_support, k_max_consequents=256)
+
+# warm-up: compile every kernel in the bracket
+result = mine(baskets, cfg)
+result.tensors.to_rules_dict(result.vocab_names)
+print(f"warm-up mine: {result.duration_s:.3f}s (includes compile)",
+      file=sys.stderr, flush=True)
+
+times = []
+for i in range(repeats):
+    t0 = time.perf_counter()
     result = mine(baskets, cfg)
-    result.tensors.to_rules_dict(result.vocab_names)
-    log(f"warm-up mine: {result.duration_s:.3f}s (includes compile)")
+    rules_dict = result.tensors.to_rules_dict(result.vocab_names)
+    times.append(time.perf_counter() - t0)
+    print(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)",
+          file=sys.stderr, flush=True)
 
-    times = []
-    for i in range(REPEATS):
-        t0 = time.perf_counter()
-        result = mine(baskets, cfg)
-        rules_dict = result.tensors.to_rules_dict(result.vocab_names)
-        times.append(time.perf_counter() - t0)
-        log(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)")
-    median_s = statistics.median(times)
+np.savez(out_npz, rule_ids=result.tensors.rule_ids,
+         rule_confs=result.tensors.rule_confs)
+print(json.dumps({"median_s": statistics.median(times)}))
+"""
 
-    # serving context number (stderr only): batch-32 recommend p50
-    rule_ids = jax.device_put(jnp.asarray(result.tensors.rule_ids))
-    rule_confs = jax.device_put(jnp.asarray(result.tensors.rule_confs))
-    rng = np.random.default_rng(0)
-    seeds = jnp.asarray(
-        rng.integers(0, baskets.n_tracks, size=(32, 8), dtype=np.int32)
-    )
+_SERVING_BENCH = r"""
+import json, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+from kmlserver_tpu.ops.serve import recommend_batch
+
+with np.load(sys.argv[1]) as z:
+    rule_ids = jax.device_put(jnp.asarray(z["rule_ids"]))
+    rule_confs = jax.device_put(jnp.asarray(z["rule_confs"]))
+v = rule_ids.shape[0]
+rng = np.random.default_rng(0)
+seeds = jnp.asarray(rng.integers(0, v, size=(32, 8), dtype=np.int32))
+recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
+lat = []
+for _ in range(50):
+    t0 = time.perf_counter()
     recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
-    lat = []
-    for _ in range(50):
-        t0 = time.perf_counter()
-        recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
-        lat.append(time.perf_counter() - t0)
-    lat.sort()
-    log(
-        f"serving: batch-32 recommend p50 {lat[len(lat) // 2] * 1e3:.3f}ms "
-        f"({lat[len(lat) // 2] / 32 * 1e6:.1f}us/request)"
-    )
+    lat.append(time.perf_counter() - t0)
+lat.sort()
+print(json.dumps({"p50_ms": lat[len(lat) // 2] * 1e3}))
+"""
 
+
+def _run_phase(name: str, code: str, argv: list[str]) -> dict | None:
+    """Run one bench phase in its own process; → parsed result JSON
+    (last stdout line) or None on any failure (logged, fail-soft)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code, *argv],
+            capture_output=True, text=True, timeout=1800,
+            env=os.environ.copy(), cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as exc:
+        for line in (exc.stderr or "").splitlines():
+            log(line)
+        log(f"{name} phase timed out after {exc.timeout}s")
+        return None
+    for line in proc.stderr.splitlines():
+        log(line)
+    if proc.returncode != 0:
+        log(f"{name} phase failed (exit {proc.returncode})")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError) as exc:
+        log(f"{name} phase produced unparseable output: {exc}")
+        return None
+
+
+def main() -> int:
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        mining = _run_phase(
+            "mining", _MINING_BENCH, [f.name, str(MIN_SUPPORT), str(REPEATS)]
+        )
+        if mining is None:
+            return 1
+        # serving context number (stderr only): batch-32 recommend p50 in a
+        # fresh process, like the real API server
+        serving = _run_phase("serving", _SERVING_BENCH, [f.name])
+    if serving is not None:
+        p50 = serving["p50_ms"]
+        log(
+            f"serving: batch-32 recommend p50 {p50:.3f}ms "
+            f"({p50 / 32 * 1e3:.1f}us/request)"
+        )
+    median_s = mining["median_s"]
     print(
         json.dumps(
             {

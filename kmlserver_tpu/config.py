@@ -80,7 +80,10 @@ class MiningConfig:
     # Minimum confidence when confidence_mode == "confidence"
     # (reference slow path hardcodes 0.04 — machine-learning/main.py:226-227).
     min_confidence: float = 0.04
-    # Device-mesh shape for sharded mining: "auto", "1x1", "dpxtp" e.g. "4x1".
+    # Device-mesh shape for sharded mining: "auto", "1x1", "dpxtp" e.g.
+    # "4x1", or "hybrid"/"hybrid:tpN" (DCN×ICI layout for multi-host — tp
+    # pinned to intra-host devices). "auto" picks hybrid automatically when
+    # the multi-host runtime is active (KMLS_COORDINATOR_ADDRESS set).
     mesh_shape: str = "auto"
     # Use the bit-packed popcount path instead of int8 matmul when the
     # one-hot matrix would exceed this many elements.

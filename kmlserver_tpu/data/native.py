@@ -31,7 +31,22 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libkmls_csv.so")
 _lib: ctypes.CDLL | None = None
 
 
+# must match KMLS_ABI_VERSION in native/kmls_csv.cpp
+_ABI_VERSION = 2
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    try:
+        lib.kmls_abi_version.restype = ctypes.c_int32
+        lib.kmls_abi_version.argtypes = []
+        got = lib.kmls_abi_version()
+    except AttributeError:  # pre-versioning build
+        raise OSError("native CSV loader .so predates ABI versioning")
+    if got != _ABI_VERSION:
+        raise OSError(
+            f"native CSV loader ABI {got} != expected {_ABI_VERSION} "
+            f"(stale build: run make -C native)"
+        )
     lib.kmls_read_csv.restype = ctypes.c_void_p
     lib.kmls_read_csv.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.kmls_table_error.restype = ctypes.c_char_p
@@ -60,9 +75,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def ensure_built(quiet: bool = True) -> bool:
-    """Build the .so if missing; returns availability."""
-    if os.path.exists(_SO_PATH):
-        return True
+    """Build (or incrementally rebuild) the .so; returns availability.
+
+    Always runs make — its kmls_csv.cpp dependency makes this a no-op when
+    current, and it replaces a STALE .so left by an older checkout, which
+    would otherwise silently serve an outdated parser ABI."""
     try:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR],
@@ -70,7 +87,7 @@ def ensure_built(quiet: bool = True) -> bool:
             capture_output=quiet,
         )
     except (subprocess.CalledProcessError, FileNotFoundError):
-        return False
+        return os.path.exists(_SO_PATH)  # no toolchain: use what exists
     return os.path.exists(_SO_PATH)
 
 
@@ -81,7 +98,7 @@ def _load() -> ctypes.CDLL | None:
         return None
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH) and not ensure_built():
+    if not ensure_built():
         return None
     try:
         _lib = _bind(ctypes.CDLL(_SO_PATH))

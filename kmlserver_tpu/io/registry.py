@@ -60,9 +60,13 @@ def read_dataset_list(cfg: MiningConfig) -> list[str]:
     return [line for line in (l.strip() for l in text.splitlines()) if line]
 
 
-def get_dataset_list(cfg: MiningConfig) -> list[str]:
+def get_dataset_list(cfg: MiningConfig, persist: bool = True) -> list[str]:
     """First run: discover + persist; later runs: read the persisted list
-    (reference: main.py:315-346 call pattern at :425)."""
+    (reference: main.py:315-346 call pattern at :425).
+
+    ``persist=False`` skips the first-run write — non-zero ranks of a
+    multi-host job must not race rank 0 on the shared PVC (the sorted glob
+    over the same volume is deterministic, so every rank sees one list)."""
     path = _datasets_list_path(cfg)
     if os.path.exists(path):
         existing = read_dataset_list(cfg)
@@ -73,7 +77,8 @@ def get_dataset_list(cfg: MiningConfig) -> list[str]:
         raise FileNotFoundError(
             f"no datasets matching {cfg.regex_filename!r} under {cfg.datasets_dir!r}"
         )
-    write_dataset_list(cfg, datasets)
+    if persist:
+        write_dataset_list(cfg, datasets)
     return datasets
 
 

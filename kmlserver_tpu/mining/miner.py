@@ -38,6 +38,7 @@ import numpy as np
 
 from ..config import MiningConfig
 from ..ops import encode, rules, support
+from ..utils.profiling import PhaseTimer, trace_session
 from .vocab import Baskets, Vocab
 
 
@@ -51,6 +52,7 @@ class MiningResult:
     duration_s: float
     pruned_vocab: int | None = None  # size after pruning, when it ran
     itemset_census: dict[int, int] | None = None  # length → frequent-itemset count
+    phase_timings: dict[str, float] | None = None  # profiling detail (§5)
 
 
 def pair_count_fn(
@@ -166,31 +168,42 @@ def mine(
     mesh: "jax.sharding.Mesh | None" = None,
 ) -> MiningResult:
     """Run the full mining compute, timed like the reference's rule step."""
+    timer = PhaseTimer()
     t0 = time.perf_counter()
     n_total = baskets.n_tracks
     pruned_vocab = None
     mined_baskets = baskets
-    if baskets.n_tracks > cfg.prune_vocab_threshold:
-        min_count = support.min_count_for(cfg.min_support, baskets.n_playlists)
-        mined_baskets, _ = prune_infrequent(baskets, min_count)
-        pruned_vocab = mined_baskets.n_tracks
-    counts, x = pair_count_fn(
-        mined_baskets, mesh, bitpack_threshold_elems=cfg.bitpack_threshold_elems
-    )
-    jax.block_until_ready(counts)
-    tensors = rules.mine_rules_from_counts(
-        counts,
-        n_playlists=mined_baskets.n_playlists,
-        min_support=cfg.min_support,
-        k_max=cfg.k_max_consequents,
-        mode=cfg.confidence_mode,
-        min_confidence=cfg.min_confidence,
-        n_total_songs=n_total,
-    )
-    duration = time.perf_counter() - t0
-    census = None
-    if cfg.max_itemset_len >= 3:
-        census = _itemset_census(x, counts, tensors.min_count, cfg.max_itemset_len)
+    with trace_session("mine"):
+        if baskets.n_tracks > cfg.prune_vocab_threshold:
+            with timer.phase("apriori_prune"):
+                min_count = support.min_count_for(
+                    cfg.min_support, baskets.n_playlists
+                )
+                mined_baskets, _ = prune_infrequent(baskets, min_count)
+                pruned_vocab = mined_baskets.n_tracks
+        with timer.phase("pair_counts"):
+            counts, x = pair_count_fn(
+                mined_baskets, mesh,
+                bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+            )
+            jax.block_until_ready(counts)
+        with timer.phase("rule_emission"):
+            tensors = rules.mine_rules_from_counts(
+                counts,
+                n_playlists=mined_baskets.n_playlists,
+                min_support=cfg.min_support,
+                k_max=cfg.k_max_consequents,
+                mode=cfg.confidence_mode,
+                min_confidence=cfg.min_confidence,
+                n_total_songs=n_total,
+            )
+        duration = time.perf_counter() - t0
+        census = None
+        if cfg.max_itemset_len >= 3:
+            with timer.phase("itemset_census"):
+                census = _itemset_census(
+                    x, counts, tensors.min_count, cfg.max_itemset_len
+                )
     return MiningResult(
         tensors=tensors,
         vocab_names=list(mined_baskets.vocab.names),
@@ -199,4 +212,5 @@ def mine(
         duration_s=duration,
         pruned_vocab=pruned_vocab,
         itemset_census=census,
+        phase_timings=dict(timer.phases),
     )

@@ -46,7 +46,13 @@ def run_mining_job(
 ) -> JobSummary:
     print(f"Job starting at {get_current_time_str()}")
 
-    datasets = registry.get_dataset_list(cfg)
+    # Multi-host: every rank participates in the sharded compute (the
+    # collectives need all processes), but only rank 0 touches the shared
+    # PVC — duplicate history appends would corrupt the dataset rotation,
+    # and concurrent artifact writes could tear what the API replicas read.
+    is_writer = jax.process_index() == 0
+
+    datasets = registry.get_dataset_list(cfg, persist=is_writer)
     run_index = registry.get_next_run_index(cfg, datasets)
     selected = datasets[run_index - BASE_INDEX]
     print(f"Selected dataset {run_index}/{len(datasets)}: {selected}")
@@ -61,22 +67,27 @@ def run_mining_job(
 
     # auxiliary vocab artifacts (reference M5-M8: main.py:438-446)
     artists = vocab_mod.validate_and_map_artists(table)
-    paths["artists_mapping"] = _pickle_path(cfg, cfg.artists_mapping_file)
-    artifacts.save_pickle(artists, paths["artists_mapping"])
+    if is_writer:
+        paths["artists_mapping"] = _pickle_path(cfg, cfg.artists_mapping_file)
+        artifacts.save_pickle(artists, paths["artists_mapping"])
 
     repeated = vocab_mod.extract_repeated_track_names(table)
-    if repeated:  # the reference saves this one conditionally (main.py:86-109)
+    if repeated and is_writer:
+        # the reference saves this one conditionally (main.py:86-109)
         paths["repeated_tracks"] = _pickle_path(cfg, cfg.repeated_tracks_file)
         artifacts.save_pickle(repeated, paths["repeated_tracks"])
 
     info = vocab_mod.map_track_ids_to_info(table)
-    paths["track_info"] = _pickle_path(cfg, cfg.track_info_file)
-    artifacts.save_pickle(info, paths["track_info"])
-
     best = vocab_mod.most_frequent_tracks(table, cfg.top_tracks_save_percentile)
-    paths["best_tracks"] = _pickle_path(cfg, cfg.best_tracks_file)
-    artifacts.save_pickle(best, paths["best_tracks"])
-    print(f"Saved {len(best)} best tracks (top {cfg.top_tracks_save_percentile:.0%})")
+    if is_writer:
+        paths["track_info"] = _pickle_path(cfg, cfg.track_info_file)
+        artifacts.save_pickle(info, paths["track_info"])
+        paths["best_tracks"] = _pickle_path(cfg, cfg.best_tracks_file)
+        artifacts.save_pickle(best, paths["best_tracks"])
+        print(
+            f"Saved {len(best)} best tracks "
+            f"(top {cfg.top_tracks_save_percentile:.0%})"
+        )
 
     # the compute core
     baskets = vocab_mod.build_baskets(table)
@@ -89,6 +100,10 @@ def run_mining_job(
         )
     print(f"Songs without recommendations: {tensors.n_songs_missing}")
     print(f"Time elapsed in rule generation: {result.duration_s:.2f}s")
+    if result.phase_timings:
+        from ..utils.profiling import format_phases
+
+        print(format_phases(result.phase_timings).capitalize())
     if result.itemset_census is not None:
         census = ", ".join(
             f"len {k}: {'not enumerated' if v < 0 else v}"
@@ -103,23 +118,26 @@ def run_mining_job(
         )
 
     rules_dict = tensors.to_rules_dict(result.vocab_names)
-    paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
-    artifacts.save_pickle(rules_dict, paths["recommendations"])
-    if cfg.write_tensor_artifact:
-        paths["rule_tensors"] = artifacts.tensor_artifact_path(paths["recommendations"])
-        artifacts.save_rule_tensors(
-            paths["rule_tensors"],
-            vocab=result.vocab_names,
-            rule_ids=tensors.rule_ids,
-            rule_counts=tensors.rule_counts,
-            item_counts=tensors.item_counts,
-            n_playlists=result.n_playlists,
-            min_support=cfg.min_support,
-            mode=tensors.mode,
-            min_confidence=tensors.min_confidence,
-        )
-
-    token = registry.append_history_and_invalidate(cfg, run_index, selected)
+    token = ""
+    if is_writer:
+        paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
+        artifacts.save_pickle(rules_dict, paths["recommendations"])
+        if cfg.write_tensor_artifact:
+            paths["rule_tensors"] = artifacts.tensor_artifact_path(
+                paths["recommendations"]
+            )
+            artifacts.save_rule_tensors(
+                paths["rule_tensors"],
+                vocab=result.vocab_names,
+                rule_ids=tensors.rule_ids,
+                rule_counts=tensors.rule_counts,
+                item_counts=tensors.item_counts,
+                n_playlists=result.n_playlists,
+                min_support=cfg.min_support,
+                mode=tensors.mode,
+                min_confidence=tensors.min_confidence,
+            )
+        token = registry.append_history_and_invalidate(cfg, run_index, selected)
     print(f"Job finished at {get_current_time_str()}")
 
     return JobSummary(

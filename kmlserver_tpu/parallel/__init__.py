@@ -1,1 +1,1 @@
-from . import mesh, support  # noqa: F401
+from . import distributed, mesh, support  # noqa: F401

@@ -1,0 +1,124 @@
+"""Multi-host distributed runtime — coordinator bootstrap + hybrid DCN×ICI
+meshes.
+
+The reference has no distributed communication backend at all: its
+inter-process "bus" is a shared RWX filesystem plus a polled token file
+(reference: kubernetes/pvc.yaml:10-11, machine-learning/main.py:406-408,
+rest_api/app/main.py:82-97; SURVEY.md §2.4 documents the absence of
+NCCL/MPI/Gloo explicitly). The rebuild's equivalent is the JAX/XLA
+distributed runtime: one process per TPU host, a gRPC coordinator for
+process bootstrap, and XLA collectives for all data-plane communication —
+riding ICI within a slice and DCN across slices/hosts. The PVC + token
+protocol is deliberately retained for the batch→serve artifact handoff (it
+is the reference's versioning mechanism); this module only replaces what the
+reference *couldn't* do: scaling one mining computation across hosts.
+
+Bootstrap is env-driven so the same container works as a single-host job, an
+indexed k8s Job (`JOB_COMPLETION_INDEX`), or a GKE TPU multi-host node pool
+(where jax.distributed auto-detects from the TPU metadata server):
+
+- ``KMLS_COORDINATOR_ADDRESS`` — host:port of process 0. Unset → no-op
+  single-process mode.
+- ``KMLS_NUM_PROCESSES`` — world size.
+- ``KMLS_PROCESS_ID`` — explicit rank; falls back to
+  ``JOB_COMPLETION_INDEX`` (k8s indexed Job downward API).
+
+Mesh layout rule (the scaling-book recipe): the mesh axis with the highest
+communication volume per step — here ``tp``, whose ring/all-gather moves
+pair-count blocks every step — must map to ICI (devices within a host/slice,
+the innermost mesh dimension); ``dp``, which communicates only in the final
+``psum`` of partial counts, tolerates DCN and maps to the outermost (cross-
+host) dimension. ``make_hybrid_mesh`` encodes exactly that.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXIS_DP, AXIS_TP
+
+logger = logging.getLogger("kmlserver_tpu.distributed")
+
+COORDINATOR_ENV = "KMLS_COORDINATOR_ADDRESS"
+NUM_PROCESSES_ENV = "KMLS_NUM_PROCESSES"
+PROCESS_ID_ENV = "KMLS_PROCESS_ID"
+K8S_INDEX_ENV = "JOB_COMPLETION_INDEX"
+
+_initialized = False
+
+
+def distributed_env() -> tuple[str, int, int] | None:
+    """→ (coordinator, num_processes, process_id) or None (single-process)."""
+    coordinator = os.getenv(COORDINATOR_ENV)
+    if not coordinator:
+        return None
+    num = int(os.getenv(NUM_PROCESSES_ENV, "1"))
+    raw_id = os.getenv(PROCESS_ID_ENV) or os.getenv(K8S_INDEX_ENV) or "0"
+    process_id = int(raw_id)
+    if process_id >= num:
+        # e.g. an indexed k8s Job where KMLS_NUM_PROCESSES was forgotten:
+        # fail with a clear config error instead of a bootstrap hang
+        raise ValueError(
+            f"process_id {process_id} >= num_processes {num}: set "
+            f"{NUM_PROCESSES_ENV} to the Job's completion count"
+        )
+    return coordinator, num, process_id
+
+
+def maybe_initialize() -> bool:
+    """Join the distributed runtime when configured; idempotent; False when
+    running single-process. Must run before the first device access."""
+    global _initialized
+    if _initialized:
+        return True
+    env = distributed_env()
+    if env is None:
+        return False
+    coordinator, num_processes, process_id = env
+    logger.info(
+        "joining distributed runtime: coordinator=%s rank=%d/%d",
+        coordinator, process_id, num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def make_hybrid_mesh(
+    dp_per_host: int | None = None,
+    tp: int | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """A ``(dp, tp)`` mesh laid out for the hardware fabric: ``tp`` packed
+    within each host's devices (ICI), ``dp`` spanning hosts (DCN) × the
+    leftover intra-host factor.
+
+    Defaults: ``tp`` = all of one host's local devices (max ICI width for
+    the block-exchange axis), ``dp`` = number of hosts. Works identically on
+    one process (then dp×tp just factors the local device count).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    n_hosts = max(len({d.process_index for d in devices}), 1)
+    local = n // n_hosts
+    if tp is None:
+        tp = local if dp_per_host is None else max(local // dp_per_host, 1)
+    if local % tp != 0:
+        raise ValueError(
+            f"tp={tp} must divide the per-host device count {local}"
+        )
+    dp = n // tp
+    # order devices host-major, so reshape(dp, tp) keeps each tp row within
+    # one host: tp collectives ride ICI, never DCN
+    ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+    grid = np.asarray(ordered).reshape(dp, tp)
+    return Mesh(grid, (AXIS_DP, AXIS_TP))

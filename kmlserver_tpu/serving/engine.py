@@ -120,6 +120,11 @@ class RecommendEngine:
         """Build a fresh bundle from the PVC; atomic swap on success.
         Returns False (fail-soft) when artifacts aren't there yet."""
         with self._reload_lock:
+            # re-check under the lock: concurrent "nudge" threads that queued
+            # behind an in-flight load must not repeat it (their staleness
+            # decision predates the load that just completed)
+            if self.finished_loading and not self.is_data_stale():
+                return True
             cfg = self.cfg
             best_path = os.path.join(cfg.pickles_dir, cfg.best_tracks_file)
             rec_path = os.path.join(cfg.pickles_dir, cfg.recommendations_file)

@@ -1,0 +1,90 @@
+"""Tracing / profiling — the subsystem the reference does NOT have.
+
+The reference's entire observability for compute cost is a wall-clock bracket
+around rule generation printed to stdout (reference:
+machine-learning/main.py:264,306-308) plus the disabled sweep harness's
+per-support durations (machine-learning/main.py:462-473). SURVEY.md §5
+prescribes the TPU-native replacement: ``jax.profiler`` device traces plus
+``block_until_ready``-bracketed host timers, while preserving the printed
+``Time elapsed in rule generation`` line for log parity.
+
+Two layers, both zero-cost when disabled:
+
+- :func:`trace_session` — a ``jax.profiler`` trace of a whole region, dumped
+  to ``$KMLS_PROFILE_DIR`` (TensorBoard/XProf-readable; contains XLA device
+  timelines, HLO names, HBM allocations). Enabled only when the env var is
+  set: profiling must be opt-in in production serving.
+- :class:`PhaseTimer` — named host-side phase timings with explicit
+  ``block_until_ready`` discipline (a device call isn't "done" at dispatch;
+  timing without a sync fence measures nothing). Each phase is also wrapped
+  in a ``jax.profiler.TraceAnnotation`` so host phases line up against the
+  device timeline inside the dumped trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Iterator
+
+import jax
+
+PROFILE_DIR_ENV = "KMLS_PROFILE_DIR"
+
+
+def profile_dir() -> str | None:
+    """The trace dump directory, or None when profiling is disabled."""
+    raw = os.getenv(PROFILE_DIR_ENV)
+    return raw if raw else None
+
+
+@contextlib.contextmanager
+def trace_session(label: str) -> Iterator[None]:
+    """``jax.profiler`` trace of the enclosed region when profiling is
+    enabled (``$KMLS_PROFILE_DIR`` set), else a no-op. Safe to nest inside —
+    but not around — another active trace."""
+    target = profile_dir()
+    if target is None:
+        yield
+        return
+    path = os.path.join(target, label)
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+class PhaseTimer:
+    """Named phase timings with device-sync fencing.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("pair_counts", counts):   # fences on `counts`
+    ...     counts = pair_counts(x)
+    """
+
+    def __init__(self) -> None:
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, *fence: Any) -> Iterator[None]:
+        """Time the enclosed block under ``name``. Any ``fence`` values given
+        at entry are block_until_ready'd FIRST so queued prior device work
+        isn't billed to this phase; the block's own device outputs should be
+        fenced by the block itself (or be host work)."""
+        for f in fence:
+            jax.block_until_ready(f)
+        with jax.profiler.TraceAnnotation(f"kmls:{name}"):
+            t0 = time.perf_counter()
+            yield
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def report(self) -> str:
+        """One log line, reference-log style."""
+        return format_phases(self.phases)
+
+
+def format_phases(phases: dict[str, float]) -> str:
+    parts = ", ".join(f"{k} {v:.3f}s" for k, v in phases.items())
+    return f"phase timings: {parts}" if parts else "phase timings: (none)"

@@ -105,7 +105,14 @@ struct Column {
 
 }  // namespace
 
+// Bumped whenever the exported C surface or parse semantics change; the
+// Python binding refuses a .so whose version doesn't match, so a stale
+// build from an older checkout can never silently serve the old parser.
+#define KMLS_ABI_VERSION 2
+
 extern "C" {
+
+int32_t kmls_abi_version(void) { return KMLS_ABI_VERSION; }
 
 struct kmls_table {
   std::vector<int64_t> pids;
@@ -238,6 +245,14 @@ kmls_table* kmls_read_csv(const char* path, const char* skip_cols) {
         trailing_comma = false;
         break;
       }
+    }
+    // a comma consumed right before EOF carries one last EMPTY field that
+    // the loop above couldn't enter for (p >= end) — same row WITH a final
+    // newline parses that empty field normally, so EOF must match
+    if (trailing_comma && p >= end && col < ncols) {
+      fields[col].clear();
+      ++col;
+      trailing_comma = false;
     }
     // a well-formed row ends exactly at EOL/EOF; extra fields after the
     // ncols-th are an error, including a lone trailing empty one (the comma

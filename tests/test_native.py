@@ -94,6 +94,48 @@ def test_invalid_pid_errors_not_zero(tmp_path):
         native.read_csv_native(str(path))
 
 
+def test_trailing_empty_field_at_eof_parses(tmp_path):
+    # "1,a," with no final newline: the trailing comma carries one empty
+    # final field; must parse identically to the same row WITH a newline
+    for suffix in ("", "\n"):
+        path = tmp_path / f"eof{len(suffix)}.csv"
+        path.write_text("pid,track_name,artist_name\n1,a,b\n2,c," + suffix)
+        nt = native.read_csv_native(str(path))
+        assert nt.pids.tolist() == [1, 2]
+        assert nt.columns["artist_name"].materialize().tolist() == ["b", ""]
+
+
+def test_stale_abi_refused(tmp_path, monkeypatch):
+    # a .so exporting the wrong (or no) ABI version must be refused
+    assert native._ABI_VERSION == 2
+    lib = native._load()
+    assert lib is not None
+    class FakeOld:
+        def __getattr__(self, name):
+            raise AttributeError(name)
+    with pytest.raises(OSError, match="ABI|predates"):
+        native._bind(FakeOld())
+
+
+def test_float_pid_rejected_on_both_paths(tmp_path, monkeypatch):
+    # a float-like pid ("1.5") must be a parse error on BOTH loader paths —
+    # the pandas fallback must not silently truncate it into playlist 1
+    path = tmp_path / "floatpid.csv"
+    path.write_text("pid,track_name\n1.5,x\n2,y\n")
+    with pytest.raises(ValueError, match="pid"):
+        read_tracks(str(path))  # native path raises, falls back, pandas raises
+    monkeypatch.setenv("KMLS_NATIVE", "0")
+    monkeypatch.setattr(native, "_lib", None)
+    with pytest.raises(ValueError, match="pid"):
+        read_tracks(str(path))
+    # out-of-int64-range pid must error on the pandas path too (the native
+    # parser already rejects it via strtoll ERANGE), never wrap
+    over = tmp_path / "overpid.csv"
+    over.write_text("pid,track_name\n9223372036854775808,x\n")
+    with pytest.raises(ValueError, match="pid"):
+        read_tracks(str(over))
+
+
 def test_empty_cell_parity_with_pandas(tmp_path, monkeypatch):
     # empty string cells must read identically ("") on both loader paths
     path = tmp_path / "empty.csv"

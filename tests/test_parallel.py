@@ -61,3 +61,59 @@ def test_sharded_counts_match_single_device(baskets, shape, impl):
 def test_unknown_impl_raises(baskets):
     with pytest.raises(ValueError):
         sharded_pair_counts(baskets, mesh_mod.make_mesh("8x1"), impl="nope")
+
+
+class TestDistributed:
+    """Multi-host bootstrap + hybrid-mesh layout (single-process here; the
+    env parsing and mesh-layout rules are what's testable without N hosts —
+    the driver's dryrun_multichip covers the jitted collective path)."""
+
+    def test_env_absent_is_single_process(self, monkeypatch):
+        from kmlserver_tpu.parallel import distributed
+
+        monkeypatch.delenv(distributed.COORDINATOR_ENV, raising=False)
+        assert distributed.distributed_env() is None
+        assert distributed.maybe_initialize() is False
+
+    def test_env_parsing_with_k8s_index_fallback(self, monkeypatch):
+        from kmlserver_tpu.parallel import distributed
+
+        monkeypatch.setenv(distributed.COORDINATOR_ENV, "coord:1234")
+        monkeypatch.setenv(distributed.NUM_PROCESSES_ENV, "4")
+        monkeypatch.delenv(distributed.PROCESS_ID_ENV, raising=False)
+        monkeypatch.setenv(distributed.K8S_INDEX_ENV, "3")
+        assert distributed.distributed_env() == ("coord:1234", 4, 3)
+        monkeypatch.setenv(distributed.PROCESS_ID_ENV, "2")  # explicit wins
+        assert distributed.distributed_env() == ("coord:1234", 4, 2)
+
+    def test_rank_without_world_size_is_config_error(self, monkeypatch):
+        from kmlserver_tpu.parallel import distributed
+
+        monkeypatch.setenv(distributed.COORDINATOR_ENV, "coord:1234")
+        monkeypatch.delenv(distributed.NUM_PROCESSES_ENV, raising=False)
+        monkeypatch.setenv(distributed.K8S_INDEX_ENV, "3")
+        with pytest.raises(ValueError, match="num_processes"):
+            distributed.distributed_env()
+
+    def test_hybrid_mesh_factors_local_devices(self):
+        from kmlserver_tpu.parallel import distributed
+
+        m = distributed.make_hybrid_mesh(tp=4)
+        assert m.shape[mesh_mod.AXIS_DP] == len(jax.devices()) // 4
+        assert m.shape[mesh_mod.AXIS_TP] == 4
+        # tp rows must be intra-host (ICI, not DCN)
+        for row in m.devices:
+            assert len({d.process_index for d in row}) == 1
+
+    def test_hybrid_mesh_rejects_nondivisor_tp(self):
+        from kmlserver_tpu.parallel import distributed
+
+        with pytest.raises(ValueError):
+            distributed.make_hybrid_mesh(tp=3)
+
+    def test_hybrid_mesh_counts_match_single_device(self, baskets):
+        from kmlserver_tpu.parallel import distributed
+
+        m = distributed.make_hybrid_mesh(tp=2)
+        got = np.asarray(sharded_pair_counts(baskets, m, impl="ring"))
+        np.testing.assert_array_equal(got, single_device_counts(baskets))

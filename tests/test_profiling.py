@@ -1,0 +1,57 @@
+"""Tracing/profiling subsystem (SURVEY.md §5): host phase timers with device
+fencing, opt-in jax.profiler traces, and the miner's phase report."""
+
+import os
+
+import numpy as np
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.mining.vocab import build_baskets
+from kmlserver_tpu.utils import profiling
+
+from .oracle import random_baskets
+from .test_ops import table_from_baskets
+
+
+def test_phase_timer_accumulates_and_reports():
+    t = profiling.PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert set(t.phases) == {"a", "b"}
+    assert t.phases["a"] >= 0.0
+    assert "a " in t.report() and "b " in t.report()
+
+
+def test_trace_session_noop_without_env(monkeypatch, tmp_path):
+    monkeypatch.delenv(profiling.PROFILE_DIR_ENV, raising=False)
+    with profiling.trace_session("unit"):
+        pass
+    assert profiling.profile_dir() is None
+
+
+def test_trace_session_dumps_trace(monkeypatch, tmp_path):
+    monkeypatch.setenv(profiling.PROFILE_DIR_ENV, str(tmp_path))
+    with profiling.trace_session("unit"):
+        import jax.numpy as jnp
+
+        (jnp.arange(8) + 1).block_until_ready()
+    dumped = list(os.walk(tmp_path / "unit"))
+    # jax.profiler.trace writes a plugins/profile/<ts>/ tree
+    assert any(files for _, _, files in dumped)
+
+
+def test_mine_reports_phase_timings():
+    rng = np.random.default_rng(3)
+    baskets = build_baskets(
+        table_from_baskets(random_baskets(rng, n_playlists=40, n_tracks=24, mean_len=5))
+    )
+    result = mine(baskets, MiningConfig(min_support=0.05, k_max_consequents=8))
+    assert result.phase_timings is not None
+    assert "pair_counts" in result.phase_timings
+    assert "rule_emission" in result.phase_timings
+    assert sum(result.phase_timings.values()) <= result.duration_s + 0.5
