@@ -86,6 +86,7 @@ def save_rule_tensors(
     min_support: float,
     mode: str = "support",
     min_confidence: float = 0.0,
+    rule_confs64: np.ndarray | None = None,
 ) -> None:
     """Write the padded rule tensors + vocabulary as one ``.npz``.
 
@@ -97,6 +98,9 @@ def save_rule_tensors(
     ``item_counts`` int32 (V,) — singleton supports; items with
                     count ≥ ceil(min_support·P) are the rule-dict key set
                     (including empty rows — see ops/rules.py).
+    ``rule_confs64`` float64 (V, K_max), only when confidences carry
+                    per-rule denominators (triple-antecedent merge) and so
+                    cannot be re-derived from counts.
     """
     if rule_ids.shape != rule_counts.shape:
         raise ValueError(f"rule_ids {rule_ids.shape} != rule_counts {rule_counts.shape}")
@@ -104,9 +108,7 @@ def save_rule_tensors(
         raise ValueError(
             f"rows {rule_ids.shape[0]}/{len(item_counts)} != vocab size {len(vocab)}"
         )
-    buf = io.BytesIO()
-    np.savez_compressed(
-        buf,
+    arrays = dict(
         vocab=np.asarray(vocab, dtype=object),
         rule_ids=rule_ids.astype(np.int32),
         rule_counts=rule_counts.astype(np.int32),
@@ -116,6 +118,14 @@ def save_rule_tensors(
         mode=np.asarray(mode),
         min_confidence=np.float64(min_confidence),
     )
+    if rule_confs64 is not None:
+        if rule_confs64.shape != rule_ids.shape:
+            raise ValueError(
+                f"rule_confs64 {rule_confs64.shape} != rule_ids {rule_ids.shape}"
+            )
+        arrays["rule_confs64"] = rule_confs64.astype(np.float64)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
     _atomic_write_bytes(path, buf.getvalue())
 
 
@@ -128,12 +138,27 @@ def load_rule_tensors(path: str) -> dict[str, Any]:
         item_counts = npz["item_counts"]
         n_playlists = int(npz["n_playlists"])
         mode = str(npz["mode"])
-        confs = derive_confs(rule_counts, item_counts, n_playlists, mode)
+        confs64 = npz["rule_confs64"] if "rule_confs64" in npz.files else None
+        rule_ids = npz["rule_ids"]
+        if confs64 is None and bool(((rule_ids >= 0) & (rule_counts <= 0)).any()):
+            # valid rules with zero counts can only come from a
+            # triple-merged artifact whose rule_confs64 was stripped —
+            # re-deriving would silently turn every confidence into 0.0
+            raise ValueError(
+                f"{path}: rules present with zero counts and no rule_confs64 "
+                f"— corrupt or stripped artifact"
+            )
+        confs = (
+            confs64.astype(np.float32)
+            if confs64 is not None
+            else derive_confs(rule_counts, item_counts, n_playlists, mode)
+        )
         return {
             "vocab": [str(s) for s in npz["vocab"]],
             "rule_ids": npz["rule_ids"],
             "rule_counts": rule_counts,
             "rule_confs": confs,
+            "rule_confs64": confs64,
             "item_counts": item_counts,
             "n_playlists": n_playlists,
             "min_support": float(npz["min_support"]),
@@ -158,6 +183,7 @@ def rules_dict_from_tensors(loaded: dict[str, Any]) -> dict[str, dict[str, float
         n_playlists=loaded["n_playlists"],
         min_support=loaded["min_support"],
         mode=loaded["mode"],
+        rule_confs64=loaded.get("rule_confs64"),
     )
 
 

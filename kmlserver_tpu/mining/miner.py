@@ -53,6 +53,10 @@ class MiningResult:
     pruned_vocab: int | None = None  # size after pruning, when it ran
     itemset_census: dict[int, int] | None = None  # length → frequent-itemset count
     phase_timings: dict[str, float] | None = None  # profiling detail (§5)
+    # confidence mode with max_itemset_len >= 3: True when the triple-rule
+    # merge ran, False when it had to be skipped (confidences pairwise-only),
+    # None when not applicable
+    triple_merge_applied: bool | None = None
 
 
 def pair_count_fn(
@@ -100,34 +104,67 @@ def pair_count_fn(
     return support.pair_counts(x), x
 
 
+PAIR_CAPACITY = 1 << 16
+
+
+def compute_triple_extension(
+    x: jax.Array,
+    counts: jax.Array,
+    min_count: int,
+    pair_capacity: int = PAIR_CAPACITY,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int] | None:
+    """Frequent pairs + their triple extensions, computed ONCE and shared by
+    the itemset census and the confidence-mode triple-rule merge.
+
+    → ``(pair_i, pair_j, pair_counts, triple_counts, n_pairs)`` as host
+    arrays, or None when the frequent-pair count overflows ``pair_capacity``
+    (reported honestly by the caller rather than silently truncated)."""
+    pair_i, pair_j, pair_counts, n_pairs = support.frequent_pairs(
+        counts, jnp.int32(min_count), capacity=pair_capacity
+    )
+    n_pairs = int(n_pairs)
+    if n_pairs > pair_capacity:
+        return None
+    t = support.triple_counts(
+        x, jnp.where(pair_i >= 0, pair_i, 0), jnp.where(pair_j >= 0, pair_j, 0)
+    )
+    return (
+        np.asarray(pair_i),
+        np.asarray(pair_j),
+        np.asarray(pair_counts),
+        np.asarray(t),
+        n_pairs,
+    )
+
+
 def _itemset_census(
-    x: jax.Array | None,
     counts: jax.Array,
     min_count: int,
     max_len: int,
-    pair_capacity: int = 1 << 16,
+    triple_data: tuple | None,
+    n_pairs: int | None,
 ) -> dict[int, int]:
-    """Exact frequent-itemset counts per length (1, 2, and — via pair
-    extension on the MXU over the already-built one-hot ``x`` — 3). Lengths
-    beyond 3, and length 3 when ``x`` isn't materialized (sharded mining),
-    are reported as -1 (not enumerated) rather than silently dropped."""
+    """Exact frequent-itemset counts per length (1, 2, and — via the shared
+    triple extension — 3). Lengths beyond 3, and length 3 when the extension
+    isn't available (sharded mining / capacity overflow), are reported as -1
+    (not enumerated) rather than silently dropped."""
     item_counts = np.asarray(jnp.diagonal(counts))
     census = {1: int((item_counts >= min_count).sum())}
     if max_len < 2:
         return census
-    pair_i, pair_j, _, n_pairs = support.frequent_pairs(
-        counts, jnp.int32(min_count), capacity=pair_capacity
-    )
-    n_pairs = int(n_pairs)
+    if n_pairs is None:
+        n_pairs = int(
+            support.frequent_pairs(
+                counts, jnp.int32(min_count), capacity=1
+            )[3]
+        )
     census[2] = n_pairs
     if max_len < 3:
         return census
-    if n_pairs > pair_capacity or x is None:
+    if triple_data is None:
         census[3] = -1  # capacity overflow / sharded x: report honestly
         return census
-    t = support.triple_counts(x, jnp.where(pair_i >= 0, pair_i, 0), jnp.where(pair_j >= 0, pair_j, 0))
-    t = np.asarray(t)
-    pi, pj = np.asarray(pair_i), np.asarray(pair_j)
+    pi, pj, _, t, _ = triple_data
     valid_rows = pi >= 0
     v = t.shape[1]
     k_ids = np.arange(v)[None, :]
@@ -197,12 +234,61 @@ def mine(
                 min_confidence=cfg.min_confidence,
                 n_total_songs=n_total,
             )
+        triple_data = None
+        triple_merge_applied = None
+        needs_triples = (
+            cfg.confidence_mode == "confidence" and cfg.max_itemset_len >= 3
+        )
+        if needs_triples:
+            # 2-antecedent rules from frequent triples: the slow-path
+            # semantics pairwise mining cannot dominate (ops/rules.py) —
+            # part of rule generation, so inside the timing bracket
+            if x is not None:
+                with timer.phase("triple_extension"):
+                    triple_data = compute_triple_extension(
+                        x, counts, tensors.min_count
+                    )
+            if triple_data is not None:
+                with timer.phase("triple_confidence_merge"):
+                    tensors = rules.merge_triple_confidences(
+                        tensors,
+                        triple_data[0], triple_data[1], triple_data[2],
+                        triple_data[3],
+                        k_max=cfg.k_max_consequents,
+                    )
+                triple_merge_applied = True
+            else:
+                # sharded/bit-packed path (no one-hot matrix) or frequent
+                # pairs over capacity: the merge CANNOT run — say so loudly,
+                # confidences are pairwise-only (inexact for itemsets ≥ 3)
+                triple_merge_applied = False
+                print(
+                    "WARNING: confidence-mode triple-rule merge skipped "
+                    + (
+                        "(frequent pairs exceed capacity)"
+                        if x is not None
+                        else "(one-hot matrix not materialized on the "
+                        "sharded/bit-packed path)"
+                    )
+                    + "; confidences are pairwise-only"
+                )
         duration = time.perf_counter() - t0
         census = None
         if cfg.max_itemset_len >= 3:
+            # census-only triple extension (support mode) runs OUTSIDE the
+            # rule-generation bracket: it's reporting, not rule work
+            if triple_data is None and x is not None and not needs_triples:
+                with timer.phase("triple_extension"):
+                    triple_data = compute_triple_extension(
+                        x, counts, tensors.min_count
+                    )
             with timer.phase("itemset_census"):
                 census = _itemset_census(
-                    x, counts, tensors.min_count, cfg.max_itemset_len
+                    counts,
+                    tensors.min_count,
+                    cfg.max_itemset_len,
+                    triple_data,
+                    triple_data[4] if triple_data is not None else None,
                 )
     return MiningResult(
         tensors=tensors,
@@ -213,4 +299,5 @@ def mine(
         pruned_vocab=pruned_vocab,
         itemset_census=census,
         phase_timings=dict(timer.phases),
+        triple_merge_applied=triple_merge_applied,
     )

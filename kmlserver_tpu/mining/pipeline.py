@@ -136,6 +136,7 @@ def run_mining_job(
                 min_support=cfg.min_support,
                 mode=tensors.mode,
                 min_confidence=tensors.min_confidence,
+                rule_confs64=tensors.rule_confs64,
             )
         token = registry.append_history_and_invalidate(cfg, run_index, selected)
     print(f"Job finished at {get_current_time_str()}")

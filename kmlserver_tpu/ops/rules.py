@@ -94,12 +94,15 @@ def expand_rules_dict(
     n_playlists: int,
     min_support: float,
     mode: str = "support",
+    rule_confs64: np.ndarray | None = None,
 ) -> dict[str, dict[str, float]]:
     """THE canonical tensor→dict expansion, shared by the mining artifact
     writer and every npz consumer. Reproduces the reference pickle exactly:
     every frequent item is a key (empty dict when it has no partners),
     confidences are float64 ``count / P`` (support mode) or
-    ``count / item_count`` (confidence mode)."""
+    ``count / item_count`` (confidence mode). When ``rule_confs64`` is given
+    (triple-antecedent merge: per-rule denominators), the stored float64
+    confidences are used verbatim instead of re-deriving from counts."""
     min_count = min_count_for(min_support, n_playlists)
     out: dict[str, dict[str, float]] = {}
     for i, name in enumerate(vocab_names):
@@ -108,6 +111,12 @@ def expand_rules_dict(
             continue  # infrequent item: not a key (reference main.py:284 loop)
         ids, counts = rule_ids[i], rule_counts[i]
         valid = ids >= 0
+        if rule_confs64 is not None:
+            out[name] = {
+                vocab_names[int(j)]: float(c)
+                for j, c in zip(ids[valid], rule_confs64[i][valid])
+            }
+            continue
         denom = n_playlists if mode == "support" else denom_i
         out[name] = {
             vocab_names[int(j)]: int(c) / denom
@@ -132,6 +141,10 @@ class RuleTensors:
     n_frequent_items: int  # == len(keys) of the expanded dict
     n_songs_missing: int  # total_songs - len(keys) (reference main.py:304)
     overflow_rows: int  # rows whose true consequent set exceeded K_max
+    # set when confidences can NOT be re-derived from counts alone — i.e.
+    # triple-antecedent contributions are merged in (conf = s3/c_ab has a
+    # per-rule denominator); float64 so dict expansion keeps full precision
+    rule_confs64: np.ndarray | None = None
 
     @property
     def frequent_item_mask(self) -> np.ndarray:
@@ -146,7 +159,97 @@ class RuleTensors:
             n_playlists=self.n_playlists,
             min_support=self.min_support,
             mode=self.mode,
+            rule_confs64=self.rule_confs64,
         )
+
+
+def merge_triple_confidences(
+    tensors: "RuleTensors",
+    pair_i: np.ndarray,  # int32 (E,), -1 padded
+    pair_j: np.ndarray,  # int32 (E,), -1 padded
+    pair_counts: np.ndarray,  # int32 (E,) c_ij, 0 padded
+    triple_counts: np.ndarray,  # int32 (E, V) s_ijk (cols i,j invalid)
+    *,
+    k_max: int,
+) -> "RuleTensors":
+    """Fold 2-antecedent rules from frequent TRIPLES into the pairwise
+    confidence tensors — the part of the reference slow path's semantics
+    (machine-learning/main.py:224-260) that pairwise mining cannot dominate:
+    conf({a,b}→c) = s({a,b,c})/s({a,b}) may exceed every pairwise
+    confidence involving c. (Single-antecedent rules derived from triples
+    ARE dominated — s3/c_a ≤ s_ac/c_a — so with max itemset length 3 this
+    merge makes the confidence-mode output exact; the itemset census reports
+    length ≥ 4 as not enumerated.)
+
+    Each frequent triple {i,j,k} contributes six directed rules: for every
+    member pair as antecedent, both its members recommend the third with the
+    triple's confidence. Contributions below ``min_confidence`` or whose
+    triple is infrequent are dropped; surviving ones max-merge with the
+    pairwise rows, re-ranked per row, truncated to ``k_max``.
+    """
+    min_count = tensors.min_count
+    v = tensors.rule_ids.shape[0]
+    denom = np.maximum(tensors.item_counts, 1).astype(np.float64)
+
+    # sparse (row, col, conf) entries from the pairwise emission
+    rb, kb = np.nonzero(tensors.rule_ids >= 0)
+    cols_b = tensors.rule_ids[rb, kb].astype(np.int64)
+    vals_b = tensors.rule_counts[rb, kb].astype(np.int64) / denom[rb]
+
+    # triple entries, fully vectorized: O(n_pairs × V) numpy, no Python loop
+    e_valid = np.flatnonzero((pair_i >= 0) & (pair_counts > 0))
+    t = triple_counts[e_valid]  # (E, V)
+    pi = pair_i[e_valid].astype(np.int64)
+    pj = pair_j[e_valid].astype(np.int64)
+    pc = pair_counts[e_valid].astype(np.int64)
+    mask = t >= min_count
+    if e_valid.size:
+        e_rows = np.arange(e_valid.size)
+        mask[e_rows, pi] = False  # those columns hold pair supports,
+        mask[e_rows, pj] = False  # not proper triples
+    conf_t = t.astype(np.int64) / pc[:, None].astype(np.float64)
+    mask &= conf_t >= tensors.min_confidence
+    e_hit, k_hit = np.nonzero(mask)
+    vals_hit = conf_t[e_hit, k_hit]
+    # each triple {i,j,k} contributes i→k AND j→k at conf s3/c_ij
+    rows = np.concatenate([rb.astype(np.int64), pi[e_hit], pj[e_hit]])
+    cols = np.concatenate([cols_b, k_hit.astype(np.int64), k_hit.astype(np.int64)])
+    vals = np.concatenate([vals_b, vals_hit, vals_hit])
+
+    # max-dedup per (row, col): sort by (row, col, conf desc), keep first
+    order = np.lexsort((-vals, cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    keep_first = np.ones(len(rows), dtype=bool)
+    keep_first[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
+    rows, cols, vals = rows[keep_first], cols[keep_first], vals[keep_first]
+
+    # per-row rank by conf desc (ties: lower col id — deterministic)
+    order = np.lexsort((cols, -vals, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    row_start = np.ones(len(rows), dtype=bool)
+    row_start[1:] = rows[1:] != rows[:-1]
+    seg_id = np.cumsum(row_start) - 1
+    rank = np.arange(len(rows)) - np.flatnonzero(row_start)[seg_id]
+    row_sizes = np.bincount(seg_id) if len(rows) else np.empty(0, np.int64)
+    overflow = int((row_sizes > k_max).sum())
+    keep = rank < k_max
+    rows, cols, vals, rank = rows[keep], cols[keep], vals[keep], rank[keep]
+
+    rule_ids = np.full((v, k_max), -1, dtype=np.int32)
+    rule_confs64 = np.zeros((v, k_max), dtype=np.float64)
+    rule_ids[rows, rank] = cols
+    rule_confs64[rows, rank] = vals
+    return dataclasses.replace(
+        tensors,
+        rule_ids=rule_ids,
+        # counts cannot back these confidences (per-rule denominators);
+        # consumers MUST use rule_confs64 — artifacts.load_rule_tensors
+        # refuses an artifact where this invariant is broken
+        rule_counts=np.zeros((v, k_max), dtype=np.int32),
+        rule_confs=rule_confs64.astype(np.float32),
+        rule_confs64=rule_confs64,
+        overflow_rows=overflow,
+    )
 
 
 def mine_rules_from_counts(

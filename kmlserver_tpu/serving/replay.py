@@ -1,0 +1,212 @@
+"""QPS replay harness — the offline load test the reference never had.
+
+SURVEY.md §4 prescribes "a replay harness for the 1k-QPS batch-32 serving
+config" (BASELINE.json config 5: `/api/recommend/` p50 < 10 ms at 1k QPS,
+batch 32). This module drives a serving target at a fixed request rate with
+open-loop (Poisson-paced) arrivals — closed-loop clients understate tail
+latency because a slow server throttles its own load — and reports achieved
+QPS plus latency percentiles per response source.
+
+Two targets:
+
+- in-process: a :class:`MicroBatcher` over a loaded
+  :class:`RecommendEngine` (measures the engine + batching, no HTTP) —
+  what the tests and ``python -m kmlserver_tpu.serving.replay`` use;
+- HTTP: any running server URL (measures the full stack), via
+  ``--url http://host:port``.
+
+Seed sets are sampled from the engine's vocabulary (mixing known and
+unknown seeds exercises both the rules path and the static fallback, like
+the reference's three canned Swagger examples at rest_api/app/main.py:158-174).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    target_qps: float
+    achieved_qps: float
+    duration_s: float
+    n_requests: int
+    n_errors: int
+    p50_ms: float
+    p95_ms: float
+    p99_ms: float
+    by_source: dict[str, int]
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def _percentile(sorted_ms: list[float], q: float) -> float:
+    if not sorted_ms:
+        return float("nan")
+    idx = min(int(q * len(sorted_ms)), len(sorted_ms) - 1)
+    return sorted_ms[idx]
+
+
+def sample_seed_sets(
+    vocab: list[str],
+    n: int,
+    *,
+    seeds_per_request: int = 3,
+    unknown_fraction: float = 0.1,
+    rng_seed: int = 0,
+) -> list[list[str]]:
+    """n request payloads: mostly known tracks, a slice of unknown ones."""
+    rng = random.Random(rng_seed)
+    out = []
+    for i in range(n):
+        if vocab and rng.random() >= unknown_fraction:
+            k = min(seeds_per_request, len(vocab))
+            out.append(rng.sample(vocab, k))
+        else:
+            out.append([f"__replay_unknown_{i}__"])
+    return out
+
+
+def replay(
+    send,  # callable(list[str]) -> str (response source tag)
+    payloads: list[list[str]],
+    *,
+    qps: float,
+    max_concurrency: int = 256,
+) -> ReplayReport:
+    """Open-loop replay: request i is DISPATCHED at its Poisson arrival time
+    regardless of whether earlier requests completed (up to
+    ``max_concurrency`` in flight, beyond which arrivals count as errors —
+    an overloaded server must show up as drops/latency, not reduced load)."""
+    rng = np.random.default_rng(12345)
+    gaps = rng.exponential(1.0 / qps, size=len(payloads))
+    arrival = np.cumsum(gaps)
+
+    lat_ms: list[float] = []
+    by_source: dict[str, int] = {}
+    errors = 0
+    lock = threading.Lock()
+    inflight = threading.Semaphore(max_concurrency)
+    threads: list[threading.Thread] = []
+
+    def worker(seeds: list[str]) -> None:
+        t0 = time.perf_counter()
+        try:
+            source = send(seeds)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lat_ms.append(dt_ms)
+                by_source[source] = by_source.get(source, 0) + 1
+        except Exception:
+            nonlocal errors
+            with lock:
+                errors += 1
+        finally:
+            inflight.release()
+
+    start = time.perf_counter()
+    for i, seeds in enumerate(payloads):
+        now = time.perf_counter() - start
+        wait = arrival[i] - now
+        if wait > 0:
+            time.sleep(wait)
+        if not inflight.acquire(blocking=False):
+            with lock:
+                errors += 1
+            continue
+        t = threading.Thread(target=worker, args=(seeds,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=60.0)
+    duration = time.perf_counter() - start
+
+    # snapshot under the lock: a straggler worker past its join deadline may
+    # still complete and append concurrently — its write either lands before
+    # this snapshot (counted) or is excluded, never racing the sort
+    with lock:
+        lat_sorted = sorted(lat_ms)
+        sources = dict(by_source)
+        n_errors = errors
+    n_ok = len(lat_sorted)
+    return ReplayReport(
+        target_qps=qps,
+        achieved_qps=(n_ok + n_errors) / duration if duration > 0 else 0.0,
+        duration_s=duration,
+        n_requests=len(payloads),
+        n_errors=n_errors,
+        p50_ms=_percentile(lat_sorted, 0.50),
+        p95_ms=_percentile(lat_sorted, 0.95),
+        p99_ms=_percentile(lat_sorted, 0.99),
+        by_source=sources,
+    )
+
+
+def _http_sender(url: str):
+    endpoint = url.rstrip("/") + "/api/recommend/"
+
+    def send(seeds: list[str]) -> str:
+        req = urllib.request.Request(
+            endpoint,
+            data=json.dumps({"songs": seeds}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.load(resp)
+        # the HTTP schema doesn't expose the source; bucket by outcome
+        return "rules" if body.get("songs") else "empty_or_fallback"
+
+    return send
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--qps", type=float, default=1000.0)
+    parser.add_argument("--requests", type=int, default=2000)
+    parser.add_argument("--url", default=None, help="HTTP target; default: in-process engine")
+    parser.add_argument("--batch-max-size", type=int, default=32)
+    parser.add_argument("--batch-window-ms", type=float, default=2.0)
+    args = parser.parse_args()
+
+    if args.url:
+        send = _http_sender(args.url)
+        # sample seeds via one warm-up request? keep it simple: unknown-heavy
+        payloads = sample_seed_sets([], args.requests)
+    else:
+        from ..config import ServingConfig
+        from .batcher import MicroBatcher
+        from .engine import RecommendEngine
+
+        cfg = ServingConfig.from_env()
+        engine = RecommendEngine(cfg)
+        if not engine.load():
+            print("artifacts not found; run the mining job first")
+            return 1
+        batcher = MicroBatcher(
+            engine, max_size=args.batch_max_size, window_ms=args.batch_window_ms
+        )
+
+        def send(seeds: list[str]) -> str:
+            return batcher.recommend(seeds)[1]
+
+        payloads = sample_seed_sets(engine.bundle.vocab, args.requests)
+
+    report = replay(send, payloads, qps=args.qps)
+    print(report.to_json())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

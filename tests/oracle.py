@@ -66,6 +66,44 @@ def reference_fast_rules(
     return rules
 
 
+def reference_slow_rules(
+    baskets: list[list[str]],
+    min_support: float,
+    min_confidence: float,
+    max_len: int | None = None,
+) -> dict[str, dict[str, float]]:
+    """The reference SLOW path's true-confidence semantics
+    (machine-learning/main.py:224-260): standard association-rule generation
+    — for every frequent itemset S and every non-empty proper subset A,
+    conf = count(S)/count(A); if conf ≥ min_confidence, every song in A
+    recommends every song in S\\A at that confidence, max-merged
+    (the reference's per-rule loop at main.py:247-255). Keys exist only
+    where a rule landed (unlike the fast path's empty-row keys)."""
+    supports = itemset_supports(baskets, max_len)
+    p = len(baskets)
+    freq = {s: c for s, c in supports.items() if c / p >= min_support}
+    rules: dict[str, dict[str, float]] = {}
+    for itemset, count in freq.items():
+        if len(itemset) < 2:
+            continue
+        members = sorted(itemset)
+        for a_size in range(1, len(members)):
+            for antecedent in combinations(members, a_size):
+                c_a = freq.get(frozenset(antecedent))
+                if not c_a:
+                    continue
+                conf = count / c_a
+                if conf < min_confidence:
+                    continue
+                consequents = [m for m in members if m not in antecedent]
+                for song in antecedent:
+                    row = rules.setdefault(song, {})
+                    for c in consequents:
+                        if conf > row.get(c, 0.0):
+                            row[c] = conf
+    return rules
+
+
 def reference_recommend(
     rules: dict[str, dict[str, float]], seeds: list[str], k_best: int
 ) -> list[tuple[str, float]]:

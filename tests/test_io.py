@@ -93,6 +93,42 @@ class TestArtifacts:
         d = artifacts.rules_dict_from_tensors(loaded)
         assert d == {"a": {"b": 0.5}, "b": {"a": 0.5, "c": 0.25}, "c": {}}
 
+    def test_rule_tensor_roundtrip_explicit_confs(self, tmp_path):
+        # triple-antecedent merge: confidences carry per-rule denominators
+        # and must survive the npz verbatim, not be re-derived from counts
+        vocab = ["a", "b", "c"]
+        rule_ids = np.array([[1, 2], [0, -1], [-1, -1]], dtype=np.int32)
+        rule_counts = np.zeros((3, 2), dtype=np.int32)
+        confs64 = np.array([[0.75, 2 / 3], [0.4, 0.0], [0.0, 0.0]])
+        item_counts = np.array([3, 2, 2], dtype=np.int32)
+        path = str(tmp_path / "rc.npz")
+        artifacts.save_rule_tensors(
+            path, vocab=vocab, rule_ids=rule_ids, rule_counts=rule_counts,
+            item_counts=item_counts, n_playlists=4, min_support=0.25,
+            mode="confidence", min_confidence=0.1, rule_confs64=confs64,
+        )
+        loaded = artifacts.load_rule_tensors(path)
+        np.testing.assert_array_equal(loaded["rule_confs64"], confs64)
+        np.testing.assert_array_equal(
+            loaded["rule_confs"], confs64.astype(np.float32)
+        )
+        d = artifacts.rules_dict_from_tensors(loaded)
+        assert d == {"a": {"b": 0.75, "c": 2 / 3}, "b": {"a": 0.4}, "c": {}}
+
+    def test_zero_count_rules_without_confs64_refused(self, tmp_path):
+        # valid rule ids backed by zero counts and no rule_confs64 would
+        # re-derive as all-0.0 confidences; the loader must refuse instead
+        path = str(tmp_path / "stripped.npz")
+        artifacts.save_rule_tensors(
+            path, vocab=["a", "b"],
+            rule_ids=np.array([[1], [-1]], dtype=np.int32),
+            rule_counts=np.zeros((2, 1), dtype=np.int32),
+            item_counts=np.array([2, 2], dtype=np.int32),
+            n_playlists=4, min_support=0.25, mode="confidence",
+        )
+        with pytest.raises(ValueError, match="stripped"):
+            artifacts.load_rule_tensors(path)
+
     def test_tensors_from_dict_legacy_pickle(self):
         vocab = ["a", "b", "c"]
         d = {"a": {"zz-not-in-vocab": 0.9, "b": 0.5, "c": 0.4}, "c": {}}

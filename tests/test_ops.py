@@ -196,6 +196,42 @@ class TestRuleEmission:
         # singletons of frequent pairs are themselves frequent → keys exist
         assert got == expected
 
+    def test_triple_antecedent_confidence_matches_oracle(self, rng):
+        """With max_itemset_len=3 in confidence mode, 2-antecedent rules
+        from frequent triples (conf({a,b}→c) = s3/s(ab)) merge in — the
+        slow-path semantics pairwise mining cannot dominate. Must equal the
+        full subset-split oracle at max_len=3 exactly (single-antecedent
+        triple rules are dominated by pair rules, so the oracle's extra
+        splits change nothing)."""
+        from kmlserver_tpu.config import MiningConfig
+        from kmlserver_tpu.mining.miner import mine
+
+        from .oracle import reference_slow_rules
+
+        baskets = random_baskets(rng, n_playlists=40, n_tracks=10, mean_len=6)
+        min_support, min_confidence = 0.1, 0.25
+        b = build_baskets(table_from_baskets(baskets))
+        cfg = MiningConfig(
+            min_support=min_support, k_max_consequents=64,
+            confidence_mode="confidence", min_confidence=min_confidence,
+            max_itemset_len=3,
+        )
+        mined = mine(b, cfg)
+        got = mined.tensors.to_rules_dict(mined.vocab_names)
+        expected = reference_slow_rules(
+            baskets, min_support, min_confidence, max_len=3
+        )
+        for key, row in expected.items():
+            assert got.get(key) == row, key
+        # our extra keys (frequent items with no rule ≥ threshold) are empty
+        for key in set(got) - set(expected):
+            assert got[key] == {}
+        # sanity: the triples actually changed something vs pairwise-only
+        pairwise = reference_slow_rules(
+            baskets, min_support, min_confidence, max_len=2
+        )
+        assert expected != pairwise, "workload produced no frequent triples"
+
     def test_k_max_truncation_and_overflow(self, tiny_baskets):
         b = build_baskets(table_from_baskets(tiny_baskets))
         x = jnp.asarray(onehot_np(tiny_baskets, b.vocab))

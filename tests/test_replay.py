@@ -1,0 +1,84 @@
+"""QPS replay harness (SURVEY.md §4 prescription): open-loop pacing, latency
+percentiles, mixed known/unknown seed sampling, and an end-to-end replay
+against a real engine + micro-batcher on a tmpdir PVC."""
+
+import numpy as np
+
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.batcher import MicroBatcher
+from kmlserver_tpu.serving.engine import RecommendEngine
+from kmlserver_tpu.serving.replay import ReplayReport, replay, sample_seed_sets
+
+from .oracle import random_baskets
+from .test_ops import table_from_baskets
+
+
+def test_sample_seed_sets_mixes_known_and_unknown():
+    vocab = [f"t{i}" for i in range(50)]
+    payloads = sample_seed_sets(vocab, 200, unknown_fraction=0.25, rng_seed=1)
+    assert len(payloads) == 200
+    unknown = sum(1 for p in payloads if p[0].startswith("__replay_unknown_"))
+    assert 20 < unknown < 80  # ~25%
+    known = [p for p in payloads if not p[0].startswith("__replay_unknown_")]
+    assert all(all(s in vocab for s in p) for p in known)
+
+
+def test_replay_reports_latency_and_sources():
+    def send(seeds):
+        return "rules" if seeds[0] == "a" else "fallback"
+
+    payloads = [["a"], ["b"], ["a"], ["a"]] * 25
+    report = replay(send, payloads, qps=2000.0)
+    assert report.n_requests == 100
+    assert report.n_errors == 0
+    assert report.by_source == {"rules": 75, "fallback": 25}
+    assert report.p50_ms <= report.p95_ms <= report.p99_ms
+    assert 0 < report.achieved_qps
+    assert '"p50_ms"' in report.to_json()
+
+
+def test_replay_counts_failures_as_errors():
+    def send(seeds):
+        if seeds[0] == "boom":
+            raise RuntimeError("injected")
+        return "rules"
+
+    report = replay(send, [["ok"], ["boom"], ["ok"]], qps=500.0)
+    assert report.n_errors == 1
+    assert report.by_source == {"rules": 2}
+
+
+def test_replay_end_to_end_against_engine(tmp_path):
+    # mine a real artifact set, load it, and replay through the micro-batcher
+    rng = np.random.default_rng(11)
+    baskets = random_baskets(rng, n_playlists=60, n_tracks=30, mean_len=8)
+    table = table_from_baskets(baskets)
+    from kmlserver_tpu.data.csv import write_tracks_csv
+
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    write_tracks_csv(str(ds_dir / "2023_spotify_ds1.csv"), table)
+    mining_cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.05,
+        k_max_consequents=16,
+    )
+    run_mining_job(mining_cfg)
+
+    engine = RecommendEngine(
+        ServingConfig(base_dir=str(tmp_path), polling_wait_in_minutes=60.0)
+    )
+    assert engine.load()
+    batcher = MicroBatcher(engine, max_size=8, window_ms=1.0)
+
+    payloads = sample_seed_sets(engine.bundle.vocab, 60, rng_seed=3)
+    report = replay(
+        lambda seeds: batcher.recommend(seeds)[1], payloads, qps=300.0
+    )
+    assert isinstance(report, ReplayReport)
+    assert report.n_errors == 0
+    assert report.n_requests == 60
+    assert sum(report.by_source.values()) == 60
+    # known-seed requests should hit the rules path
+    assert report.by_source.get("rules", 0) > 0
+    assert np.isfinite(report.p99_ms)
