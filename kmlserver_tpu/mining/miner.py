@@ -11,9 +11,10 @@ Config wiring:
   path's true asymmetric confidence (``"confidence"``,
   machine-learning/main.py:224-260).
 - ``cfg.max_itemset_len`` ≥ 3 additionally computes a frequent-itemset
-  census (per-length counts, exact via pair extension) — the reference's
-  log surface reports itemset statistics; ≥ 4 is not yet enumerated and is
-  reported as such rather than silently ignored.
+  census (per-length counts, exact via MXU pair→triple→quad extension up
+  to length 4; ≥ 5 is reported as not enumerated rather than silently
+  ignored), and in confidence mode merges the multi-antecedent rules those
+  itemsets imply (see ops/rules.py merge_confidence_contributions).
 - ``cfg.bitpack_threshold_elems``: above this one-hot element count the
   bit-packed Pallas popcount path (ops/popcount.py) replaces the dense int8
   matmul — 32× denser in HBM, exact.
@@ -137,12 +138,69 @@ def compute_triple_extension(
     )
 
 
+TRIPLE_CAPACITY = 1 << 16
+
+
+def frequent_triples_from_extension(
+    triple_data: tuple, min_count: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Unique frequent triples (i < j < k) + their supports, extracted from
+    the pair→triple extension. Each triple appears under exactly one pair
+    row (its (i, j) with k > j), so restricting to k > j dedups across the
+    three pair rows that could generate it."""
+    pi, pj, _, t, _ = triple_data
+    valid = pi >= 0
+    v = t.shape[1]
+    k_ids = np.arange(v)[None, :]
+    mask = valid[:, None] & (k_ids > pj[:, None]) & (t >= min_count)
+    e_idx, k_idx = np.nonzero(mask)
+    return (
+        pi[e_idx].astype(np.int32),
+        pj[e_idx].astype(np.int32),
+        k_idx.astype(np.int32),
+        t[e_idx, k_idx].astype(np.int32),
+    )
+
+
+def compute_quad_extension(
+    x: jax.Array,
+    triple_data: tuple,
+    min_count: int,
+    capacity: int = TRIPLE_CAPACITY,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None:
+    """Frequent triples + their quad extensions:
+    ``(ti, tj, tk, triple_supports, quad_counts (E3, V))`` as host arrays,
+    or None when the frequent-triple count exceeds ``capacity``. Triple
+    index arrays are padded to a multiple of 1024 (-1 sentinels) so the jit
+    shape set stays bounded across runs."""
+    ti, tj, tk, tc = frequent_triples_from_extension(triple_data, min_count)
+    n = len(ti)
+    if n > capacity:
+        return None
+    if n == 0:
+        return ti, tj, tk, tc, np.zeros((0, x.shape[1]), np.int32)
+    padded = ((n + 1023) // 1024) * 1024
+    pad = padded - n
+    ti_p = np.concatenate([ti, np.full(pad, -1, np.int32)])
+    tj_p = np.concatenate([tj, np.zeros(pad, np.int32)])
+    tk_p = np.concatenate([tk, np.zeros(pad, np.int32)])
+    tc_p = np.concatenate([tc, np.zeros(pad, np.int32)])
+    q = support.quad_counts(
+        x,
+        jnp.where(jnp.asarray(ti_p) >= 0, jnp.asarray(ti_p), 0),
+        jnp.asarray(tj_p),
+        jnp.asarray(tk_p),
+    )
+    return ti_p, tj_p, tk_p, tc_p, np.asarray(q)
+
+
 def _itemset_census(
     counts: jax.Array,
     min_count: int,
     max_len: int,
     triple_data: tuple | None,
     n_pairs: int | None,
+    quad_data: tuple | None = None,
 ) -> dict[int, int]:
     """Exact frequent-itemset counts per length (1, 2, and — via the shared
     triple extension — 3). Lengths beyond 3, and length 3 when the extension
@@ -150,6 +208,13 @@ def _itemset_census(
     (not enumerated) rather than silently dropped."""
     item_counts = np.asarray(jnp.diagonal(counts))
     census = {1: int((item_counts >= min_count).sum())}
+
+    def finish(first_unenumerated: int) -> dict[int, int]:
+        # EVERY non-enumerated length gets an explicit -1, never a missing key
+        for length in range(first_unenumerated, max_len + 1):
+            census[length] = -1
+        return census
+
     if max_len < 2:
         return census
     if n_pairs is None:
@@ -162,19 +227,27 @@ def _itemset_census(
     if max_len < 3:
         return census
     if triple_data is None:
-        census[3] = -1  # capacity overflow / sharded x: report honestly
+        return finish(3)  # capacity overflow / sharded x: report honestly
+    if quad_data is not None:
+        # quad extraction already enumerated the triples — reuse its count
+        census[3] = int((quad_data[0] >= 0).sum())
+    else:
+        # one shared dedup rule with the rule merge: a triple {i,j,k} is
+        # counted once, under its frequent (i,j) row with k > j > i
+        census[3] = len(
+            frequent_triples_from_extension(triple_data, min_count)[0]
+        )
+    if max_len < 4:
         return census
-    pi, pj, _, t, _ = triple_data
-    valid_rows = pi >= 0
-    v = t.shape[1]
-    k_ids = np.arange(v)[None, :]
-    # a triple {i,j,k} is counted once per frequent (i,j) with k > j > i:
-    # restrict to k > j to avoid double counting across its three pairs
-    mask = valid_rows[:, None] & (k_ids > pj[:, None]) & (t >= min_count)
-    census[3] = int(mask.sum())
-    if max_len > 3:
-        census[max_len] = -1
-    return census
+    if quad_data is None:
+        return finish(4)  # triple-capacity overflow: report honestly
+    ti, tj, tk, _, q = quad_data
+    v = q.shape[1] if q.ndim == 2 else 0
+    l_ids = np.arange(v)[None, :]
+    # quad {i,j,k,l} counted once: under its (i,j,k) with l > k > j > i
+    qmask = (ti >= 0)[:, None] & (l_ids > tk[:, None]) & (q >= min_count)
+    census[4] = int(qmask.sum())
+    return finish(5)
 
 
 def prune_infrequent(baskets: Baskets, min_count: int) -> tuple[Baskets, np.ndarray]:
@@ -235,26 +308,61 @@ def mine(
                 n_total_songs=n_total,
             )
         triple_data = None
+        quad_data = None
         triple_merge_applied = None
         needs_triples = (
             cfg.confidence_mode == "confidence" and cfg.max_itemset_len >= 3
         )
         if needs_triples:
-            # 2-antecedent rules from frequent triples: the slow-path
-            # semantics pairwise mining cannot dominate (ops/rules.py) —
-            # part of rule generation, so inside the timing bracket
+            # multi-antecedent rules from frequent triples/quads: the
+            # slow-path semantics pairwise mining cannot dominate
+            # (ops/rules.py) — part of rule generation, inside the bracket
+            if cfg.max_itemset_len >= 5:
+                print(
+                    "WARNING: confidence-mode antecedents are enumerated up "
+                    f"to size 3 (itemsets of length 4); max_itemset_len="
+                    f"{cfg.max_itemset_len} rules from longer itemsets are "
+                    "not merged and confidences may understate them"
+                )
             if x is not None:
                 with timer.phase("triple_extension"):
                     triple_data = compute_triple_extension(
                         x, counts, tensors.min_count
                     )
             if triple_data is not None:
-                with timer.phase("triple_confidence_merge"):
-                    tensors = rules.merge_triple_confidences(
-                        tensors,
-                        triple_data[0], triple_data[1], triple_data[2],
-                        triple_data[3],
-                        k_max=cfg.k_max_consequents,
+                if cfg.max_itemset_len >= 4:
+                    with timer.phase("quad_extension"):
+                        quad_data = compute_quad_extension(
+                            x, triple_data, tensors.min_count
+                        )
+                    if quad_data is None:
+                        print(
+                            "WARNING: quad-rule merge skipped (frequent "
+                            "triples exceed capacity); confidences include "
+                            "antecedents up to size 2 only"
+                        )
+                # the O(E×V) contribution builds are the merge's dominant
+                # host cost — keep them inside the timed merge phase
+                with timer.phase("confidence_merge"):
+                    contributions = [
+                        rules.antecedent_contributions(
+                            (triple_data[0], triple_data[1]),
+                            triple_data[2], triple_data[3],
+                            min_count=tensors.min_count,
+                            min_confidence=cfg.min_confidence,
+                        )
+                    ]
+                    if quad_data is not None:
+                        contributions.append(
+                            rules.antecedent_contributions(
+                                (quad_data[0], quad_data[1], quad_data[2]),
+                                quad_data[3], quad_data[4],
+                                min_count=tensors.min_count,
+                                min_confidence=cfg.min_confidence,
+                            )
+                        )
+                    tensors = rules.merge_confidence_contributions(
+                        tensors, contributions, k_max=cfg.k_max_consequents
                     )
                 triple_merge_applied = True
             else:
@@ -275,12 +383,23 @@ def mine(
         duration = time.perf_counter() - t0
         census = None
         if cfg.max_itemset_len >= 3:
-            # census-only triple extension (support mode) runs OUTSIDE the
-            # rule-generation bracket: it's reporting, not rule work
+            # census-only extensions (support mode) run OUTSIDE the
+            # rule-generation bracket: reporting, not rule work
             if triple_data is None and x is not None and not needs_triples:
                 with timer.phase("triple_extension"):
                     triple_data = compute_triple_extension(
                         x, counts, tensors.min_count
+                    )
+            if (
+                cfg.max_itemset_len >= 4
+                and quad_data is None
+                and triple_data is not None
+                and x is not None
+                and not needs_triples
+            ):
+                with timer.phase("quad_extension"):
+                    quad_data = compute_quad_extension(
+                        x, triple_data, tensors.min_count
                     )
             with timer.phase("itemset_census"):
                 census = _itemset_census(
@@ -289,6 +408,7 @@ def mine(
                     cfg.max_itemset_len,
                     triple_data,
                     triple_data[4] if triple_data is not None else None,
+                    quad_data,
                 )
     return MiningResult(
         tensors=tensors,

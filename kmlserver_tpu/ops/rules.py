@@ -141,6 +141,10 @@ class RuleTensors:
     n_frequent_items: int  # == len(keys) of the expanded dict
     n_songs_missing: int  # total_songs - len(keys) (reference main.py:304)
     overflow_rows: int  # rows whose true consequent set exceeded K_max
+    # emission-time TRUE consequent-set sizes (may exceed K_max); lets the
+    # multi-antecedent merge keep the overflow count honest after it can no
+    # longer see the entries emission truncated away
+    row_valid_counts: np.ndarray | None = None  # int32 (V,)
     # set when confidences can NOT be re-derived from counts alone — i.e.
     # triple-antecedent contributions are merged in (conf = s3/c_ab has a
     # per-rule denominator); float64 so dict expansion keeps full precision
@@ -163,31 +167,63 @@ class RuleTensors:
         )
 
 
-def merge_triple_confidences(
+def antecedent_contributions(
+    members: tuple[np.ndarray, ...],  # each int (E,), -1 padded
+    ant_counts: np.ndarray,  # int (E,) support of the antecedent itemset
+    ext_counts: np.ndarray,  # int (E, V) support of antecedent ∪ {col}
+    *,
+    min_count: int,
+    min_confidence: float,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Directed rule contributions from one antecedent size, vectorized.
+
+    For each row e — an antecedent itemset A = {members[0][e], …} — and each
+    column c with ``ext_counts[e, c] ≥ min_count``, the rule A→c holds at
+    conf = ext/ant. The reference slow path assigns that confidence from
+    EVERY member of A to c (machine-learning/main.py:247-255), so each hit
+    yields ``len(members)`` directed (row, col, conf) entries. Columns that
+    are themselves members hold the antecedent's own support, not a proper
+    extension, and are masked out. → (rows, cols, vals).
+    """
+    e_valid = np.flatnonzero((members[0] >= 0) & (ant_counts > 0))
+    ext = ext_counts[e_valid]  # (E', V)
+    ms = [m[e_valid].astype(np.int64) for m in members]
+    ac = ant_counts[e_valid].astype(np.int64)
+    mask = ext >= min_count
+    if e_valid.size:
+        e_rows = np.arange(e_valid.size)
+        for m in ms:
+            mask[e_rows, m] = False
+    conf = ext.astype(np.int64) / ac[:, None].astype(np.float64)
+    mask &= conf >= min_confidence
+    e_hit, k_hit = np.nonzero(mask)
+    vals_hit = conf[e_hit, k_hit]
+    rows = np.concatenate([m[e_hit] for m in ms])
+    cols = np.tile(k_hit.astype(np.int64), len(ms))
+    vals = np.tile(vals_hit, len(ms))
+    return rows, cols, vals
+
+
+def merge_confidence_contributions(
     tensors: "RuleTensors",
-    pair_i: np.ndarray,  # int32 (E,), -1 padded
-    pair_j: np.ndarray,  # int32 (E,), -1 padded
-    pair_counts: np.ndarray,  # int32 (E,) c_ij, 0 padded
-    triple_counts: np.ndarray,  # int32 (E, V) s_ijk (cols i,j invalid)
+    contributions: list[tuple[np.ndarray, np.ndarray, np.ndarray]],
     *,
     k_max: int,
 ) -> "RuleTensors":
-    """Fold 2-antecedent rules from frequent TRIPLES into the pairwise
-    confidence tensors — the part of the reference slow path's semantics
+    """Fold multi-antecedent rule contributions into the pairwise confidence
+    tensors — the part of the reference slow path's semantics
     (machine-learning/main.py:224-260) that pairwise mining cannot dominate:
-    conf({a,b}→c) = s({a,b,c})/s({a,b}) may exceed every pairwise
-    confidence involving c. (Single-antecedent rules derived from triples
-    ARE dominated — s3/c_a ≤ s_ac/c_a — so with max itemset length 3 this
-    merge makes the confidence-mode output exact; the itemset census reports
-    length ≥ 4 as not enumerated.)
+    conf({a,b}→c) = s3/s(ab) (and conf({a,b,c}→d) = s4/s(abc), …) may
+    exceed every pairwise confidence involving the consequent. Rules whose
+    antecedent is a PROPER SUBSET of another frequent itemset's antecedent
+    at the same size-or-less ARE dominated (sL/c_A ≤ s(A∪{c})/c_A), so
+    (L-1)-antecedent contributions per itemset length L are sufficient for
+    exactness at that max length.
 
-    Each frequent triple {i,j,k} contributes six directed rules: for every
-    member pair as antecedent, both its members recommend the third with the
-    triple's confidence. Contributions below ``min_confidence`` or whose
-    triple is infrequent are dropped; surviving ones max-merge with the
-    pairwise rows, re-ranked per row, truncated to ``k_max``.
+    Contributions max-merge with the pairwise rows, re-rank per row
+    (confidence descending, ties by lower consequent id), truncate to
+    ``k_max``.
     """
-    min_count = tensors.min_count
     v = tensors.rule_ids.shape[0]
     denom = np.maximum(tensors.item_counts, 1).astype(np.float64)
 
@@ -196,25 +232,9 @@ def merge_triple_confidences(
     cols_b = tensors.rule_ids[rb, kb].astype(np.int64)
     vals_b = tensors.rule_counts[rb, kb].astype(np.int64) / denom[rb]
 
-    # triple entries, fully vectorized: O(n_pairs × V) numpy, no Python loop
-    e_valid = np.flatnonzero((pair_i >= 0) & (pair_counts > 0))
-    t = triple_counts[e_valid]  # (E, V)
-    pi = pair_i[e_valid].astype(np.int64)
-    pj = pair_j[e_valid].astype(np.int64)
-    pc = pair_counts[e_valid].astype(np.int64)
-    mask = t >= min_count
-    if e_valid.size:
-        e_rows = np.arange(e_valid.size)
-        mask[e_rows, pi] = False  # those columns hold pair supports,
-        mask[e_rows, pj] = False  # not proper triples
-    conf_t = t.astype(np.int64) / pc[:, None].astype(np.float64)
-    mask &= conf_t >= tensors.min_confidence
-    e_hit, k_hit = np.nonzero(mask)
-    vals_hit = conf_t[e_hit, k_hit]
-    # each triple {i,j,k} contributes i→k AND j→k at conf s3/c_ij
-    rows = np.concatenate([rb.astype(np.int64), pi[e_hit], pj[e_hit]])
-    cols = np.concatenate([cols_b, k_hit.astype(np.int64), k_hit.astype(np.int64)])
-    vals = np.concatenate([vals_b, vals_hit, vals_hit])
+    rows = np.concatenate([rb.astype(np.int64)] + [c[0] for c in contributions])
+    cols = np.concatenate([cols_b] + [c[1] for c in contributions])
+    vals = np.concatenate([vals_b] + [c[2] for c in contributions])
 
     # max-dedup per (row, col): sort by (row, col, conf desc), keep first
     order = np.lexsort((-vals, cols, rows))
@@ -230,8 +250,16 @@ def merge_triple_confidences(
     row_start[1:] = rows[1:] != rows[:-1]
     seg_id = np.cumsum(row_start) - 1
     rank = np.arange(len(rows)) - np.flatnonzero(row_start)[seg_id]
-    row_sizes = np.bincount(seg_id) if len(rows) else np.empty(0, np.int64)
-    overflow = int((row_sizes > k_max).sum())
+    # honest overflow: a row is truncated if the MERGED candidate set
+    # exceeds k_max, or if emission already truncated it (the merge can't
+    # see those dropped entries — tensors.row_valid_counts remembers them)
+    overflow_mask = np.zeros(v, dtype=bool)
+    if len(rows):
+        row_sizes = np.bincount(seg_id)
+        overflow_mask[rows[row_start]] = row_sizes > k_max
+    if tensors.row_valid_counts is not None:
+        overflow_mask |= tensors.row_valid_counts > k_max
+    overflow = int(overflow_mask.sum())
     keep = rank < k_max
     rows, cols, vals, rank = rows[keep], cols[keep], vals[keep], rank[keep]
 
@@ -306,4 +334,5 @@ def mine_rules_from_counts(
             n_total_songs if n_total_songs is not None else int(pair_count_matrix.shape[0])
         ) - n_frequent,
         overflow_rows=int((row_valid > k_max).sum()),
+        row_valid_counts=row_valid.astype(np.int32),
     )

@@ -90,6 +90,29 @@ def triple_counts(x_onehot: jax.Array, pair_i: jax.Array, pair_j: jax.Array) -> 
     )
 
 
+@jax.jit
+def quad_counts(
+    x_onehot: jax.Array,
+    trip_i: jax.Array,
+    trip_j: jax.Array,
+    trip_k: jax.Array,
+) -> jax.Array:
+    """Supports of {i, j, k, l} for E candidate triples × all l: int32 (E, V).
+
+    Same shape of computation as :func:`triple_counts` one level up:
+    ``Y[p, e] = X[p, i_e]·X[p, j_e]·X[p, k_e]`` on the VPU, then ``YᵀX`` on
+    the MXU. Rows for padded triples are garbage and must be masked by the
+    caller; columns l ∈ {i_e, j_e, k_e} hold the triple support itself.
+    """
+    y = x_onehot[:, trip_i] * x_onehot[:, trip_j] * x_onehot[:, trip_k]
+    return jax.lax.dot_general(
+        y,
+        x_onehot,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
 @partial(jax.jit, static_argnames=("capacity",))
 def frequent_pairs(counts: jax.Array, min_count: jax.Array, *, capacity: int):
     """Extract up to ``capacity`` frequent off-diagonal pairs (i < j) from the

@@ -323,10 +323,16 @@ def make_handler(app: RecommendApp):
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    # stdlib default listen backlog is 5 — QPS-scale bursts get connection-
+    # refused before a handler thread ever sees them
+    request_queue_size = 256
+
+
 def serve(app: RecommendApp, port: int | None = None) -> ThreadingHTTPServer:
     """Bind + return the server (caller runs ``serve_forever``); port 0 picks
     an ephemeral port (used by tests and local dev)."""
-    server = ThreadingHTTPServer(
+    server = _Server(
         ("0.0.0.0", port if port is not None else app.cfg.port), make_handler(app)
     )
     return server

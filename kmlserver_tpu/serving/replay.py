@@ -163,10 +163,29 @@ def _http_sender(url: str):
         )
         with urllib.request.urlopen(req, timeout=30) as resp:
             body = json.load(resp)
-        # the HTTP schema doesn't expose the source; bucket by outcome
-        return "rules" if body.get("songs") else "empty_or_fallback"
+        # the HTTP schema doesn't expose the engine's source tag (reference
+        # response shape, rest_api/app/main.py:183-187) — label honestly by
+        # outcome; a non-empty body may be rules OR the static fallback
+        return "nonempty" if body.get("songs") else "empty"
 
     return send
+
+
+def _local_vocab() -> list[str]:
+    """Best-effort seed vocabulary for --url runs: the local artifacts, when
+    BASE_DIR points at the same PVC the server reads. Empty when absent —
+    then every request is an unknown seed and only exercises the static
+    fallback, which the report will show as such."""
+    try:
+        from ..config import ServingConfig
+        from .engine import RecommendEngine
+
+        engine = RecommendEngine(ServingConfig.from_env())
+        if engine.load():
+            return engine.bundle.vocab
+    except Exception:
+        pass
+    return []
 
 
 def main() -> int:
@@ -180,8 +199,13 @@ def main() -> int:
 
     if args.url:
         send = _http_sender(args.url)
-        # sample seeds via one warm-up request? keep it simple: unknown-heavy
-        payloads = sample_seed_sets([], args.requests)
+        vocab = _local_vocab()
+        if not vocab:
+            print(
+                "NOTE: no local artifacts found (BASE_DIR); all seeds are "
+                "unknown — this measures the static-fallback path only",
+            )
+        payloads = sample_seed_sets(vocab, args.requests)
     else:
         from ..config import ServingConfig
         from .batcher import MicroBatcher

@@ -196,13 +196,14 @@ class TestRuleEmission:
         # singletons of frequent pairs are themselves frequent → keys exist
         assert got == expected
 
-    def test_triple_antecedent_confidence_matches_oracle(self, rng):
-        """With max_itemset_len=3 in confidence mode, 2-antecedent rules
-        from frequent triples (conf({a,b}→c) = s3/s(ab)) merge in — the
-        slow-path semantics pairwise mining cannot dominate. Must equal the
-        full subset-split oracle at max_len=3 exactly (single-antecedent
-        triple rules are dominated by pair rules, so the oracle's extra
-        splits change nothing)."""
+    @pytest.mark.parametrize("max_len", [3, 4])
+    def test_multi_antecedent_confidence_matches_oracle(self, rng, max_len):
+        """Confidence mode with max_itemset_len ≥ 3 merges multi-antecedent
+        rules from frequent triples (conf({a,b}→c) = s3/s(ab)) and, at 4,
+        from frequent quads (conf({a,b,c}→d) = s4/s(abc)) — the slow-path
+        semantics pairwise mining cannot dominate. Must equal the full
+        subset-split oracle at the same max_len exactly (every other split
+        shape is dominated — see merge_confidence_contributions)."""
         from kmlserver_tpu.config import MiningConfig
         from kmlserver_tpu.mining.miner import mine
 
@@ -214,23 +215,59 @@ class TestRuleEmission:
         cfg = MiningConfig(
             min_support=min_support, k_max_consequents=64,
             confidence_mode="confidence", min_confidence=min_confidence,
-            max_itemset_len=3,
+            max_itemset_len=max_len,
         )
         mined = mine(b, cfg)
+        assert mined.triple_merge_applied is True
         got = mined.tensors.to_rules_dict(mined.vocab_names)
         expected = reference_slow_rules(
-            baskets, min_support, min_confidence, max_len=3
+            baskets, min_support, min_confidence, max_len=max_len
         )
         for key, row in expected.items():
             assert got.get(key) == row, key
         # our extra keys (frequent items with no rule ≥ threshold) are empty
         for key in set(got) - set(expected):
             assert got[key] == {}
-        # sanity: the triples actually changed something vs pairwise-only
-        pairwise = reference_slow_rules(
-            baskets, min_support, min_confidence, max_len=2
+        # sanity: this length actually changed something vs one length less
+        shorter = reference_slow_rules(
+            baskets, min_support, min_confidence, max_len=max_len - 1
         )
-        assert expected != pairwise, "workload produced no frequent triples"
+        assert expected != shorter, f"no frequent itemsets of len {max_len}"
+        # census covers every enumerated length exactly
+        from .oracle import frequent_itemsets
+
+        by_len: dict[int, int] = {}
+        for s in frequent_itemsets(baskets, min_support, max_len):
+            by_len[len(s)] = by_len.get(len(s), 0) + 1
+        for length in range(1, max_len + 1):
+            assert mined.itemset_census[length] == by_len.get(length, 0)
+
+    def test_merge_preserves_emission_overflow(self):
+        # a row truncated at EMISSION stays counted as overflowed after the
+        # merge even when the merged candidate set fits k_max — isolate the
+        # row_valid_counts path with no contributions and a sparse row
+        import dataclasses as dc
+
+        base = rules.RuleTensors(
+            rule_ids=np.array([[1, -1]], dtype=np.int32),
+            rule_counts=np.array([[3, 0]], dtype=np.int32),
+            rule_confs=np.array([[0.75, 0.0]], dtype=np.float32),
+            item_counts=np.array([4], dtype=np.int32),
+            n_playlists=8, min_support=0.25, min_count=2,
+            mode="confidence", min_confidence=0.0,
+            n_frequent_items=1, n_songs_missing=0, overflow_rows=1,
+            row_valid_counts=np.array([5], dtype=np.int32),  # 5 > k_max=2
+        )
+        empty = (
+            np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.float64)
+        )
+        merged = rules.merge_confidence_contributions(base, [empty], k_max=2)
+        assert merged.overflow_rows == 1  # merged set is 1 entry ≤ k_max
+        # without the emission record the merge alone would say 0
+        blind = rules.merge_confidence_contributions(
+            dc.replace(base, row_valid_counts=None), [empty], k_max=2
+        )
+        assert blind.overflow_rows == 0
 
     def test_k_max_truncation_and_overflow(self, tiny_baskets):
         b = build_baskets(table_from_baskets(tiny_baskets))
