@@ -22,6 +22,9 @@ This package re-implements every component TPU-first:
 - ``serving/``  — the online API (reference: rest_api/app/main.py): identical
                   HTTP surface served from HBM-resident rule tensors with a
                   double-buffered hot swap driven by the same polling protocol.
+- ``models/``   — the model abstraction: rule tensors + vocabulary + jitted
+                  apply as one deployable object, in two families
+                  (support-mode / confidence-mode semantics).
 - ``io/``       — artifact + state files: the pickle wire format the reference
                   serves from, dataset registry, run history, invalidation
                   token (reference: machine-learning/main.py:315-411).

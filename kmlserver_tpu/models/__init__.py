@@ -1,0 +1,21 @@
+"""Model layer — what "model" means in this framework.
+
+The reference system has no neural network: its "model" is the association-
+rule artifact the mining job produces and the API serves (reference:
+machine-learning/main.py:262-313 produces it; rest_api/app/main.py:224-254
+applies it). This package names that abstraction explicitly:
+
+- :class:`RuleModel` — the deployable unit: HBM-resident rule tensors +
+  vocabulary + the jitted apply (recommendation) function.
+- two model *families*, selected by ``MiningConfig.confidence_mode``:
+  ``"support"`` (the reference fast path's symmetric support-as-confidence
+  rules) and ``"confidence"`` (true asymmetric confidence with
+  multi-antecedent rules, the slow path's semantics).
+
+Training = ``kmlserver_tpu.mining.miner.mine``; inference =
+``kmlserver_tpu.ops.serve.recommend_batch``; serialization =
+``kmlserver_tpu.io.artifacts``. This module composes them into the
+model-object view without duplicating any of it.
+"""
+
+from .rule_model import RuleModel  # noqa: F401

@@ -1,0 +1,113 @@
+"""The deployable rule model: tensors + vocab + jitted apply.
+
+Composes the existing pieces (miner → tensors, artifacts → persistence,
+ops/serve → apply) into one object, for library users who want the model
+without running the full job/API stack. The serving engine keeps its own
+:class:`~kmlserver_tpu.serving.engine.RuleBundle` (adds hot-swap state);
+both sit on the same three primitives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MiningConfig
+from ..io import artifacts
+from ..mining.vocab import Baskets
+from ..ops.serve import recommend_batch
+
+
+@dataclasses.dataclass
+class RuleModel:
+    """Association-rule model over a track vocabulary."""
+
+    vocab: list[str]
+    index: dict[str, int]
+    rule_ids: jax.Array  # int32 (V, K_max), device
+    rule_confs: jax.Array  # float32 (V, K_max), device
+    mode: str  # "support" | "confidence" (the model family)
+
+    # ---------- construction ----------
+
+    @staticmethod
+    def fit(
+        baskets: Baskets,
+        cfg: MiningConfig | None = None,
+        mesh: "jax.sharding.Mesh | None" = None,
+    ) -> "RuleModel":
+        """Mine a model from a transaction DB (the "training" step)."""
+        from ..mining.miner import mine
+
+        cfg = cfg or MiningConfig()
+        result = mine(baskets, cfg, mesh=mesh)
+        t = result.tensors
+        return RuleModel(
+            vocab=list(result.vocab_names),
+            index={n: i for i, n in enumerate(result.vocab_names)},
+            rule_ids=jax.device_put(jnp.asarray(t.rule_ids)),
+            rule_confs=jax.device_put(jnp.asarray(t.rule_confs)),
+            mode=t.mode,
+        )
+
+    @staticmethod
+    def load(npz_path: str) -> "RuleModel":
+        """Load from the tensor-native artifact the mining job writes."""
+        loaded = artifacts.load_rule_tensors(npz_path)
+        return RuleModel(
+            vocab=loaded["vocab"],
+            index={n: i for i, n in enumerate(loaded["vocab"])},
+            rule_ids=jax.device_put(jnp.asarray(loaded["rule_ids"])),
+            rule_confs=jax.device_put(jnp.asarray(loaded["rule_confs"])),
+            mode=loaded["mode"],
+        )
+
+    # ---------- inference ----------
+
+    def encode_seeds(
+        self, seed_sets: list[list[str]], pad_len: int | None = None
+    ) -> np.ndarray:
+        """Seed names → int32 (B, L) id batch, -1 padded; unknown names drop."""
+        ids = [
+            [self.index[s] for s in seeds if s in self.index]
+            for seeds in seed_sets
+        ]
+        length = pad_len or max((len(r) for r in ids), default=1) or 1
+        out = np.full((len(seed_sets), length), -1, dtype=np.int32)
+        for r, row in enumerate(ids):
+            out[r, : min(len(row), length)] = row[:length]
+        return out
+
+    def recommend(
+        self, seed_sets: list[list[str]], k_best: int = 10
+    ) -> list[list[str]]:
+        """Batched apply: ONE device call for the whole batch. Batch and
+        seed-length dims are bucketed to powers of two so naturally varying
+        call shapes reuse a bounded set of compiled kernels (the same
+        strategy as the serving engine's shape buckets) instead of paying a
+        fresh jit compile per distinct (B, L)."""
+        longest = max((len(s) for s in seed_sets), default=1)
+        pad_len = 1 << max(longest - 1, 0).bit_length()
+        seed_arr = self.encode_seeds(seed_sets, pad_len=pad_len)
+        n_rows = 1 << max(len(seed_sets) - 1, 0).bit_length()
+        if n_rows > seed_arr.shape[0]:
+            seed_arr = np.concatenate(
+                [seed_arr, np.full((n_rows - seed_arr.shape[0], pad_len), -1,
+                                   dtype=np.int32)]
+            )
+        top_ids, _ = self.apply_fn(k_best)(
+            self.rule_ids, self.rule_confs, jnp.asarray(seed_arr)
+        )
+        top_ids = np.asarray(top_ids)[: len(seed_sets)]
+        return [
+            [self.vocab[int(i)] for i in row if i >= 0] for row in top_ids
+        ]
+
+    @staticmethod
+    def apply_fn(k_best: int = 10):
+        """The raw jittable forward step (what ``__graft_entry__`` exposes)."""
+        return partial(recommend_batch, k_best=k_best)

@@ -135,6 +135,13 @@ class RecommendEngine:
             except FileNotFoundError as exc:
                 logger.warning("artifacts not ready: %s", exc)
                 return False
+            except Exception:
+                # corrupt/torn artifact (the REFERENCE mining job writes
+                # non-atomically — its report acknowledges the race; this
+                # engine must serve either side's PVC): keep the current
+                # bundle, retry on the next poll
+                logger.exception("artifact load failed; keeping current bundle")
+                return False
             # warm the serving kernel for every seed-bucket shape BEFORE
             # publishing: the first jit compile costs seconds on TPU and must
             # not land inside a request (readiness implies warmed). Reloads
@@ -155,8 +162,18 @@ class RecommendEngine:
 
     def _build_bundle(self, rec_path: str, npz_path: str) -> RuleBundle:
         token = self._read_token() or ""
+        loaded = None
         if self.cfg.prefer_tensor_artifact and os.path.exists(npz_path):
-            loaded = artifacts.load_rule_tensors(npz_path)
+            try:
+                loaded = artifacts.load_rule_tensors(npz_path)
+            except Exception:
+                # torn/corrupt npz next to a possibly-intact pickle of the
+                # same generation: fall through to the pickle rather than
+                # abandoning the whole reload
+                logger.exception(
+                    "tensor artifact %s unreadable; trying the pickle", npz_path
+                )
+        if loaded is not None:
             vocab = loaded["vocab"]
             rule_ids = loaded["rule_ids"]
             rule_confs = loaded["rule_confs"]
@@ -304,14 +321,13 @@ class RecommendEngine:
         lifespan + @repeat_every timer (rest_api/app/main.py:100-108)."""
 
         def loop() -> None:
-            self.reload_if_required()
             interval = max(self.cfg.polling_wait_in_minutes * 60.0, 0.05)
-            while True:
-                time.sleep(interval)
+            while True:  # first load included: a crash must not kill the poller
                 try:
                     self.reload_if_required()
-                except Exception:  # never kill the poller
+                except Exception:
                     logger.exception("reload failed; will retry next poll")
+                time.sleep(interval)
 
         thread = threading.Thread(target=loop, daemon=True, name="kmls-reload-poller")
         thread.start()

@@ -1,0 +1,64 @@
+"""RuleModel facade: fit/load/recommend compose the mining, artifact, and
+serving primitives without semantic drift from the engine path."""
+
+import numpy as np
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.io import artifacts
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.mining.vocab import build_baskets
+from kmlserver_tpu.models import RuleModel
+
+from .oracle import random_baskets, reference_fast_rules, reference_recommend
+from .test_ops import table_from_baskets
+
+
+def test_fit_and_recommend_matches_oracle(rng):
+    baskets_list = random_baskets(rng, n_playlists=50, n_tracks=16, mean_len=5)
+    model = RuleModel.fit(
+        build_baskets(table_from_baskets(baskets_list)),
+        MiningConfig(min_support=0.08, k_max_consequents=32),
+    )
+    assert model.mode == "support"
+    rules = reference_fast_rules(baskets_list, 0.08)
+    seeds = [s for s, row in rules.items() if row][:3]
+    got = model.recommend([seeds], k_best=5)[0]
+    expected = [name for name, _ in reference_recommend(rules, seeds, 5)]
+    assert sorted(got) == sorted(expected)  # same set (tie order may differ)
+
+
+def test_load_equals_fit(tmp_path, rng):
+    baskets = build_baskets(
+        table_from_baskets(
+            random_baskets(rng, n_playlists=40, n_tracks=12, mean_len=4)
+        )
+    )
+    cfg = MiningConfig(min_support=0.1, k_max_consequents=16)
+    fitted = RuleModel.fit(baskets, cfg)
+    result = mine(baskets, cfg)
+    path = str(tmp_path / "m.npz")
+    t = result.tensors
+    artifacts.save_rule_tensors(
+        path, vocab=result.vocab_names, rule_ids=t.rule_ids,
+        rule_counts=t.rule_counts, item_counts=t.item_counts,
+        n_playlists=result.n_playlists, min_support=cfg.min_support,
+    )
+    loaded = RuleModel.load(path)
+    assert loaded.vocab == fitted.vocab
+    np.testing.assert_array_equal(
+        np.asarray(loaded.rule_ids), np.asarray(fitted.rule_ids)
+    )
+    assert loaded.recommend([[fitted.vocab[0]]]) == fitted.recommend(
+        [[fitted.vocab[0]]]
+    )
+
+
+def test_encode_seeds_drops_unknown_and_pads():
+    model = RuleModel(
+        vocab=["a", "b"], index={"a": 0, "b": 1},
+        rule_ids=None, rule_confs=None, mode="support",
+    )
+    arr = model.encode_seeds([["a", "zz", "b"], ["zz"]], pad_len=4)
+    np.testing.assert_array_equal(
+        arr, [[0, 1, -1, -1], [-1, -1, -1, -1]]
+    )

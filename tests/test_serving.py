@@ -87,6 +87,35 @@ class TestEngine:
         c, _ = engine2.recommend(["definitely-unknown-1", "unknown-2"])
         assert c == a
 
+    def test_fail_soft_on_corrupt_artifact(self, mined_pvc):
+        # a torn/corrupt pickle (the reference job writes non-atomically)
+        # must not crash the engine or evict a previously-good bundle
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        assert engine.load()
+        good_bundle = engine.bundle
+        # corrupt both the npz and the pickle, then signal staleness
+        for name in (cfg.recommendations_file, cfg.recommendations_file + ".tensors.npz"):
+            with open(f"{cfg.base_dir}/pickles/{name}", "wb") as fh:
+                fh.write(b"\x80garbage-not-a-pickle")
+        registry.append_history_and_invalidate(
+            MiningConfig(base_dir=cfg.base_dir), 1, "ds1"
+        )
+        assert engine.is_data_stale()
+        assert engine.load() is False  # fail-soft, no exception
+        assert engine.bundle is good_bundle  # old generation still serving
+
+    def test_corrupt_npz_falls_back_to_intact_pickle(self, mined_pvc):
+        # a torn npz beside a VALID pickle of the same generation must not
+        # block the reload — the pickle path serves the new data
+        cfg, _, _ = mined_pvc
+        npz = f"{cfg.base_dir}/pickles/{cfg.recommendations_file}.tensors.npz"
+        with open(npz, "wb") as fh:
+            fh.write(b"torn")
+        engine = RecommendEngine(cfg)
+        assert engine.load() is True
+        assert engine.bundle is not None
+
     def test_fail_soft_on_empty_pvc(self, tmp_path):
         cfg = ServingConfig(base_dir=str(tmp_path))
         engine = RecommendEngine(cfg)
