@@ -34,6 +34,19 @@ class RuleModel:
 
     # ---------- construction ----------
 
+    @classmethod
+    def _from_tensors(
+        cls, vocab: list[str], rule_ids, rule_confs, mode: str
+    ) -> "RuleModel":
+        """The one place host tensors become a device-resident model."""
+        return cls(
+            vocab=list(vocab),
+            index={n: i for i, n in enumerate(vocab)},
+            rule_ids=jax.device_put(jnp.asarray(rule_ids)),
+            rule_confs=jax.device_put(jnp.asarray(rule_confs)),
+            mode=mode,
+        )
+
     @staticmethod
     def fit(
         baskets: Baskets,
@@ -46,24 +59,17 @@ class RuleModel:
         cfg = cfg or MiningConfig()
         result = mine(baskets, cfg, mesh=mesh)
         t = result.tensors
-        return RuleModel(
-            vocab=list(result.vocab_names),
-            index={n: i for i, n in enumerate(result.vocab_names)},
-            rule_ids=jax.device_put(jnp.asarray(t.rule_ids)),
-            rule_confs=jax.device_put(jnp.asarray(t.rule_confs)),
-            mode=t.mode,
+        return RuleModel._from_tensors(
+            result.vocab_names, t.rule_ids, t.rule_confs, t.mode
         )
 
     @staticmethod
     def load(npz_path: str) -> "RuleModel":
         """Load from the tensor-native artifact the mining job writes."""
         loaded = artifacts.load_rule_tensors(npz_path)
-        return RuleModel(
-            vocab=loaded["vocab"],
-            index={n: i for i, n in enumerate(loaded["vocab"])},
-            rule_ids=jax.device_put(jnp.asarray(loaded["rule_ids"])),
-            rule_confs=jax.device_put(jnp.asarray(loaded["rule_confs"])),
-            mode=loaded["mode"],
+        return RuleModel._from_tensors(
+            loaded["vocab"], loaded["rule_ids"], loaded["rule_confs"],
+            loaded["mode"],
         )
 
     # ---------- inference ----------

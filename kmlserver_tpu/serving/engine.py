@@ -132,6 +132,13 @@ class RecommendEngine:
             try:
                 best = artifacts.load_pickle(best_path)
                 bundle = self._build_bundle(rec_path, npz_path)
+                # warm the serving kernel for every seed-bucket shape BEFORE
+                # publishing: the first jit compile costs seconds on TPU and
+                # must not land inside a request (readiness implies warmed).
+                # Reloads with unchanged tensor shapes hit the jit cache and
+                # skip this. Inside the try: tensors that np.load accepts
+                # but the kernel rejects must fail-soft too.
+                self._warmup(bundle)
             except FileNotFoundError as exc:
                 logger.warning("artifacts not ready: %s", exc)
                 return False
@@ -142,11 +149,6 @@ class RecommendEngine:
                 # bundle, retry on the next poll
                 logger.exception("artifact load failed; keeping current bundle")
                 return False
-            # warm the serving kernel for every seed-bucket shape BEFORE
-            # publishing: the first jit compile costs seconds on TPU and must
-            # not land inside a request (readiness implies warmed). Reloads
-            # with unchanged tensor shapes hit the jit cache and skip this.
-            self._warmup(bundle)
             # atomic publication: single reference assignments
             self.best_tracks = best
             self.bundle = bundle

@@ -25,6 +25,19 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# hermetic against ambient config: a developer shell with the env-var
+# contract exported (BASE_DIR=..., MIN_SUPPORT=...) must not leak into
+# tests that construct configs from env/defaults
+for _var in (
+    "BASE_DIR", "DATASETS_DIR", "PICKLE_DIR", "PICKLES_FOLDER",
+    "MIN_SUPPORT", "REGEX_FILENAME", "SAMPLE_RATIO", "K_BEST_TRACKS",
+    "POLLING_WAIT_IN_MINUTES", "VERSION", "RECOMMENDATIONS_FILE",
+    "BEST_TRACKS_FILE", "DATA_INVALIDATION_FILE",
+):
+    os.environ.pop(_var, None)
+for _var in [v for v in os.environ if v.startswith("KMLS_")]:
+    os.environ.pop(_var, None)
+
 import numpy as np
 import pytest
 
