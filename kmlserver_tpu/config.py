@@ -86,8 +86,13 @@ class MiningConfig:
     # the multi-host runtime is active (KMLS_COORDINATOR_ADDRESS set).
     mesh_shape: str = "auto"
     # Use the bit-packed popcount path instead of int8 matmul when the
-    # one-hot matrix would exceed this many elements.
+    # one-hot matrix would exceed this many elements (single-device AND
+    # sharded: over a mesh this selects the dp-sharded popcount slabs).
     bitpack_threshold_elems: int = 1 << 28
+    # Sharded dense pair-count implementation: "gspmd" (annotate + let XLA
+    # partition), "allgather" (explicit shard_map), "ring" (ppermute
+    # neighbor exchange; lowest peak memory).
+    sharded_impl: str = "gspmd"
     # Above this vocabulary size, prune infrequent items (exact, by the
     # Apriori property) before pair counting — the path that makes the
     # 1M-track configs feasible (a dense 1M x 1M count matrix is 4 TB).
@@ -126,6 +131,7 @@ class MiningConfig:
             min_confidence=_getenv_float("KMLS_MIN_CONFIDENCE", 0.04),
             mesh_shape=os.getenv("KMLS_MESH_SHAPE", "auto"),
             bitpack_threshold_elems=_getenv_int("KMLS_BITPACK_THRESHOLD_ELEMS", 1 << 28),
+            sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
             prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 4096),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
         )

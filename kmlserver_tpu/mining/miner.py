@@ -64,6 +64,7 @@ def pair_count_fn(
     baskets: Baskets,
     mesh: "jax.sharding.Mesh | None" = None,
     bitpack_threshold_elems: int | None = None,
+    sharded_impl: str = "gspmd",
 ) -> tuple[jax.Array, jax.Array | None]:
     """One-hot encode + pair-support count: sharded, bit-packed, or dense.
 
@@ -74,9 +75,19 @@ def pair_count_fn(
     point), so ``None`` is returned.
     """
     if mesh is not None:
+        elems = baskets.n_playlists * baskets.n_tracks
+        if (
+            bitpack_threshold_elems is not None
+            and elems > bitpack_threshold_elems
+        ):
+            # config-4 scale: bit-packed slabs sharded over dp, Pallas
+            # popcount per chip, psum over ICI
+            from ..parallel.support import sharded_bitpack_pair_counts
+
+            return sharded_bitpack_pair_counts(baskets, mesh), None
         from ..parallel.support import sharded_pair_counts
 
-        return sharded_pair_counts(baskets, mesh), None
+        return sharded_pair_counts(baskets, mesh, impl=sharded_impl), None
     elems = baskets.n_playlists * baskets.n_tracks
     if bitpack_threshold_elems is not None and elems > bitpack_threshold_elems:
         if jax.default_backend() == "tpu":
@@ -295,6 +306,7 @@ def mine(
             counts, x = pair_count_fn(
                 mined_baskets, mesh,
                 bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+                sharded_impl=cfg.sharded_impl,
             )
             jax.block_until_ready(counts)
         with timer.phase("rule_emission"):

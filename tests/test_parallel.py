@@ -63,6 +63,31 @@ def test_unknown_impl_raises(baskets):
         sharded_pair_counts(baskets, mesh_mod.make_mesh("8x1"), impl="nope")
 
 
+@pytest.mark.parametrize("shape", ["8x1", "4x1", "2x1"])
+def test_sharded_bitpack_matches_single_device(baskets, shape):
+    """dp-sharded Pallas popcount slabs (interpret mode on CPU) must agree
+    exactly with the dense single-device kernel."""
+    from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
+
+    devices = jax.devices()[: int(shape.split("x")[0])]
+    m = mesh_mod.make_mesh(shape, devices=devices)
+    got = np.asarray(sharded_bitpack_pair_counts(baskets, m, interpret=True))
+    np.testing.assert_array_equal(got, single_device_counts(baskets))
+
+
+def test_miner_selects_sharded_bitpack(baskets):
+    """pair_count_fn routes to the bit-packed sharded path above the
+    threshold and still produces exact counts."""
+    from kmlserver_tpu.mining.miner import pair_count_fn
+
+    m = mesh_mod.make_mesh("8x1")
+    counts, x = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
+    assert x is None
+    np.testing.assert_array_equal(
+        np.asarray(counts), single_device_counts(baskets)
+    )
+
+
 class TestDistributed:
     """Multi-host bootstrap + hybrid-mesh layout (single-process here; the
     env parsing and mesh-layout rules are what's testable without N hosts —
