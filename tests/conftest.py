@@ -1,29 +1,19 @@
 """Test harness: force JAX onto a virtual 8-device CPU platform.
 
 Multi-chip sharding is tested without TPU hardware via XLA's host platform
-with 8 virtual devices. Two mechanisms, both needed:
-
-- ``XLA_FLAGS`` must be in the environment before the first backend
-  initialization (conftest import is early enough);
-- the platform must be forced to "cpu" via ``jax.config`` — an environment
-  variable is NOT sufficient here because this image's site hook registers a
-  remote TPU ("axon") backend at interpreter startup and pins the platform
-  selection programmatically; re-updating the config keeps the remote TPU
-  client from ever being constructed inside the test process.
+with 8 virtual devices. The how and the why (the image's site hook registers
+a remote-TPU backend that hangs when probed) live in ONE place:
+``kmlserver_tpu.utils.virtualcpu`` — conftest import is early enough for the
+env half of that recipe to beat the first backend initialization.
 """
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# for any python subprocess a test may spawn
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+from kmlserver_tpu.utils.virtualcpu import force_virtual_cpu
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+# session-wide and deliberately permanent: env mutations are inherited by
+# any python subprocess a test spawns
+force_virtual_cpu(8)
 
 # hermetic against ambient config: a developer shell with the env-var
 # contract exported (BASE_DIR=..., MIN_SUPPORT=...) must not leak into
