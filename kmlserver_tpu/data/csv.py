@@ -79,23 +79,19 @@ def read_tracks(path: str, sample_ratio: float = 1.0) -> TrackTable:
     # never silently-wrong data (pandas leaves them as an object column)
     try:
         pid_num = pd.to_numeric(df["pid"], errors="raise")
-        # reject float-like ("1.5") and out-of-int64-range pids instead of
-        # truncating/wrapping them into the wrong playlist — the same
-        # strictness the native parser enforces (strtoll + ERANGE).
-        # dtype-aware: int64.max is not float64-representable, so range
-        # checks must not round-trip in-range ints through float
+        # reject float-formatted ("1.5", "1.0", "2e3") and out-of-int64-range
+        # pids instead of truncating/wrapping them into the wrong playlist —
+        # the same strictness the native parser enforces (strtoll + ERANGE
+        # treats any non-[0-9] trailing byte as a parse error, so even
+        # integral-VALUED float spellings must fail here, not round-trip)
         if pid_num.dtype == np.uint64:
             if (pid_num.to_numpy() > np.uint64(np.iinfo(np.int64).max)).any():
                 raise ValueError("pid exceeds int64 range")
         elif not np.issubdtype(pid_num.dtype, np.integer):
-            arr = pid_num.to_numpy(dtype=np.float64)
-            # ±2^63 are exact in float64; values at/beyond them overflow int64
-            if (
-                not np.isfinite(arr).all()
-                or (arr != np.floor(arr)).any()
-                or (np.abs(arr) >= 2.0**63).any()
-            ):
-                raise ValueError("non-integer or out-of-range pid value")
+            raise ValueError(
+                "non-integer-formatted pid value (float spellings like "
+                "'1.0' are rejected, matching the native parser)"
+            )
         pid = pid_num.astype(np.int64).to_numpy()
     except (ValueError, TypeError) as exc:
         raise ValueError(f"{path}: invalid pid column: {exc}") from None

@@ -81,9 +81,18 @@ def pair_count_fn(
             and elems > bitpack_threshold_elems
         ):
             # config-4 scale: bit-packed slabs sharded over dp, Pallas
-            # popcount per chip, psum over ICI
+            # popcount per chip, psum over ICI. The bitpack impl shards the
+            # word axis over dp ONLY — on a dp×tp mesh the tp chips would
+            # each redundantly hold the full per-host slab (per-chip memory
+            # O(V·P/(32·dp)) instead of O(V·P/(32·n_chips))), so flatten
+            # every device onto dp first.
+            from ..parallel.mesh import AXIS_TP, make_mesh
             from ..parallel.support import sharded_bitpack_pair_counts
 
+            if mesh.shape.get(AXIS_TP, 1) > 1:
+                mesh = make_mesh(
+                    "auto", devices=list(mesh.devices.flatten())
+                )
             return sharded_bitpack_pair_counts(baskets, mesh), None
         from ..parallel.support import sharded_pair_counts
 

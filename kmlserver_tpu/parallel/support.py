@@ -135,11 +135,18 @@ def sharded_bitpack_pair_counts(
     Per-chip memory is O(V · P/(32·dp)) — 32× below the sharded dense
     int8 path — which is what makes BASELINE.json config 4 (10M baskets,
     1M-track vocabulary Apriori-pruned to the frequent items) fit in HBM.
-    The ``tp`` axis is unused (inputs replicated over it); run this impl on
-    a ``Nx1`` mesh.
+    Requires a ``Nx1`` mesh: the word axis shards over ``dp`` only, and a
+    ``tp > 1`` mesh would silently replicate the full slab on every tp chip
+    (defeating the memory budget), so it is rejected — callers flatten all
+    devices onto ``dp`` first (mining.miner.pair_count_fn does).
     """
     from ..ops import popcount as pc
 
+    if mesh.shape.get(AXIS_TP, 1) > 1:
+        raise ValueError(
+            f"sharded_bitpack_pair_counts needs a dp-only (Nx1) mesh, got "
+            f"{dict(mesh.shape)}; flatten devices onto dp first"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     dp = mesh.shape[AXIS_DP]

@@ -290,6 +290,10 @@ def _esc(s: str) -> str:
 def make_handler(app: RecommendApp):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # the handler writes headers and body as separate sends on an
+        # unbuffered socket; with Nagle on, the body send sits behind the
+        # peer's delayed ACK (~40ms) — at QPS scale that dominates latency
+        disable_nagle_algorithm = True
 
         def _dispatch(self, method: str) -> None:
             body = None

@@ -134,6 +134,15 @@ def test_float_pid_rejected_on_both_paths(tmp_path, monkeypatch):
     over.write_text("pid,track_name\n9223372036854775808,x\n")
     with pytest.raises(ValueError, match="pid"):
         read_tracks(str(over))
+    # integral-VALUED float spellings ("1.0", "2e3") parse to a float dtype
+    # and previously slipped through the floor/range checks — the native
+    # strtoll parser rejects them as trailing garbage, so the pandas path
+    # must agree (the two loaders may not disagree on the same file)
+    for cell in ("1.0", "2e3"):
+        fp = tmp_path / f"intfloatpid_{cell.replace('.', '_')}.csv"
+        fp.write_text(f"pid,track_name\n{cell},x\n2,y\n")
+        with pytest.raises(ValueError, match="pid"):
+            read_tracks(str(fp))
 
 
 def test_empty_cell_parity_with_pandas(tmp_path, monkeypatch):

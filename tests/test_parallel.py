@@ -88,6 +88,24 @@ def test_miner_selects_sharded_bitpack(baskets):
     )
 
 
+def test_miner_flattens_mesh_for_bitpack(baskets):
+    """On a dp×tp mesh the bitpack path must flatten all devices onto dp
+    (the word axis shards over dp only — a 4x2 mesh would otherwise leave
+    the tp pairs holding redundant full slabs) and stay exact."""
+    from kmlserver_tpu.mining.miner import pair_count_fn
+    from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
+
+    m = mesh_mod.make_mesh("4x2")
+    counts, x = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
+    assert x is None
+    np.testing.assert_array_equal(
+        np.asarray(counts), single_device_counts(baskets)
+    )
+    # and the impl itself rejects a tp>1 mesh outright
+    with pytest.raises(ValueError, match="dp-only"):
+        sharded_bitpack_pair_counts(baskets, m)
+
+
 class TestDistributed:
     """Multi-host bootstrap + hybrid-mesh layout (single-process here; the
     env parsing and mesh-layout rules are what's testable without N hosts —
