@@ -214,14 +214,6 @@ dense_fn = jax.jit(lambda a, b: support.pair_counts(encode.onehot_matrix(a, b, *
 dense = dense_fn(pr, ti)
 dense.block_until_ready()  # warm-up/compile
 
-# compiled (interpret=False) Pallas bitset-popcount kernel — the config-4
-# perf path, executed here as a real TPU kernel for the first time
-pc = popcount_pair_counts(baskets.playlist_rows, baskets.track_ids,
-                          interpret=False, **kw)
-pc.block_until_ready()
-np.testing.assert_array_equal(np.asarray(dense), np.asarray(pc))
-print("popcount == dense on-device: EXACT", file=sys.stderr, flush=True)
-
 def med(fn, n=5):
     ts = []
     for _ in range(n):
@@ -230,10 +222,39 @@ def med(fn, n=5):
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts) * 1e3
 
+# compiled (interpret=False) Pallas bitset-popcount kernel — the config-4
+# perf path, executed as a real TPU kernel. Mosaic lowering can't be
+# pre-verified off-hardware, so try each (variant, popcount-impl) config
+# until one compiles AND matches the dense counts exactly; report which.
+chosen = None
+for variant, swar in (("bcast", False), ("row", False),
+                      ("bcast", True), ("row", True)):
+    label = f"{variant}{'-swar' if swar else ''}"
+    try:
+        pc = popcount_pair_counts(
+            baskets.playlist_rows, baskets.track_ids,
+            interpret=False, variant=variant, swar=swar, **kw)
+        pc.block_until_ready()
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(pc))
+        print(f"popcount[{label}] == dense on-device: EXACT",
+              file=sys.stderr, flush=True)
+        chosen = (variant, swar, label)
+        break
+    except Exception as exc:
+        print(f"popcount[{label}] failed: {type(exc).__name__}: "
+              f"{str(exc).splitlines()[0][:300]}", file=sys.stderr, flush=True)
+if chosen is None:
+    print("all popcount kernel configs failed to compile/run on this backend",
+          file=sys.stderr, flush=True)
+    sys.exit(1)
+
+variant, swar, label = chosen
 dense_ms = med(lambda: dense_fn(pr, ti))
 pc_ms = med(lambda: popcount_pair_counts(
-    baskets.playlist_rows, baskets.track_ids, interpret=False, **kw))
-print(json.dumps({"dense_ms": dense_ms, "popcount_ms": pc_ms, "exact": True}))
+    baskets.playlist_rows, baskets.track_ids,
+    interpret=False, variant=variant, swar=swar, **kw))
+print(json.dumps({"dense_ms": dense_ms, "popcount_ms": pc_ms,
+                  "exact": True, "kernel": label}))
 """
 
 _SERVING_BENCH = r"""
@@ -256,6 +277,14 @@ for _ in range(50):
     lat.append(time.perf_counter() - t0)
 lat.sort()
 print(json.dumps({"p50_ms": lat[len(lat) // 2] * 1e3}))
+"""
+
+# run scripts/scale_demo.py under _run_phase's retry/diagnosis machinery
+# (cwd is the repo root, set by _run_phase)
+_SCALE_BENCH = r"""
+import runpy, sys
+sys.argv = ["scale_demo"] + sys.argv[1:]
+runpy.run_path("scripts/scale_demo.py", run_name="__main__")
 """
 
 _CSV_SETUP = r"""
@@ -472,12 +501,28 @@ def main() -> int:
             )
             if popcount is not None:
                 log(
-                    f"popcount kernel (compiled TPU, ds2 shape): "
-                    f"{popcount['popcount_ms']:.2f}ms vs dense MXU "
-                    f"{popcount['dense_ms']:.2f}ms, exact match"
+                    f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
+                    f"ds2 shape): {popcount['popcount_ms']:.2f}ms vs dense "
+                    f"MXU {popcount['dense_ms']:.2f}ms, exact match"
                 )
                 result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
                 result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
+                result["popcount_kernel"] = popcount["kernel"]
+
+        if platform == "tpu" and _elapsed() < DEADLINE_S:
+            # config-4 scale mechanics on real HBM: 1M playlists x 100k
+            # vocab through Apriori prune + the bit-packed popcount path
+            # (SCALE.md documents the model; this captures the numbers)
+            scale = _run_phase(
+                "scale", _SCALE_BENCH,
+                ["--playlists", "1000000", "--tracks", "100000",
+                 "--rows", "50000000", "--min-support", "0.001"],
+                platform=platform, timeout=900,
+            )
+            if scale is not None:
+                result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
+                result["scale_rows_per_s"] = scale["rows_per_s"]
+                result["scale_frequent_items"] = scale["frequent_items"]
 
         if _elapsed() < DEADLINE_S:
             serving = _run_phase(

@@ -8,23 +8,43 @@ uint32 bit-words shrinks the operand 32× and turns pair counting into
     C[i, j] = Σ_w popcount(Bt[i, w] & Bt[j, w])
 
 where ``Bt (V, ceil(P/32)) uint32`` holds track i's playlist membership as a
-bitset. This kernel tiles that computation for the VPU:
+bitset. The kernel tiles that computation for the VPU:
 
 - grid ``(i_tile, j_tile, w_chunk)``: output tile ``(TI, TJ) int32`` revisited
   across the trailing ``w_chunk`` dimension and accumulated in place
   (zero-initialized at the first chunk via ``@pl.when``);
 - per step, row block A ``(TI, WK)`` and column block B ``(TJ, WK)`` live in
-  VMEM; a ``fori_loop`` over the TI rows does AND + ``population_count`` +
-  word-sum on the VPU — no MXU involvement, no unpacking;
+  VMEM; AND + popcount + word-sum run on the VPU — no MXU, no unpacking;
 - V is padded to the 128-lane tile and P to 32·WK word chunks with zero
   bits, which contribute zero counts and are sliced away by the caller.
 
+Two kernel variants (``variant=``), identical results, different lowering
+risk/perf profiles — selectable so the on-hardware bench can pick whichever
+actually lowers fastest (this environment has no local TPU to pre-verify
+Mosaic lowering):
+
+- ``"bcast"`` (default): fully vectorized — slices the word chunk into
+  SUB-wide pieces and broadcasts ``(TI, 1, SUB) & (1, TJ, SUB)``; only
+  static shapes, no dynamic VMEM indexing.
+- ``"row"``: a ``fori_loop`` over the TI rows with dynamic sublane reads
+  (``a_ref[i, :]``) — smaller intermediates, more loop overhead.
+
+``swar=True`` replaces ``jax.lax.population_count`` with an adds-and-shifts
+SWAR popcount (Hacker's Delight fig. 5-2, public-domain identity) in case
+the popcount primitive doesn't lower in Mosaic.
+
 On non-TPU backends the kernel runs in interpreter mode (tests); the public
 entry point falls back gracefully.
+
+Tile sizes are env-tunable (``KMLS_POPCOUNT_TILE_I/TILE_J/WORD_CHUNK``) for
+on-hardware tuning without a code change; defaults keep every operand on
+the (8, 128) 32-bit tile grid and the per-step VMEM footprint ≈ 0.3 MB.
 """
 
 from __future__ import annotations
 
+import math
+import os
 from functools import partial
 
 import jax
@@ -33,12 +53,54 @@ import numpy as np
 
 from . import encode
 
-TILE_I = 32
-TILE_J = 128
-WORD_CHUNK = 512  # uint32 words per grid step (= 16,384 playlists)
+TILE_I = int(os.environ.get("KMLS_POPCOUNT_TILE_I", "32"))
+TILE_J = int(os.environ.get("KMLS_POPCOUNT_TILE_J", "128"))
+WORD_CHUNK = int(os.environ.get("KMLS_POPCOUNT_WORD_CHUNK", "512"))
+_SUB = 128  # lane-aligned word slice for the bcast variant's 3D intermediate
+# the vocab axis must pad to a multiple of BOTH tile sizes — rounding to
+# max() silently leaves output rows unwritten when TILE_I ∤ TILE_J
+V_TILE = math.lcm(TILE_I, TILE_J)
+if WORD_CHUNK > _SUB and WORD_CHUNK % _SUB != 0:
+    raise ValueError(
+        f"KMLS_POPCOUNT_WORD_CHUNK={WORD_CHUNK} must be a multiple of "
+        f"{_SUB} (or at most {_SUB}): the bcast kernel slices word chunks "
+        f"in {_SUB}-wide pieces and a ragged tail would be dropped"
+    )
+
+VARIANTS = ("bcast", "row")
 
 
-def _popcount_kernel(a_ref, b_ref, out_ref):
+def resolve_kernel_opts(
+    variant: str | None, swar: bool | None
+) -> tuple[str, bool]:
+    """Kernel variant/popcount-impl selection with env-var defaults
+    (``KMLS_POPCOUNT_VARIANT``, ``KMLS_POPCOUNT_SWAR``) — shared by the
+    single-chip entry AND the dp-sharded path so a deployment can be
+    retargeted without a code change on either."""
+    if variant is None:
+        variant = os.environ.get("KMLS_POPCOUNT_VARIANT", "bcast")
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}, got {variant!r}")
+    if swar is None:
+        swar = os.environ.get("KMLS_POPCOUNT_SWAR", "0") == "1"
+    return variant, swar
+
+
+def _popcount_words(x: jax.Array, swar: bool) -> jax.Array:
+    """Per-word popcount → int32. ``swar=False`` uses the hardware/XLA
+    primitive; ``swar=True`` uses shifts+adds only (no multiply, no
+    popcount primitive), for backends where the primitive won't lower."""
+    if not swar:
+        return jax.lax.population_count(x).astype(jnp.int32)
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    x = x + (x >> 16)
+    x = x + (x >> 8)
+    return (x & jnp.uint32(0x3F)).astype(jnp.int32)
+
+
+def _kernel_row(a_ref, b_ref, out_ref, *, swar: bool):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(2) == 0)
@@ -49,15 +111,47 @@ def _popcount_kernel(a_ref, b_ref, out_ref):
 
     def row(i, _):
         anded = jnp.bitwise_and(a_ref[i, :], b_block)  # broadcast (TJ, WK)
-        counts = jax.lax.population_count(anded).astype(jnp.int32)
-        out_ref[i, :] += jnp.sum(counts, axis=1)
+        out_ref[i, :] += jnp.sum(_popcount_words(anded, swar), axis=1)
         return 0
 
     jax.lax.fori_loop(0, a_ref.shape[0], row, 0)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def popcount_pair_counts_padded(bt: jax.Array, *, interpret: bool = False) -> jax.Array:
+def _kernel_bcast(a_ref, b_ref, out_ref, *, swar: bool):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    a = a_ref[:]  # (TI, WK)
+    b = b_ref[:]  # (TJ, WK)
+    ti, wk = a.shape
+    tj = b.shape[0]
+    sub = min(_SUB, wk)
+
+    def chunk(c, acc):
+        a_c = jax.lax.dynamic_slice_in_dim(a, c * sub, sub, 1)  # (TI, SUB)
+        b_c = jax.lax.dynamic_slice_in_dim(b, c * sub, sub, 1)  # (TJ, SUB)
+        anded = a_c[:, None, :] & b_c[None, :, :]  # (TI, TJ, SUB)
+        return acc + jnp.sum(_popcount_words(anded, swar), axis=2)
+
+    out_ref[:] += jax.lax.fori_loop(
+        0, wk // sub, chunk, jnp.zeros((ti, tj), jnp.int32)
+    )
+
+
+_KERNELS = {"row": _kernel_row, "bcast": _kernel_bcast}
+
+
+@partial(jax.jit, static_argnames=("interpret", "variant", "swar"))
+def popcount_pair_counts_padded(
+    bt: jax.Array,
+    *,
+    interpret: bool = False,
+    variant: str = "bcast",
+    swar: bool = False,
+) -> jax.Array:
     """Pair counts from an already-padded bitset matrix
     ``bt (V_pad, W_pad) uint32`` with V_pad % TILE_J == 0 and
     W_pad % WORD_CHUNK == 0. → int32 (V_pad, V_pad)."""
@@ -65,9 +159,15 @@ def popcount_pair_counts_padded(bt: jax.Array, *, interpret: bool = False) -> ja
     from jax.experimental.pallas import tpu as pltpu
 
     v_pad, w_pad = bt.shape
+    if v_pad % TILE_I or v_pad % TILE_J or w_pad % WORD_CHUNK:
+        raise ValueError(
+            f"bt {bt.shape} must pad V to a multiple of lcm(TILE_I, TILE_J)"
+            f"={V_TILE} and W to a multiple of WORD_CHUNK={WORD_CHUNK}; a "
+            f"truncating grid would silently skip output tiles"
+        )
     grid = (v_pad // TILE_I, v_pad // TILE_J, w_pad // WORD_CHUNK)
     return pl.pallas_call(
-        _popcount_kernel,
+        partial(_KERNELS[variant], swar=swar),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -122,12 +222,17 @@ def popcount_pair_counts(
     n_playlists: int,
     n_tracks: int,
     interpret: bool | None = None,
+    variant: str | None = None,
+    swar: bool | None = None,
 ) -> jax.Array:
     """Public entry: membership pairs → (V, V) int32 pair counts via the
-    bit-packed popcount kernel. Interpreter mode auto-enabled off-TPU."""
+    bit-packed popcount kernel. Interpreter mode auto-enabled off-TPU;
+    variant/swar default from ``KMLS_POPCOUNT_VARIANT`` / ``KMLS_POPCOUNT_SWAR``
+    so the deployed job can be retargeted without a code change."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    v_pad = _round_up(max(n_tracks, TILE_J), max(TILE_I, TILE_J))
+    variant, swar = resolve_kernel_opts(variant, swar)
+    v_pad = _round_up(max(n_tracks, V_TILE), V_TILE)
     w_pad = _round_up(
         (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, WORD_CHUNK
     )
@@ -136,5 +241,7 @@ def popcount_pair_counts(
         n_playlists=n_playlists, n_tracks=n_tracks,
         v_pad=v_pad, w_pad=w_pad,
     )
-    counts = popcount_pair_counts_padded(bt, interpret=interpret)
+    counts = popcount_pair_counts_padded(
+        bt, interpret=interpret, variant=variant, swar=swar
+    )
     return counts[:n_tracks, :n_tracks]

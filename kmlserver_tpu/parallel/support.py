@@ -126,7 +126,11 @@ _IMPLS = {
 
 
 def sharded_bitpack_pair_counts(
-    baskets: Baskets, mesh: Mesh, interpret: bool | None = None
+    baskets: Baskets,
+    mesh: Mesh,
+    interpret: bool | None = None,
+    variant: str | None = None,
+    swar: bool | None = None,
 ) -> jax.Array:
     """Pair counts over the mesh with BIT-PACKED operands: the playlist
     (word) axis is sharded over ``dp``, each chip runs the Pallas popcount
@@ -149,9 +153,10 @@ def sharded_bitpack_pair_counts(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    variant, swar = pc.resolve_kernel_opts(variant, swar)
     dp = mesh.shape[AXIS_DP]
     v = baskets.n_tracks
-    v_pad = round_up(max(v, pc.TILE_J), pc.TILE_J)  # TILE_J % TILE_I == 0
+    v_pad = round_up(max(v, pc.V_TILE), pc.V_TILE)
     w_total = round_up(
         (baskets.n_playlists + 31) // 32, dp * pc.WORD_CHUNK
     )
@@ -168,7 +173,9 @@ def sharded_bitpack_pair_counts(
     )
 
     def local(bt_local: jax.Array) -> jax.Array:
-        c = pc.popcount_pair_counts_padded(bt_local, interpret=interpret)
+        c = pc.popcount_pair_counts_padded(
+            bt_local, interpret=interpret, variant=variant, swar=swar
+        )
         return jax.lax.psum(c, AXIS_DP)
 
     counts = jax.jit(

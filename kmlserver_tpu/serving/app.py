@@ -192,15 +192,27 @@ class RecommendApp:
         return _html_response(200, page)
 
     def _get_docs(self) -> Response:
+        """Interactive API docs: the three canned request examples
+        (reference parity: rest_api/app/main.py:158-174, surfaced there via
+        Swagger UI's "try it out") each load into an editable request body
+        that can be sent to the live endpoint from the page."""
         examples = "\n".join(
             f"<h3>{_esc(ex['summary'])}</h3>"
             f"<pre>POST /api/recommend/\n{json.dumps(ex['value'], indent=2)}</pre>"
+            f"<button class='load' data-body='{_esc(json.dumps(ex['value']))}'>"
+            f"Try it</button>"
             for ex in CANNED_EXAMPLES.values()
+        )
+        first = json.dumps(
+            next(iter(CANNED_EXAMPLES.values()))["value"], indent=2
         )
         html = f"""<!doctype html><html><head><meta charset="utf-8">
 <title>API docs — Playlist Recommender</title>
 <style>body{{font-family:system-ui;max-width:760px;margin:2rem auto;padding:0 1rem}}
-pre{{background:#8881;padding:.8rem;border-radius:6px;overflow-x:auto}}</style></head>
+pre{{background:#8881;padding:.8rem;border-radius:6px;overflow-x:auto}}
+textarea{{width:100%;font-family:monospace;min-height:7rem}}
+button{{margin:.3rem .3rem .3rem 0;padding:.35rem .9rem;cursor:pointer}}
+#resp{{white-space:pre-wrap}}</style></head>
 <body><h1>Playlist Recommender API {_esc(self.cfg.version)}</h1>
 <p>Machine-readable spec: <a href="/openapi.json">/openapi.json</a></p>
 <h2 id="post-api-recommend">POST /api/recommend/</h2>
@@ -210,6 +222,35 @@ pre{{background:#8881;padding:.8rem;border-radius:6px;overflow-x:auto}}</style><
 recommendations; fully unknown seed sets fall back to a deterministic
 popular-tracks sample.</p>
 {examples}
+<h2>Try it against this server</h2>
+<textarea id="body" spellcheck="false">{_esc(first)}</textarea><br>
+<button id="send">Send POST /api/recommend/</button>
+<pre id="resp">(response appears here)</pre>
+<script>
+document.querySelectorAll('button.load').forEach(function (b) {{
+  b.addEventListener('click', function () {{
+    document.getElementById('body').value =
+      JSON.stringify(JSON.parse(b.dataset.body), null, 2);
+    document.getElementById('body').scrollIntoView({{behavior: 'smooth'}});
+  }});
+}});
+document.getElementById('send').addEventListener('click', async function () {{
+  var out = document.getElementById('resp');
+  out.textContent = '...';
+  try {{
+    var r = await fetch('/api/recommend/', {{
+      method: 'POST',
+      headers: {{'Content-Type': 'application/json'}},
+      body: document.getElementById('body').value,
+    }});
+    var text = await r.text();
+    try {{ text = JSON.stringify(JSON.parse(text), null, 2); }} catch (e) {{}}
+    out.textContent = 'HTTP ' + r.status + '\\n' + text;
+  }} catch (e) {{
+    out.textContent = 'request failed: ' + e;
+  }}
+}});
+</script>
 <h2>Other endpoints</h2>
 <ul>
 <li><code>GET /</code> — HTML test client</li>
@@ -280,7 +321,7 @@ popular-tracks sample.</p>
 def _esc(s: str) -> str:
     return (
         str(s).replace("&", "&amp;").replace("<", "&lt;")
-        .replace(">", "&gt;").replace('"', "&quot;")
+        .replace(">", "&gt;").replace('"', "&quot;").replace("'", "&#39;")
     )
 
 

@@ -25,7 +25,12 @@ def dense_counts(baskets):
 
 
 @pytest.mark.parametrize("pv", [(40, 17), (700, 300), (129, 257)])
-def test_popcount_matches_dense(rng, pv):
+@pytest.mark.parametrize("variant", ["bcast", "row"])
+@pytest.mark.parametrize("swar", [False, True])
+def test_popcount_matches_dense(rng, pv, variant, swar):
+    """Every kernel variant × popcount implementation is oracle-exact (the
+    on-hardware bench picks whichever variant lowers/runs fastest, so all
+    of them must be correct, not just the default)."""
     p, v = pv
     baskets = build_baskets(
         table_from_baskets(random_baskets(rng, n_playlists=p, n_tracks=v, mean_len=6))
@@ -34,9 +39,75 @@ def test_popcount_matches_dense(rng, pv):
         popcount_pair_counts(
             baskets.playlist_rows, baskets.track_ids,
             n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+            variant=variant, swar=swar,
         )
     )
     np.testing.assert_array_equal(got, dense_counts(baskets))
+
+
+def test_padded_entry_rejects_misaligned_shapes():
+    """A truncating grid would silently skip output tiles (wrong counts,
+    no error) — misaligned padded shapes must be rejected loudly."""
+    import jax.numpy as jnp
+
+    from kmlserver_tpu.ops.popcount import (
+        WORD_CHUNK, popcount_pair_counts_padded,
+    )
+
+    with pytest.raises(ValueError, match="truncating grid"):
+        popcount_pair_counts_padded(
+            jnp.zeros((120, WORD_CHUNK), jnp.uint32), interpret=True
+        )
+    with pytest.raises(ValueError, match="truncating grid"):
+        popcount_pair_counts_padded(
+            jnp.zeros((128, WORD_CHUNK - 12), jnp.uint32), interpret=True
+        )
+
+
+def test_kernel_opts_env_reach_sharded_path(rng, monkeypatch):
+    """KMLS_POPCOUNT_VARIANT/SWAR must retarget the dp-sharded kernel too,
+    not just the single-chip entry (the knobs exist for Mosaic-lowering
+    escape hatches, which matter most on mesh deployments)."""
+    import jax
+
+    from kmlserver_tpu.mining.vocab import build_baskets
+    from kmlserver_tpu.ops.popcount import resolve_kernel_opts
+    from kmlserver_tpu.parallel.mesh import make_mesh
+    from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
+
+    monkeypatch.setenv("KMLS_POPCOUNT_VARIANT", "row")
+    monkeypatch.setenv("KMLS_POPCOUNT_SWAR", "1")
+    assert resolve_kernel_opts(None, None) == ("row", True)
+    with pytest.raises(ValueError, match="variant"):
+        resolve_kernel_opts("nope", None)
+    baskets = build_baskets(
+        table_from_baskets(random_baskets(rng, n_playlists=40, n_tracks=17, mean_len=5))
+    )
+    mesh = make_mesh("4x1", devices=jax.devices()[:4])
+    got = np.asarray(sharded_bitpack_pair_counts(baskets, mesh, interpret=True))
+    np.testing.assert_array_equal(got, dense_counts(baskets))
+
+
+def test_swar_popcount_identity(rng):
+    """The adds-and-shifts SWAR popcount equals the hardware primitive on
+    the full uint32 edge-case set."""
+    import jax
+    import jax.numpy as jnp
+
+    from kmlserver_tpu.ops.popcount import _popcount_words
+
+    edge = np.array(
+        [0, 1, 2, 3, 0xFFFFFFFF, 0x80000000, 0x55555555, 0xAAAAAAAA,
+         0x0F0F0F0F, 0xF0F0F0F0, 0x12345678, 0xDEADBEEF],
+        dtype=np.uint32,
+    )
+    rand = rng.integers(0, 2**32, size=4096, dtype=np.uint32)
+    for arr in (edge, rand):
+        x = jnp.asarray(arr)
+        np.testing.assert_array_equal(
+            np.asarray(_popcount_words(x, swar=True)),
+            np.asarray(jax.lax.population_count(x)).astype(np.int32),
+        )
 
 
 def test_miner_popcount_dispatch_is_tpu_gated(rng, monkeypatch, capsys):
