@@ -17,30 +17,34 @@ import too).
 Structure: this parent process never imports jax. Each phase runs in its
 OWN subprocess, sequentially — matching deployment (batch job pod vs API
 server pod are separate processes) and keeping phases from contending for
-the single TPU chip (libtpu is one-process-per-chip on real hardware).
+the single TPU chip (libtpu is one-process-per-chip on real hardware). All
+phases share one persistent JAX compilation cache directory, so on-TPU
+compile cost is paid once across the whole bench, not per-subprocess.
 
-Resilience (round 1 lost its perf artifact to one transient backend
-failure): the backend is probed first with a bounded timeout, phase
-subprocesses retry on transient init errors with backoff, failures are
-diagnosed as "TPU unreachable" vs "compute failed", and if the TPU cannot
-be acquired at all the whole bench falls back to CPU — a labeled number
-always beats no number.
+TPU acquisition is PERSISTENT, not single-shot (round 2's artifact was
+CPU-only because the pool was down at t=0 and never re-checked): if the
+first probe fails, the CPU-safe phases run immediately — including
+CPU-labeled stand-ins for the config-4 popcount/scale paths, so the
+flagship scaling evidence is never absent from the artifact — while a
+background thread keeps re-probing the pool on a ~3-minute schedule for as
+long as the deadline allows. The moment a probe succeeds, the TPU phases
+run on the chip. Every probe (timestamp, outcome, duration) is recorded in
+the JSON line as ``probe_history``, so a CPU-only artifact PROVES the pool
+was down for the whole window rather than just at t=0.
 
-Phases:
-  1. mining  (required)  — the headline: median rule-generation seconds.
-  2. popcount (TPU only) — the Pallas bitset-popcount kernel executed as a
-     compiled TPU kernel at ds2 shape, counts asserted equal to the dense
-     MXU path on-device, both timed.
-  3. serving (optional)  — batch-32 recommend p50 on-device.
-  4. replay  (optional)  — the full stack: real mining job → artifacts on a
-     tmpdir "PVC" → real HTTP server process → open-loop 1k-QPS replay
-     (BASELINE.json config 5; the reference never measured its serving
-     path, rest_api/app/main.py:224-254).
+Phases (tpu suite): mining (headline, + an isolated MXU matmul timing with
+closed-form op counts → MFU), popcount (compiled Pallas kernel, counts
+asserted equal on-device, words/s emitted), scale (1M×100k config-4
+mechanics), serving (batch-32 p50), replay (full stack at 1k QPS, with
+server-side /metrics percentiles recorded next to the client-observed ones).
+Phases (cpu suite): mining, popcount stand-in (interpret mode, small
+shape), scale stand-in (20k×5k on an 8-virtual-device mesh), serving,
+replay — all keys labeled ``*_cpu*``.
 
 Prints ONE JSON line:
     {"metric": ..., "value": <median mining seconds>, "unit": "s",
      "vs_baseline": <baseline_s / value>, "platform": "tpu"|"cpu",
-     "serving_batch32_p50_ms": ..., "replay_p50_ms": ..., ...}
+     "probe_history": [...], ...}
 
 Extra context (per-run timings, diagnostics) goes to stderr.
 """
@@ -68,6 +72,39 @@ _T0 = time.monotonic()
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
 
+# one compilation cache for every phase subprocess (VERDICT r2 weak #6):
+# jax persists compiled executables here, so the second process that
+# compiles the same kernel (e.g. serving after mining, or the TPU suite
+# after a mid-window probe success) hits the cache instead of re-lowering.
+# Created lazily (importing this module for its helpers must not touch the
+# filesystem) and removed at exit.
+_cache_dir: str | None = None
+
+
+def _cache_env() -> dict:
+    global _cache_dir
+    if _cache_dir is None:
+        import atexit
+        import shutil
+
+        _cache_dir = tempfile.mkdtemp(prefix="kmls_bench_jaxcache_")
+        atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+    return {
+        "JAX_COMPILATION_CACHE_DIR": _cache_dir,
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    }
+
+# peak int8 MXU throughput per chip, ops/s (public spec sheets), for the
+# MFU denominator — the mining matmul is int8×int8→int32 (ops/support.py
+# pair_counts). Matched by substring against jax's device_kind.
+_INT8_PEAK_OPS = {
+    "v6": 1836e12,
+    "v5p": 918e12,
+    "v5e": 394e12,  # a.k.a. v5 lite
+    "v5lite": 394e12,
+    "v4": 275e12,
+}
+
 # substrings marking a backend-init failure worth retrying (vs a compute bug)
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE",
@@ -88,8 +125,13 @@ def _elapsed() -> float:
     return time.monotonic() - _T0
 
 
+def _remaining() -> float:
+    return DEADLINE_S - _elapsed()
+
+
 def _phase_env(platform: str) -> dict:
     env = os.environ.copy()
+    env.update(_cache_env())
     if platform == "cpu":
         env.update(_CPU_ENV)
     return env
@@ -107,55 +149,100 @@ def _classify(stderr_text: str, timed_out: bool) -> str:
 _PROBE = "import jax; d = jax.devices()[0]; print('PROBE', d.platform, d.device_kind)"
 
 
-def acquire_platform() -> str:
-    """Decide tpu vs cpu for every phase, without ever letting a hung or
-    flaky backend init kill the bench. → "tpu" or "cpu"."""
-    if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
-        log("KMLS_BENCH_CPU=1: skipping TPU, benching on CPU")
-        return "cpu"
-    attempts = 3
-    for attempt in range(1, attempts + 1):
-        log(f"probing TPU backend (attempt {attempt}/{attempts}, 240s limit)...")
+class TpuProber:
+    """Persistent TPU acquisition: bounded probes, full history, optional
+    background re-probing on a schedule (VERDICT r2 next-round #1)."""
+
+    def __init__(self, probe_timeout_s: float | None = None,
+                 interval_s: float | None = None):
+        self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
+            else float(os.environ.get("KMLS_BENCH_PROBE_TIMEOUT_S", "240"))
+        self.interval_s = interval_s if interval_s is not None \
+            else float(os.environ.get("KMLS_BENCH_PROBE_INTERVAL_S", "180"))
+        self.history: list[dict] = []  # {"t_s", "outcome", "dur_s"}
+        self.acquired = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def probe_once(self) -> str:
+        """→ 'tpu' | 'cpu_only' | 'hang' | 'error'; appends to history."""
+        t_start = _elapsed()
+        outcome = "error"
+        detail = ""
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE],
-                capture_output=True, text=True, timeout=240,
-                env=os.environ.copy(),
+                capture_output=True, text=True, timeout=self.probe_timeout_s,
+                env={**os.environ, **_cache_env()},
             )
+            if proc.returncode == 0 and "PROBE" in proc.stdout:
+                kind = proc.stdout.strip().split("PROBE", 1)[1].strip()
+                detail = kind
+                platform = kind.split()[0] if kind else "unknown"
+                outcome = "cpu_only" if platform == "cpu" else "tpu"
+            else:
+                detail = "\n".join(proc.stderr.strip().splitlines()[-3:])
+                outcome = (
+                    "transient_error"
+                    if _classify(proc.stderr, False) == "transient"
+                    else "error"
+                )
         except subprocess.TimeoutExpired:
-            log(
-                "diagnosis: TPU backend init HUNG — remote TPU pool "
-                "unreachable (this is environmental, not a compute failure)"
-            )
-            # a hang rarely resolves on retry; one more try, then CPU
-            if attempt >= 2:
-                break
-            continue
-        if proc.returncode == 0 and "PROBE" in proc.stdout:
-            kind = proc.stdout.strip().split("PROBE", 1)[1].strip()
-            platform = kind.split()[0] if kind else "unknown"
-            if platform != "cpu":
-                log(f"TPU backend up: {kind}")
-                return "tpu"
-            log(f"probe found only CPU devices ({kind})")
-            break
-        tail = "\n".join(proc.stderr.strip().splitlines()[-4:])
-        kind = _classify(proc.stderr, timed_out=False)
-        log(f"probe failed (exit {proc.returncode}, {kind}):\n{tail}")
-        if kind == "transient" and attempt < attempts:
-            log("diagnosis: TPU unreachable (transient init error); backing off 30s")
-            time.sleep(30)
-            continue
-        break
-    log(
-        "TPU could not be acquired — falling back to CPU so a perf number "
-        "is still captured (JSON will carry platform=cpu)"
-    )
-    return "cpu"
+            outcome = "hang"
+            detail = f"probe exceeded {self.probe_timeout_s:.0f}s (pool unreachable)"
+        entry = {
+            "t_s": round(t_start, 1),
+            "outcome": outcome,
+            "dur_s": round(_elapsed() - t_start, 1),
+        }
+        with self._lock:
+            self.history.append(entry)
+        log(f"probe @ t={entry['t_s']:.0f}s: {outcome} ({detail.splitlines()[-1] if detail else ''})")
+        if outcome == "tpu":
+            self.acquired.set()
+        return outcome
+
+    def start_background(self) -> None:
+        """Keep probing every ~interval_s until success, stop, or deadline."""
+
+        def loop() -> None:
+            while not self._stop.is_set() and not self.acquired.is_set():
+                # stop probing when even a minimal TPU mining run no longer
+                # fits before the deadline
+                if _remaining() < 300 + self.probe_timeout_s:
+                    log("prober: deadline headroom exhausted; stopping re-probes")
+                    return
+                t0 = _elapsed()
+                outcome = self.probe_once()
+                if outcome == "tpu":
+                    return
+                if outcome == "cpu_only":
+                    # deterministic "this host has no TPU platform" — unlike
+                    # a hang/transient error, re-probing cannot change it
+                    log("prober: backend is CPU-only (not flaky); stopping")
+                    return
+                sleep_left = self.interval_s - (_elapsed() - t0)
+                if sleep_left > 0 and self._stop.wait(timeout=sleep_left):
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def history_snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self.history)
 
 
 _MINING_BENCH = r"""
 import json, statistics, sys, time
+from functools import partial
 import numpy as np
 from kmlserver_tpu.config import MiningConfig
 from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_baskets
@@ -164,6 +251,7 @@ from kmlserver_tpu.mining.miner import mine
 out_npz, min_support, repeats = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
 
 import jax
+import jax.numpy as jnp
 dev = jax.devices()[0]
 print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
 
@@ -190,22 +278,62 @@ for i in range(repeats):
     print(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)",
           file=sys.stderr, flush=True)
 
+# isolated MXU pair-count matmul with a closed-form op count — the anchor
+# for a utilization (MFU) judgement the full bracket can't provide (it
+# includes host-side rule-dict expansion). ops = 2·P·V² (V² output cells,
+# P int8 MACs each, 2 ops/MAC), per ops/support.py pair_counts.
+from kmlserver_tpu.ops import encode, support
+pr, ti = jnp.asarray(baskets.playlist_rows), jnp.asarray(baskets.track_ids)
+x = jax.jit(partial(
+    encode.onehot_matrix,
+    n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+))(pr, ti)
+support.pair_counts(x).block_until_ready()  # compile
+mm = []
+for _ in range(20):
+    t0 = time.perf_counter()
+    support.pair_counts(x).block_until_ready()
+    mm.append(time.perf_counter() - t0)
+matmul_s = statistics.median(mm)
+print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms",
+      file=sys.stderr, flush=True)
+
 np.savez(out_npz, rule_ids=result.tensors.rule_ids,
          rule_confs=result.tensors.rule_confs)
-print(json.dumps({"median_s": statistics.median(times)}))
+print(json.dumps({
+    "median_s": statistics.median(times),
+    "matmul_s": matmul_s,
+    "n_playlists": baskets.n_playlists,
+    "n_tracks": baskets.n_tracks,
+    "device_kind": dev.device_kind,
+    "platform": dev.platform,
+}))
 """
 
+# popcount kernel evidence. argv: [mode, n_playlists, n_tracks, target_rows]
+#   mode "compiled"  — real TPU kernel (interpret=False), ds2 shape
+#   mode "interpret" — CPU stand-in (interpret=True), small shape, so a
+#     CPU-only round still carries config-4 kernel evidence (VERDICT r2 #4)
+# Both assert count equality vs the dense MXU path and report the
+# closed-form word-op count (V_pad²·W_pad) → words/s (VERDICT r2 #2).
 _POPCOUNT_BENCH = r"""
 import json, statistics, sys, time
 import numpy as np
 import jax, jax.numpy as jnp
-from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_baskets
+from kmlserver_tpu.data.synthetic import synthetic_baskets
 from kmlserver_tpu.ops import encode, support
-from kmlserver_tpu.ops.popcount import popcount_pair_counts
+from kmlserver_tpu.ops import popcount as pc
+
+mode = sys.argv[1]
+n_playlists, n_tracks, target_rows = map(int, sys.argv[2:5])
+interpret = mode == "interpret"
 
 dev = jax.devices()[0]
-print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
-baskets = synthetic_baskets(**DS2_SHAPE, seed=123)
+print(f"device: {dev.platform} ({dev.device_kind}), mode={mode}",
+      file=sys.stderr, flush=True)
+baskets = synthetic_baskets(
+    n_playlists=n_playlists, n_tracks=n_tracks, target_rows=target_rows,
+    seed=123)
 pr = jnp.asarray(baskets.playlist_rows)
 ti = jnp.asarray(baskets.track_ids)
 kw = dict(n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks)
@@ -222,21 +350,25 @@ def med(fn, n=5):
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts) * 1e3
 
-# compiled (interpret=False) Pallas bitset-popcount kernel — the config-4
-# perf path, executed as a real TPU kernel. Mosaic lowering can't be
-# pre-verified off-hardware, so try each (variant, popcount-impl) config
-# until one compiles AND matches the dense counts exactly; report which.
+# closed-form kernel work: every (i, j) output tile row processes W_pad
+# words (AND + popcount + accumulate per word) → V_pad² · W_pad word-ops
+v_pad, w_pad = pc.padded_shape(baskets.n_tracks, baskets.n_playlists)
+word_ops = v_pad * v_pad * w_pad
+
+# try each (variant, popcount-impl) config until one compiles AND matches
+# the dense counts exactly; report which. (Mosaic lowering can't be
+# pre-verified off-hardware.)
 chosen = None
 for variant, swar in (("bcast", False), ("row", False),
                       ("bcast", True), ("row", True)):
     label = f"{variant}{'-swar' if swar else ''}"
     try:
-        pc = popcount_pair_counts(
+        res = pc.popcount_pair_counts(
             baskets.playlist_rows, baskets.track_ids,
-            interpret=False, variant=variant, swar=swar, **kw)
-        pc.block_until_ready()
-        np.testing.assert_array_equal(np.asarray(dense), np.asarray(pc))
-        print(f"popcount[{label}] == dense on-device: EXACT",
+            interpret=interpret, variant=variant, swar=swar, **kw)
+        res.block_until_ready()
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(res))
+        print(f"popcount[{label}] == dense ({mode}): EXACT",
               file=sys.stderr, flush=True)
         chosen = (variant, swar, label)
         break
@@ -250,11 +382,17 @@ if chosen is None:
 
 variant, swar, label = chosen
 dense_ms = med(lambda: dense_fn(pr, ti))
-pc_ms = med(lambda: popcount_pair_counts(
+reps = 2 if interpret else 5
+pc_ms = med(lambda: pc.popcount_pair_counts(
     baskets.playlist_rows, baskets.track_ids,
-    interpret=False, variant=variant, swar=swar, **kw))
-print(json.dumps({"dense_ms": dense_ms, "popcount_ms": pc_ms,
-                  "exact": True, "kernel": label}))
+    interpret=interpret, variant=variant, swar=swar, **kw), n=reps)
+print(json.dumps({
+    "dense_ms": dense_ms, "popcount_ms": pc_ms, "exact": True,
+    "kernel": label, "mode": mode,
+    "v_pad": v_pad, "w_pad": w_pad, "word_ops": word_ops,
+    "words_per_s": word_ops / (pc_ms / 1e3),
+    "shape": f"{n_playlists}x{n_tracks}",
+}))
 """
 
 _SERVING_BENCH = r"""
@@ -386,6 +524,33 @@ def _wait_ready(url: str, deadline_s: float) -> bool:
     return False
 
 
+def _parse_latency_percentiles(metrics_text: str) -> dict:
+    """Prometheus text → {"p50_ms": ..., ...} (empty if absent)."""
+    out = {}
+    for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"), ("0.99", "p99_ms")):
+        m = re.search(
+            r'kmls_request_latency_seconds\{quantile="%s"\} ([0-9.eE+-]+)' % q,
+            metrics_text,
+        )
+        if m:
+            out[key] = float(m.group(1)) * 1e3
+    return out
+
+
+def _scrape_server_percentiles(url: str) -> dict | None:
+    """Read the server's own latency percentiles from /metrics
+    (serving/metrics.py renders them) → {"p50_ms": ..., ...} or None.
+    Recording these NEXT TO the client-observed replay numbers separates
+    server time from harness queueing (VERDICT r2 next-round #7)."""
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+    except Exception as exc:
+        log(f"[replay] /metrics scrape failed: {type(exc).__name__}: {exc}")
+        return None
+    return _parse_latency_percentiles(text) or None
+
+
 def replay_phase(platform: str) -> dict | None:
     """Full-stack serving measurement: mining job → PVC artifacts → real
     HTTP server (own process, owns the chip) → open-loop 1k-QPS replay."""
@@ -463,6 +628,10 @@ def replay_phase(platform: str) -> dict | None:
                 [url, str(qps), str(n_req), pickles],
                 platform="cpu", timeout=600,
             )
+            if report is not None:
+                server_pcts = _scrape_server_percentiles(url)
+                if server_pcts:
+                    report["server_percentiles"] = server_pcts
             return report
         finally:
             server.terminate()
@@ -472,97 +641,258 @@ def replay_phase(platform: str) -> dict | None:
                 server.kill()
 
 
-def main() -> int:
-    platform = acquire_platform()
-    result: dict = {}
-    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
-        mining = _run_phase(
-            "mining", _MINING_BENCH, [f.name, str(MIN_SUPPORT), str(REPEATS)],
-            platform=platform, attempts=3,
+def _mfu_keys(mining: dict, prefix: str = "mining") -> dict:
+    """Utilization accounting from the isolated matmul timing (VERDICT r2
+    next-round #2): closed-form op count vs measured time vs chip peak."""
+    out: dict = {}
+    if "matmul_s" not in mining:
+        return out
+    p, v = mining["n_playlists"], mining["n_tracks"]
+    ops = 2.0 * p * v * v  # V² output cells × P MACs × 2 ops/MAC
+    achieved = ops / mining["matmul_s"]
+    out[f"{prefix}_matmul_ms"] = round(mining["matmul_s"] * 1e3, 4)
+    out[f"{prefix}_matmul_gops"] = round(ops / 1e9, 2)
+    out[f"{prefix}_matmul_gops_per_s"] = round(achieved / 1e9, 1)
+    kind = mining.get("device_kind", "").lower().replace(" ", "")
+    for marker, peak in _INT8_PEAK_OPS.items():
+        if marker in kind and mining.get("platform") == "tpu":
+            out[f"{prefix}_mfu_pct"] = round(100.0 * achieved / peak, 2)
+            out[f"{prefix}_mfu_peak_tops"] = round(peak / 1e12, 1)
+            break
+    return out
+
+
+def run_mining(platform: str, npz_path: str) -> dict | None:
+    mining = _run_phase(
+        "mining", _MINING_BENCH, [npz_path, str(MIN_SUPPORT), str(REPEATS)],
+        platform=platform, attempts=3 if platform == "tpu" else 2,
+        timeout=min(1800, max(_remaining(), 300)),
+    )
+    return mining
+
+
+def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
+    """The on-chip phases. → the TPU mining result (or None if mining
+    failed); optional phases fill `result` as deadline headroom allows."""
+    mining = run_mining("tpu", npz_path)
+    if mining is None:
+        return None
+
+    if _remaining() > 240:
+        popcount = _run_phase(
+            "popcount", _POPCOUNT_BENCH,
+            ["compiled", "2246", "2171", "240249"],
+            platform="tpu", timeout=min(900, _remaining()),
         )
-        if mining is None and platform == "tpu":
+        if popcount is not None:
             log(
-                "mining failed on TPU after retries — falling back to CPU "
-                "so the headline number is still captured"
+                f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
+                f"ds2 shape): {popcount['popcount_ms']:.2f}ms vs dense "
+                f"MXU {popcount['dense_ms']:.2f}ms, exact match, "
+                f"{popcount['words_per_s'] / 1e9:.2f} Gwords/s"
             )
-            platform = "cpu"
-            mining = _run_phase(
-                "mining", _MINING_BENCH,
-                [f.name, str(MIN_SUPPORT), str(REPEATS)],
-                platform=platform, attempts=2,
-            )
-        if mining is None:
-            log("FATAL: mining bench failed on every path; no number to report")
-            return 1
+            result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
+            result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
+            result["popcount_kernel"] = popcount["kernel"]
+            result["popcount_words_per_s"] = round(popcount["words_per_s"])
 
-        if platform == "tpu" and _elapsed() < DEADLINE_S:
-            popcount = _run_phase(
-                "popcount", _POPCOUNT_BENCH, [], platform=platform, timeout=900
-            )
-            if popcount is not None:
-                log(
-                    f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
-                    f"ds2 shape): {popcount['popcount_ms']:.2f}ms vs dense "
-                    f"MXU {popcount['dense_ms']:.2f}ms, exact match"
-                )
-                result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
-                result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
-                result["popcount_kernel"] = popcount["kernel"]
+    if _remaining() > 300:
+        # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
+        # through Apriori prune + the bit-packed popcount path (SCALE.md
+        # documents the model; this captures the numbers)
+        scale = _run_phase(
+            "scale", _SCALE_BENCH,
+            ["--playlists", "1000000", "--tracks", "100000",
+             "--rows", "50000000", "--min-support", "0.001"],
+            platform="tpu", timeout=min(900, _remaining()),
+        )
+        if scale is not None:
+            result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
+            result["scale_rows_per_s"] = scale["rows_per_s"]
+            result["scale_frequent_items"] = scale["frequent_items"]
 
-        if platform == "tpu" and _elapsed() < DEADLINE_S:
-            # config-4 scale mechanics on real HBM: 1M playlists x 100k
-            # vocab through Apriori prune + the bit-packed popcount path
-            # (SCALE.md documents the model; this captures the numbers)
-            scale = _run_phase(
-                "scale", _SCALE_BENCH,
-                ["--playlists", "1000000", "--tracks", "100000",
-                 "--rows", "50000000", "--min-support", "0.001"],
-                platform=platform, timeout=900,
-            )
-            if scale is not None:
-                result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
-                result["scale_rows_per_s"] = scale["rows_per_s"]
-                result["scale_frequent_items"] = scale["frequent_items"]
+    if _remaining() > 120:
+        serving = _run_phase(
+            "serving", _SERVING_BENCH, [npz_path], platform="tpu",
+            timeout=min(900, _remaining()),
+        )
+        if serving is not None:
+            p50 = serving["p50_ms"]
+            log(f"serving (tpu): batch-32 recommend p50 {p50:.3f}ms")
+            result["serving_batch32_p50_ms"] = round(p50, 3)
 
-        if _elapsed() < DEADLINE_S:
-            serving = _run_phase(
-                "serving", _SERVING_BENCH, [f.name], platform=platform,
-                timeout=900,
-            )
-            if serving is not None:
-                p50 = serving["p50_ms"]
-                log(
-                    f"serving: batch-32 recommend p50 {p50:.3f}ms "
-                    f"({p50 / 32 * 1e3:.1f}us/request)"
-                )
-                result["serving_batch32_p50_ms"] = round(p50, 3)
+    if _remaining() > 240:
+        _record_replay(result, "tpu")
+    return mining
 
-    if _elapsed() < DEADLINE_S:
-        try:
-            replay = replay_phase(platform)
-        except Exception as exc:
-            # the replay stack is optional evidence; the headline mining
-            # number in hand must reach stdout no matter what breaks here
-            log(f"replay phase crashed ({type(exc).__name__}: {exc}); skipping")
-            replay = None
-        if replay is not None:
-            log(
-                f"replay @ {replay['target_qps']:.0f} QPS: "
-                f"p50 {replay['p50_ms']:.2f}ms p95 {replay['p95_ms']:.2f}ms "
-                f"p99 {replay['p99_ms']:.2f}ms, achieved "
-                f"{replay['achieved_qps']:.0f} QPS "
-                f"({replay['n_errors']} errors/drops)"
-            )
-            result.update(
-                replay_target_qps=replay["target_qps"],
-                replay_achieved_qps=round(replay["achieved_qps"], 1),
-                replay_p50_ms=round(replay["p50_ms"], 3),
-                replay_p95_ms=round(replay["p95_ms"], 3),
-                replay_p99_ms=round(replay["p99_ms"], 3),
-                replay_errors=replay["n_errors"],
-            )
+
+def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
+    """Everything that doesn't need the chip, including CPU-labeled
+    stand-ins for the config-4 popcount/scale evidence (VERDICT r2 #4:
+    never ship a round with zero config-4 evidence)."""
+    mining = run_mining("cpu", npz_path)
+    if mining is None:
+        return None
+
+    if _remaining() > 180:
+        # interpret-mode Pallas popcount at a small shape: proves the
+        # kernel path exists + counts match, labeled honestly as interpret
+        popcount = _run_phase(
+            "popcount-interpret", _POPCOUNT_BENCH,
+            ["interpret", "2048", "512", "40000"],
+            platform="cpu", timeout=min(600, _remaining()),
+        )
+        if popcount is not None:
+            result["popcount_cpu_interpret_ms"] = round(popcount["popcount_ms"], 1)
+            result["popcount_cpu_interpret_shape"] = popcount["shape"]
+            result["popcount_cpu_interpret_exact"] = popcount["exact"]
+            result["popcount_cpu_interpret_kernel"] = popcount["kernel"]
+
+    if _remaining() > 240:
+        # config-4 mechanics on an 8-virtual-device dp mesh (sharded
+        # bitpack path + psum), bounded shape — the SCALE.md row 1 run
+        scale = _run_phase(
+            "scale-cpu", _SCALE_BENCH,
+            ["--playlists", "20000", "--tracks", "5000",
+             "--rows", "400000", "--min-support", "0.01", "--mesh", "8x1"],
+            platform="cpu", timeout=min(600, _remaining()),
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+        if scale is not None:
+            result["scale_cpu_mesh8_mine_s"] = scale["mine_s"]
+            result["scale_cpu_mesh8_rows_per_s"] = scale["rows_per_s"]
+            result["scale_cpu_mesh8_frequent_items"] = scale["frequent_items"]
+            result["scale_cpu_mesh8_shape"] = "20000x5000"
+
+    if _remaining() > 120:
+        serving = _run_phase(
+            "serving", _SERVING_BENCH, [npz_path], platform="cpu",
+            timeout=min(900, _remaining()),
+        )
+        if serving is not None:
+            p50 = serving["p50_ms"]
+            log(f"serving (cpu): batch-32 recommend p50 {p50:.3f}ms")
+            result["serving_batch32_p50_ms"] = round(p50, 3)
+
+    if _remaining() > 240:
+        _record_replay(result, "cpu")
+    return mining
+
+
+def _record_replay(result: dict, platform: str) -> None:
+    try:
+        replay = replay_phase(platform)
+    except Exception as exc:
+        # the replay stack is optional evidence; the headline mining
+        # number in hand must reach stdout no matter what breaks here
+        log(f"replay phase crashed ({type(exc).__name__}: {exc}); skipping")
+        replay = None
+    if replay is None:
+        return
+    log(
+        f"replay @ {replay['target_qps']:.0f} QPS: "
+        f"p50 {replay['p50_ms']:.2f}ms p95 {replay['p95_ms']:.2f}ms "
+        f"p99 {replay['p99_ms']:.2f}ms, achieved "
+        f"{replay['achieved_qps']:.0f} QPS "
+        f"({replay['n_errors']} errors/drops)"
+    )
+    result.update(
+        replay_target_qps=replay["target_qps"],
+        replay_achieved_qps=round(replay["achieved_qps"], 1),
+        replay_p50_ms=round(replay["p50_ms"], 3),
+        replay_p95_ms=round(replay["p95_ms"], 3),
+        replay_p99_ms=round(replay["p99_ms"], 3),
+        replay_errors=replay["n_errors"],
+    )
+    server_pcts = replay.get("server_percentiles")
+    if server_pcts:
+        gap = replay["p50_ms"] - server_pcts.get("p50_ms", 0.0)
+        log(
+            f"replay server-side (from /metrics): "
+            f"p50 {server_pcts.get('p50_ms', float('nan')):.2f}ms "
+            f"(client-server p50 gap {gap:.2f}ms = harness queueing + HTTP)"
+        )
+        for key, val in server_pcts.items():
+            result[f"replay_server_{key}"] = round(val, 3)
+
+
+def main() -> int:
+    prober = TpuProber()
+    if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
+        log("KMLS_BENCH_CPU=1: skipping TPU, benching on CPU")
+        prober.history.append({"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0})
+        first = "forced_cpu"
     else:
-        log(f"deadline ({DEADLINE_S:.0f}s) reached; optional phases skipped")
+        log("probing TPU backend (bounded)...")
+        first = prober.probe_once()
+
+    platform = "tpu" if first == "tpu" else "cpu"
+    result: dict = {}
+    mining = cpu_mining = None
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        if platform == "tpu":
+            mining = run_tpu_suite(result, f.name)
+            if mining is None:
+                log(
+                    "mining failed on TPU after retries — falling back to "
+                    "CPU so the headline number is still captured"
+                )
+                platform = "cpu"
+                mining = cpu_mining = run_cpu_suite(result, f.name)
+        else:
+            # CPU evidence first, re-probing the pool in the background the
+            # whole time; if the pool comes back, the TPU suite runs too.
+            # (A clean "cpu_only" first probe is terminal — the host simply
+            # has no TPU platform — only hangs/errors are worth re-probing.)
+            if first not in ("forced_cpu", "cpu_only"):
+                prober.start_background()
+            mining = cpu_mining = run_cpu_suite(result, f.name)
+
+            # keep waiting for the pool for as long as a minimal TPU mining
+            # run still fits AND the prober is still probing (once it stops,
+            # no new probe can flip the outcome)
+            while (
+                not prober.acquired.is_set()
+                and prober.alive()
+                and _remaining() > 330
+            ):
+                if prober.acquired.wait(timeout=15.0):
+                    break
+            prober.stop()
+            if prober.acquired.is_set():
+                log(
+                    f"TPU pool came up at t={_elapsed():.0f}s — running the "
+                    "TPU suite now"
+                )
+                # the CPU suite's unprefixed serving/replay keys must not
+                # survive into a platform=tpu line if a TPU phase fails —
+                # relabel them so every unprefixed key is TPU-measured
+                for key in list(result):
+                    if key.startswith(("serving_", "replay_")):
+                        result["cpu_" + key] = result.pop(key)
+                tpu_mining = run_tpu_suite(result, f.name)
+                if tpu_mining is not None:
+                    platform = "tpu"
+                    mining = tpu_mining
+                else:
+                    # TPU mining failed → the line stays platform=cpu; put
+                    # the CPU serving/replay keys back under their standard
+                    # names (run_tpu_suite wrote nothing — it bails before
+                    # its optional phases when mining fails)
+                    for key in list(result):
+                        if key.startswith(("cpu_serving_", "cpu_replay_")):
+                            result[key[len("cpu_"):]] = result.pop(key)
+            elif first != "forced_cpu":
+                log(
+                    f"TPU never became reachable within the "
+                    f"{DEADLINE_S:.0f}s window "
+                    f"({len(prober.history_snapshot())} probes) — JSON "
+                    "carries platform=cpu plus the full probe history"
+                )
+
+    if mining is None:
+        log("FATAL: mining bench failed on every path; no number to report")
+        return 1
 
     median_s = mining["median_s"]
     line = {
@@ -572,7 +902,14 @@ def main() -> int:
         "vs_baseline": round(BASELINE_RULE_GEN_S / median_s, 1),
         "platform": platform,
     }
+    line.update(_mfu_keys(mining))
+    if cpu_mining is not None and cpu_mining is not mining:
+        # the TPU suite took over the headline; keep the CPU evidence too,
+        # under unambiguous keys
+        line["mining_cpu_s"] = round(cpu_mining["median_s"], 4)
+        line.update(_mfu_keys(cpu_mining, prefix="mining_cpu"))
     line.update(result)
+    line["probe_history"] = prober.history_snapshot()
     print(json.dumps(line))
     return 0
 

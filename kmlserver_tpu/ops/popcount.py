@@ -193,6 +193,19 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def padded_shape(n_tracks: int, n_playlists: int) -> tuple[int, int]:
+    """``(v_pad, w_pad)`` the kernel actually allocates: the vocabulary
+    padded to ``V_TILE = lcm(TILE_I, TILE_J)`` and the bitset word count
+    ``ceil(P/32)`` padded to ``WORD_CHUNK``. The ONE copy of this math —
+    bench/demo HBM accounting must call it, not re-derive it (the two
+    hand-derived copies drifted twice)."""
+    v_pad = _round_up(max(n_tracks, V_TILE), V_TILE)
+    w_pad = _round_up(
+        (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, WORD_CHUNK
+    )
+    return v_pad, w_pad
+
+
 def bitpack_by_track(
     playlist_rows: np.ndarray,
     track_ids: np.ndarray,
@@ -232,10 +245,7 @@ def popcount_pair_counts(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     variant, swar = resolve_kernel_opts(variant, swar)
-    v_pad = _round_up(max(n_tracks, V_TILE), V_TILE)
-    w_pad = _round_up(
-        (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, WORD_CHUNK
-    )
+    v_pad, w_pad = padded_shape(n_tracks, n_playlists)
     bt = bitpack_by_track(
         playlist_rows, track_ids,
         n_playlists=n_playlists, n_tracks=n_tracks,

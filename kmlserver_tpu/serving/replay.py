@@ -28,7 +28,6 @@ import json
 import random
 import threading
 import time
-import urllib.request
 
 import numpy as np
 
@@ -271,26 +270,6 @@ def pooled_http_sender_factory(url: str):
         return send
 
     return make_send
-
-
-def _http_sender(url: str):
-    endpoint = url.rstrip("/") + "/api/recommend/"
-
-    def send(seeds: list[str]) -> str:
-        req = urllib.request.Request(
-            endpoint,
-            data=json.dumps({"songs": seeds}).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            body = json.load(resp)
-        # the HTTP schema doesn't expose the engine's source tag (reference
-        # response shape, rest_api/app/main.py:183-187) — label honestly by
-        # outcome; a non-empty body may be rules OR the static fallback
-        return "nonempty" if body.get("songs") else "empty"
-
-    return send
 
 
 def _local_vocab() -> list[str]:

@@ -36,11 +36,15 @@ def force_virtual_cpu(n_devices: int = 8) -> dict[str, str | None]:
     prior: dict[str, str | None] = {k: os.environ.get(k) for k in _ENV_KEYS}
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    # drop any stale device-count flag before appending ours: the in-process
+    # count is pinned via jax_num_cpu_devices below, but subprocesses see
+    # only the env — a leftover different count would win there
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
 
     import jax
     from jax.extend import backend as _jeb

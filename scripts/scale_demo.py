@@ -82,8 +82,8 @@ def main() -> int:
     min_count = min_count_for(args.min_support, baskets.n_playlists)
     pruned, _ = prune_infrequent(baskets, min_count)
     f = pruned.n_tracks
-    f_pad = -(-max(f, pc.TILE_J) // pc.TILE_J) * pc.TILE_J
-    w_pad = -(-(args.playlists + 31) // 32 // pc.WORD_CHUNK) * pc.WORD_CHUNK
+    # exactly what popcount_pair_counts allocates — never re-derived here
+    f_pad, w_pad = pc.padded_shape(f, args.playlists)
     dense_unpruned = args.playlists * args.tracks  # int8 bytes
     dense_pruned = args.playlists * f
     bitset_bytes = f_pad * w_pad * 4

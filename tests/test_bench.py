@@ -1,0 +1,94 @@
+"""Unit tests for bench.py's pure helpers — the artifact-assembly logic
+whose bugs would silently corrupt the judged JSON line (the bench itself is
+exercised end to end by the driver; these pin the derivations)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "kmls_bench", Path(__file__).resolve().parent.parent / "bench.py"
+)
+bench = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("kmls_bench", bench)
+_spec.loader.exec_module(bench)
+
+
+class TestMfuKeys:
+    MINING_TPU = {
+        "median_s": 0.1,
+        "matmul_s": 0.001,
+        "n_playlists": 2246,
+        "n_tracks": 2171,
+        "device_kind": "TPU v5e",
+        "platform": "tpu",
+    }
+
+    def test_closed_form_op_count(self):
+        out = bench._mfu_keys(self.MINING_TPU)
+        # 2·P·V² ops: V² output cells, P MACs each, 2 ops/MAC
+        expected_gops = 2 * 2246 * 2171 * 2171 / 1e9
+        assert out["mining_matmul_gops"] == round(expected_gops, 2)
+        assert out["mining_matmul_ms"] == 1.0
+        assert out["mining_matmul_gops_per_s"] == round(expected_gops / 0.001, 1)
+
+    def test_mfu_pct_only_on_tpu_with_known_peak(self):
+        out = bench._mfu_keys(self.MINING_TPU)
+        # v5e int8 peak 394 TOPS; achieved = 2.117e13 ops/s → ~5.4%
+        assert out["mining_mfu_peak_tops"] == 394.0
+        achieved = 2 * 2246 * 2171 * 2171 / 0.001
+        assert out["mining_mfu_pct"] == round(100 * achieved / 394e12, 2)
+
+    def test_no_mfu_pct_on_cpu(self):
+        cpu = dict(self.MINING_TPU, platform="cpu", device_kind="cpu")
+        out = bench._mfu_keys(cpu)
+        assert "mining_mfu_pct" not in out
+        assert "mining_matmul_gops_per_s" in out  # achieved still labeled
+
+    def test_prefix_separates_cpu_and_tpu_evidence(self):
+        out = bench._mfu_keys(self.MINING_TPU, prefix="mining_cpu")
+        assert set(out) >= {"mining_cpu_matmul_ms", "mining_cpu_matmul_gops"}
+        assert "mining_matmul_ms" not in out
+
+    def test_missing_matmul_is_empty(self):
+        assert bench._mfu_keys({"median_s": 1.0}) == {}
+
+
+class TestParseLatencyPercentiles:
+    def test_parses_rendered_metrics(self):
+        # exactly what serving/metrics.py renders
+        from kmlserver_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.record("rules", 0.004)
+        m.record("fallback", 0.008)
+        text = m.render(reload_counter=1, finished_loading=True)
+        out = bench._parse_latency_percentiles(text)
+        assert set(out) == {"p50_ms", "p95_ms", "p99_ms"}
+        assert out["p50_ms"] in (4.0, 8.0)
+        assert out["p99_ms"] == 8.0
+
+    def test_empty_on_unrelated_text(self):
+        assert bench._parse_latency_percentiles("nope 1\n") == {}
+
+
+class TestClassify:
+    def test_hang_wins(self):
+        assert bench._classify("whatever", timed_out=True) == "hang"
+
+    def test_transient_markers(self):
+        assert bench._classify("... UNAVAILABLE: pool down", False) == "transient"
+        assert bench._classify("Unable to initialize backend", False) == "transient"
+
+    def test_hard_default(self):
+        assert bench._classify("TypeError: boom", False) == "hard"
+
+
+class TestProbeHistory:
+    def test_forced_cpu_history_shape(self):
+        prober = bench.TpuProber(probe_timeout_s=1.0, interval_s=1.0)
+        prober.history.append({"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0})
+        snap = prober.history_snapshot()
+        assert snap == [{"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0}]
+        snap.append("mutation")  # snapshot is a copy
+        assert len(prober.history_snapshot()) == 1
