@@ -277,6 +277,14 @@ for i in range(repeats):
     times.append(time.perf_counter() - t0)
     print(f"run {i}: {times[-1]:.3f}s ({len(rules_dict)} rule keys)",
           file=sys.stderr, flush=True)
+print(
+    "phase timings (last run): "
+    + ", ".join(
+        f"{k} {v * 1e3:.0f}ms"
+        for k, v in (result.phase_timings or {}).items()
+    ),
+    file=sys.stderr, flush=True,
+)
 
 # isolated MXU pair-count matmul with a closed-form op count — the anchor
 # for a utilization (MFU) judgement the full bracket can't provide (it
@@ -295,7 +303,17 @@ for _ in range(20):
     support.pair_counts(x).block_until_ready()
     mm.append(time.perf_counter() - t0)
 matmul_s = statistics.median(mm)
-print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms",
+# amortized: dispatch a pipeline of async calls, block once at the end.
+# Per-blocked-call timing is floored by the host<->device round trip
+# (~65ms through this environment's remote-TPU tunnel) — the pipelined
+# rate is the device's actual throughput, and the honest MFU numerator.
+N_AMORT = 50 if dev.platform == "tpu" else 10  # CPU: ~1s/call, cap the cost
+t0 = time.perf_counter()
+rs = [support.pair_counts(x) for _ in range(N_AMORT)]
+jax.block_until_ready(rs)
+matmul_amortized_s = (time.perf_counter() - t0) / N_AMORT
+print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call blocked, "
+      f"{matmul_amortized_s * 1e3:.3f}ms amortized over {N_AMORT}",
       file=sys.stderr, flush=True)
 
 np.savez(out_npz, rule_ids=result.tensors.rule_ids,
@@ -303,6 +321,7 @@ np.savez(out_npz, rule_ids=result.tensors.rule_ids,
 print(json.dumps({
     "median_s": statistics.median(times),
     "matmul_s": matmul_s,
+    "matmul_amortized_s": matmul_amortized_s,
     "n_playlists": baskets.n_playlists,
     "n_tracks": baskets.n_tracks,
     "device_kind": dev.device_kind,
@@ -350,6 +369,14 @@ def med(fn, n=5):
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts) * 1e3
 
+def amortized(fn, n=20):
+    # pipeline n async dispatches, block once: device throughput without
+    # the per-call host<->device round trip (~65ms over the remote tunnel)
+    fn().block_until_ready()
+    t0 = time.perf_counter()
+    jax.block_until_ready([fn() for _ in range(n)])
+    return (time.perf_counter() - t0) / n * 1e3
+
 # closed-form kernel work: every (i, j) output tile row processes W_pad
 # words (AND + popcount + accumulate per word) → V_pad² · W_pad word-ops
 v_pad, w_pad = pc.padded_shape(baskets.n_tracks, baskets.n_playlists)
@@ -383,16 +410,28 @@ if chosen is None:
 variant, swar, label = chosen
 dense_ms = med(lambda: dense_fn(pr, ti))
 reps = 2 if interpret else 5
-pc_ms = med(lambda: pc.popcount_pair_counts(
+pc_fn = lambda: pc.popcount_pair_counts(
     baskets.playlist_rows, baskets.track_ids,
-    interpret=interpret, variant=variant, swar=swar, **kw), n=reps)
-print(json.dumps({
+    interpret=interpret, variant=variant, swar=swar, **kw)
+pc_ms = med(pc_fn, n=reps)
+out = {
     "dense_ms": dense_ms, "popcount_ms": pc_ms, "exact": True,
     "kernel": label, "mode": mode,
     "v_pad": v_pad, "w_pad": w_pad, "word_ops": word_ops,
     "words_per_s": word_ops / (pc_ms / 1e3),
     "shape": f"{n_playlists}x{n_tracks}",
-}))
+}
+if not interpret:
+    # the kernel's true device rate (interpret mode is host-python slow,
+    # amortizing it tells nothing) — this is the number that anchors
+    # SCALE.md's VPU-rate extrapolation constant
+    pc_amort_ms = amortized(pc_fn)
+    dense_amort_ms = amortized(lambda: dense_fn(pr, ti))
+    out["popcount_amortized_ms"] = pc_amort_ms
+    out["dense_amortized_ms"] = dense_amort_ms
+    out["words_per_s"] = word_ops / (pc_amort_ms / 1e3)
+    out["words_per_s_blocked"] = word_ops / (pc_ms / 1e3)
+print(json.dumps(out))
 """
 
 _SERVING_BENCH = r"""
@@ -401,6 +440,8 @@ import numpy as np
 import jax, jax.numpy as jnp
 from kmlserver_tpu.ops.serve import recommend_batch
 
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
 with np.load(sys.argv[1]) as z:
     rule_ids = jax.device_put(jnp.asarray(z["rule_ids"]))
     rule_confs = jax.device_put(jnp.asarray(z["rule_confs"]))
@@ -414,7 +455,16 @@ for _ in range(50):
     recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0].block_until_ready()
     lat.append(time.perf_counter() - t0)
 lat.sort()
-print(json.dumps({"p50_ms": lat[len(lat) // 2] * 1e3}))
+# pipelined rate: batches/s the device could sustain if requests kept the
+# queue full (per-call p50 includes one full host<->device round trip)
+t0 = time.perf_counter()
+jax.block_until_ready([
+    recommend_batch(rule_ids, rule_confs, seeds, k_best=10)[0]
+    for _ in range(50)
+])
+amortized_ms = (time.perf_counter() - t0) / 50 * 1e3
+print(json.dumps({"p50_ms": lat[len(lat) // 2] * 1e3,
+                  "amortized_ms": amortized_ms}))
 """
 
 # run scripts/scale_demo.py under _run_phase's retry/diagnosis machinery
@@ -451,6 +501,15 @@ print(report.to_json())
 """
 
 
+# every phase script prints "device: ..." to stderr right after backend
+# init; on TPU, not seeing it within this grace period means the backend
+# init hung (the flaky-pool failure mode) — kill early instead of burning
+# the phase's full timeout on a process that will never start computing.
+# Default matches the prober's timeout: a pool the prober certifies
+# healthy must not have phases killed under a shorter fuse.
+STARTUP_GRACE_S = float(os.environ.get("KMLS_BENCH_STARTUP_GRACE_S", "240"))
+
+
 def _run_phase(
     name: str,
     code: str,
@@ -462,36 +521,89 @@ def _run_phase(
     extra_env: dict | None = None,
 ) -> dict | None:
     """Run one bench phase in its own process with transient-failure
-    retries; → parsed result JSON (last stdout line) or None (logged)."""
+    retries and (on TPU) a backend-init watchdog; → parsed result JSON
+    (last stdout line) or None (logged)."""
     env = _phase_env(platform)
     if extra_env:
         env.update(extra_env)
     for attempt in range(1, attempts + 1):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code, *argv],
-                capture_output=True, text=True, timeout=timeout,
-                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired as exc:
-            # CPython leaves TimeoutExpired.stderr as bytes even under
-            # text=True — decode or the hang diagnostics print as b'...'
-            tail = exc.stderr or b""
-            if isinstance(tail, bytes):
-                tail = tail.decode(errors="replace")
-            for line in tail.splitlines()[-10:]:
-                log(f"[{name}] {line}")
-            log(f"{name} phase timed out after {timeout:.0f}s (backend hang?)")
-            return None  # a hang already burned the budget once; don't repeat
-        for line in proc.stderr.splitlines():
-            log(f"[{name}] {line}")
-        if proc.returncode == 0:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        stderr_lines: list[str] = []
+        stdout_parts: list[str] = []
+        started = threading.Event()
+
+        def _drain_err() -> None:
+            for line in proc.stderr:  # type: ignore[union-attr]
+                stderr_lines.append(line.rstrip())
+                log(f"[{name}] {line.rstrip()}")
+                if "device:" in line:
+                    started.set()
+
+        def _drain_out() -> None:
+            stdout_parts.append(proc.stdout.read())  # type: ignore[union-attr]
+
+        t_err = threading.Thread(target=_drain_err, daemon=True)
+        t_out = threading.Thread(target=_drain_out, daemon=True)
+        t_err.start()
+        t_out.start()
+
+        timed_out = False
+        if platform == "tpu":
+            t_end = time.monotonic() + STARTUP_GRACE_S
+            # poll alongside the wait: a phase that crashes at import never
+            # prints a device line and must not idle out the full grace
+            while (
+                not started.is_set()
+                and proc.poll() is None
+                and time.monotonic() < t_end
+            ):
+                started.wait(timeout=2.0)
+            if not started.is_set() and proc.poll() is None:
+                log(
+                    f"{name} phase: no device line within "
+                    f"{STARTUP_GRACE_S:.0f}s — backend init hang; killing "
+                    "early instead of burning the phase timeout"
+                )
+                proc.kill()
+                proc.wait()
+                t_err.join(timeout=10)
+                t_out.join(timeout=10)
+                # unlike a full-timeout hang (which already burned the whole
+                # phase budget), the early kill only cost the grace period —
+                # the flaky pool often recovers, so this IS worth a retry
+                if attempt < attempts:
+                    log(
+                        f"{name} phase init hang (attempt {attempt}/"
+                        f"{attempts}); retrying in 30s"
+                    )
+                    time.sleep(30)
+                    continue
+                return None
+        if not timed_out:
             try:
-                return json.loads(proc.stdout.strip().splitlines()[-1])
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                timed_out = True
+                log(f"{name} phase timed out after {timeout:.0f}s (backend hang?)")
+        proc.wait()
+        t_err.join(timeout=10)
+        t_out.join(timeout=10)
+        stderr_text = "\n".join(stderr_lines)
+        if timed_out:
+            return None  # a hang already burned budget once; don't repeat
+        if proc.returncode == 0:
+            stdout = "".join(stdout_parts)
+            try:
+                return json.loads(stdout.strip().splitlines()[-1])
             except (IndexError, ValueError) as exc:
                 log(f"{name} phase produced unparseable output: {exc}")
                 return None
-        kind = _classify(proc.stderr, timed_out=False)
+        kind = _classify(stderr_text, timed_out=False)
         if kind == "transient" and attempt < attempts:
             log(
                 f"{name} phase hit a transient backend error "
@@ -643,14 +755,23 @@ def replay_phase(platform: str) -> dict | None:
 
 def _mfu_keys(mining: dict, prefix: str = "mining") -> dict:
     """Utilization accounting from the isolated matmul timing (VERDICT r2
-    next-round #2): closed-form op count vs measured time vs chip peak."""
+    next-round #2): closed-form op count vs measured time vs chip peak.
+    MFU uses the amortized (pipelined) time when available — per-blocked-call
+    time is floored by the host<->device round trip (~65ms over this
+    environment's remote-TPU tunnel), which measures the tunnel, not the
+    chip."""
     out: dict = {}
     if "matmul_s" not in mining:
         return out
     p, v = mining["n_playlists"], mining["n_tracks"]
     ops = 2.0 * p * v * v  # V² output cells × P MACs × 2 ops/MAC
-    achieved = ops / mining["matmul_s"]
+    mfu_time = mining.get("matmul_amortized_s", mining["matmul_s"])
+    achieved = ops / mfu_time
     out[f"{prefix}_matmul_ms"] = round(mining["matmul_s"] * 1e3, 4)
+    if "matmul_amortized_s" in mining:
+        out[f"{prefix}_matmul_amortized_ms"] = round(
+            mining["matmul_amortized_s"] * 1e3, 4
+        )
     out[f"{prefix}_matmul_gops"] = round(ops / 1e9, 2)
     out[f"{prefix}_matmul_gops_per_s"] = round(achieved / 1e9, 1)
     kind = mining.get("device_kind", "").lower().replace(" ", "")
@@ -687,14 +808,19 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
         if popcount is not None:
             log(
                 f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
-                f"ds2 shape): {popcount['popcount_ms']:.2f}ms vs dense "
+                f"ds2 shape): {popcount['popcount_ms']:.2f}ms/call vs dense "
                 f"MXU {popcount['dense_ms']:.2f}ms, exact match, "
-                f"{popcount['words_per_s'] / 1e9:.2f} Gwords/s"
+                f"{popcount['words_per_s'] / 1e9:.2f} Gwords/s amortized"
             )
             result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
             result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
             result["popcount_kernel"] = popcount["kernel"]
             result["popcount_words_per_s"] = round(popcount["words_per_s"])
+            for key in ("popcount_amortized_ms", "dense_amortized_ms"):
+                if key in popcount:
+                    result[key.replace("_ms", "_ds2_ms")] = round(
+                        popcount[key], 3
+                    )
 
     if _remaining() > 300:
         # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
@@ -718,8 +844,14 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
         )
         if serving is not None:
             p50 = serving["p50_ms"]
-            log(f"serving (tpu): batch-32 recommend p50 {p50:.3f}ms")
+            log(
+                f"serving (tpu): batch-32 recommend p50 {p50:.3f}ms/call, "
+                f"{serving['amortized_ms']:.3f}ms amortized"
+            )
             result["serving_batch32_p50_ms"] = round(p50, 3)
+            result["serving_batch32_amortized_ms"] = round(
+                serving["amortized_ms"], 3
+            )
 
     if _remaining() > 240:
         _record_replay(result, "tpu")
@@ -773,6 +905,9 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             p50 = serving["p50_ms"]
             log(f"serving (cpu): batch-32 recommend p50 {p50:.3f}ms")
             result["serving_batch32_p50_ms"] = round(p50, 3)
+            result["serving_batch32_amortized_ms"] = round(
+                serving["amortized_ms"], 3
+            )
 
     if _remaining() > 240:
         _record_replay(result, "cpu")

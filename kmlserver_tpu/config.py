@@ -161,6 +161,10 @@ class ServingConfig:
     # device call (milliseconds); 0 disables batching.
     batch_window_ms: float = 2.0
     batch_max_size: int = 32
+    # Device-call pipeline depth: batches dispatched but not yet completed.
+    # >1 overlaps the next batch's dispatch with the previous transfer —
+    # essential when the host<->device link is high-latency (remote tunnel).
+    batch_max_inflight: int = 4
     # Prefer the tensor-native npz artifact over the pickle when present.
     prefer_tensor_artifact: bool = True
 
@@ -187,5 +191,6 @@ class ServingConfig:
             max_seed_tracks=_getenv_int("KMLS_MAX_SEED_TRACKS", 128),
             batch_window_ms=_getenv_float("KMLS_BATCH_WINDOW_MS", 2.0),
             batch_max_size=_getenv_int("KMLS_BATCH_MAX_SIZE", 32),
+            batch_max_inflight=_getenv_int("KMLS_BATCH_MAX_INFLIGHT", 4),
             prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),
         )

@@ -130,15 +130,16 @@ def _kernel_bcast(a_ref, b_ref, out_ref, *, swar: bool):
     tj = b.shape[0]
     sub = min(_SUB, wk)
 
-    def chunk(c, acc):
-        a_c = jax.lax.dynamic_slice_in_dim(a, c * sub, sub, 1)  # (TI, SUB)
-        b_c = jax.lax.dynamic_slice_in_dim(b, c * sub, sub, 1)  # (TJ, SUB)
+    # static Python unroll (wk/sub is a compile-time constant, default 4):
+    # Mosaic's TC lowering has no dynamic_slice, so a fori_loop with traced
+    # slice starts fails to compile on real hardware — verified on v5e
+    acc = jnp.zeros((ti, tj), jnp.int32)
+    for c in range(wk // sub):
+        a_c = a[:, c * sub:(c + 1) * sub]  # (TI, SUB)
+        b_c = b[:, c * sub:(c + 1) * sub]  # (TJ, SUB)
         anded = a_c[:, None, :] & b_c[None, :, :]  # (TI, TJ, SUB)
-        return acc + jnp.sum(_popcount_words(anded, swar), axis=2)
-
-    out_ref[:] += jax.lax.fori_loop(
-        0, wk // sub, chunk, jnp.zeros((ti, tj), jnp.int32)
-    )
+        acc = acc + jnp.sum(_popcount_words(anded, swar), axis=2)
+    out_ref[:] += acc
 
 
 _KERNELS = {"row": _kernel_row, "bcast": _kernel_bcast}

@@ -82,6 +82,7 @@ class RecommendApp:
             self.batcher = MicroBatcher(
                 self.engine, max_size=cfg.batch_max_size,
                 window_ms=cfg.batch_window_ms,
+                max_inflight=cfg.batch_max_inflight,
             )
         with open(_TEMPLATE_PATH, "r", encoding="utf-8") as fh:
             self._template = fh.read()

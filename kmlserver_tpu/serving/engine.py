@@ -267,17 +267,29 @@ class RecommendEngine:
         songs = [bundle.vocab[int(i)] for i in ids if i >= 0]
         return songs, ("rules" if songs else "empty")
 
-    def recommend_many(
-        self, seed_sets: list[list[str]]
-    ) -> list[tuple[list[str], str]]:
-        """Batched device call over aggregated concurrent requests (the QPS
-        path): ONE kernel invocation serves the whole batch. Per-request
+    def recommend_many_async(self, seed_sets: list[list[str]]):
+        """Batched lookup split into DISPATCH (device call enqueued, returns
+        immediately — jax dispatch is asynchronous) and FINISH (a zero-arg
+        callable that blocks on the result and builds the responses).
+
+        The split lets the micro-batcher pipeline device calls: with a
+        high-latency host<->device link (this environment's remote-TPU
+        tunnel adds ~65 ms per blocked call) a dispatch-block-respond loop
+        caps throughput at batch_size/RTT; overlapping the next dispatch
+        with the previous transfer removes that ceiling. Per-request
         semantics identical to :meth:`recommend`."""
         bundle = self.bundle
         if bundle is None:
             # same late-load nudge as the single-request path
             threading.Thread(target=self.reload_if_required, daemon=True).start()
-            return [(self.static_recommendation(s), "fallback") for s in seed_sets]
+
+            def finish_fallback() -> list[tuple[list[str], str]]:
+                return [
+                    (self.static_recommendation(s), "fallback")
+                    for s in seed_sets
+                ]
+
+            return finish_fallback
         length = self._bucket_len(
             max((len(s) for s in seed_sets), default=1)
         )
@@ -295,15 +307,26 @@ class RecommendEngine:
             ][:length]
             arr[r, : len(ids)] = ids
         top_ids, _ = self._kernel(bundle.rule_ids, bundle.rule_confs, jnp.asarray(arr))
-        top_ids = np.asarray(top_ids)
-        out: list[tuple[list[str], str]] = []
-        for r, seeds in enumerate(seed_sets):
-            if (arr[r] >= 0).any():
-                songs = [bundle.vocab[int(i)] for i in top_ids[r] if i >= 0]
-                out.append((songs, "rules" if songs else "empty"))
-            else:
-                out.append((self.static_recommendation(seeds), "fallback"))
-        return out
+
+        def finish() -> list[tuple[list[str], str]]:
+            host_ids = np.asarray(top_ids)  # blocks on the device transfer
+            out: list[tuple[list[str], str]] = []
+            for r, seeds in enumerate(seed_sets):
+                if (arr[r] >= 0).any():
+                    songs = [bundle.vocab[int(i)] for i in host_ids[r] if i >= 0]
+                    out.append((songs, "rules" if songs else "empty"))
+                else:
+                    out.append((self.static_recommendation(seeds), "fallback"))
+            return out
+
+        return finish
+
+    def recommend_many(
+        self, seed_sets: list[list[str]]
+    ) -> list[tuple[list[str], str]]:
+        """Batched device call over aggregated concurrent requests (the QPS
+        path): ONE kernel invocation serves the whole batch."""
+        return self.recommend_many_async(seed_sets)()
 
     def static_recommendation(self, seed_tracks: list[str]) -> list[str]:
         """Deterministic popular-tracks sample (reference:

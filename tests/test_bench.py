@@ -53,6 +53,17 @@ class TestMfuKeys:
     def test_missing_matmul_is_empty(self):
         assert bench._mfu_keys({"median_s": 1.0}) == {}
 
+    def test_amortized_time_preferred_for_mfu(self):
+        # the per-blocked-call time carries the tunnel round trip; the
+        # pipelined time is the device rate — MFU must use the latter
+        mining = dict(self.MINING_TPU, matmul_amortized_s=0.0001)
+        out = bench._mfu_keys(mining)
+        achieved = 2 * 2246 * 2171 * 2171 / 0.0001
+        assert out["mining_matmul_gops_per_s"] == round(achieved / 1e9, 1)
+        assert out["mining_mfu_pct"] == round(100 * achieved / 394e12, 2)
+        assert out["mining_matmul_ms"] == 1.0  # blocked time still reported
+        assert out["mining_matmul_amortized_ms"] == 0.1
+
 
 class TestParseLatencyPercentiles:
     def test_parses_rendered_metrics(self):

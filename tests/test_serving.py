@@ -194,13 +194,13 @@ class TestEngine:
         )
         seeds = [s for s, row in rules_dict.items() if row]
         calls = []
-        original = engine.recommend_many
+        original = engine.recommend_many_async
 
         def counting(seed_sets):
             calls.append(len(seed_sets))
             return original(seed_sets)
 
-        engine.recommend_many = counting
+        engine.recommend_many_async = counting
         batcher = MicroBatcher(engine, max_size=8, window_ms=50.0)
         results = {}
 
@@ -223,6 +223,54 @@ class TestEngine:
     def test_stable_seed_order_independent(self):
         assert stable_seed(["b", "a"]) == stable_seed(["a", "b"])
         assert stable_seed(["a"]) != stable_seed(["b"])
+
+    def test_pipelined_batches_keep_request_result_pairing(self, mined_pvc):
+        # many small windows force MULTIPLE in-flight batches through the
+        # dispatch/completion pipeline; every response must still match its
+        # own request (a pairing bug would swap results between batches)
+        from kmlserver_tpu.serving.batcher import MicroBatcher
+
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        seeds = [s for s, row in rules_dict.items() if row]
+        batcher = MicroBatcher(engine, max_size=4, window_ms=1.0, max_inflight=3)
+        expected = {s: engine.recommend([s]) for s in seeds}
+        results: dict[int, tuple] = {}
+
+        def worker(i):
+            s = seeds[i % len(seeds)]
+            results[i] = (s, batcher.recommend([s]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(48)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 48
+        for s, (got, source) in results.values():
+            assert set(got) == set(expected[s][0])
+            assert source == expected[s][1]
+
+    def test_recommend_many_async_matches_sync(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        engine.load()
+        rules_dict = artifacts.load_pickle(
+            f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
+        )
+        seed_sets = [[s] for s, row in rules_dict.items() if row][:3]
+        seed_sets.append(["unknown-seed-x"])
+        # dispatch two batches before finishing either — results must not mix
+        f1 = engine.recommend_many_async(seed_sets)
+        f2 = engine.recommend_many_async(list(reversed(seed_sets)))
+        r1, r2 = f1(), f2()
+        sync1 = engine.recommend_many(seed_sets)
+        assert [set(g) for g, _ in r1] == [set(g) for g, _ in sync1]
+        assert [set(g) for g, _ in r2] == [set(g) for g, _ in reversed(sync1)]
 
 
 class TestAppRouting:
