@@ -552,8 +552,14 @@ def _run_phase(
         t_out.start()
 
         timed_out = False
+        t_phase = time.monotonic()
         if platform == "tpu":
-            t_end = time.monotonic() + STARTUP_GRACE_S
+            # never arm a grace longer than the phase's own budget, and
+            # count grace time AGAINST that budget below — otherwise a
+            # short-deadline phase could overrun the bench deadline by
+            # grace+timeout and cost the whole JSON artifact
+            grace = min(STARTUP_GRACE_S, timeout)
+            t_end = t_phase + grace
             # poll alongside the wait: a phase that crashes at import never
             # prints a device line and must not idle out the full grace
             while (
@@ -565,7 +571,7 @@ def _run_phase(
             if not started.is_set() and proc.poll() is None:
                 log(
                     f"{name} phase: no device line within "
-                    f"{STARTUP_GRACE_S:.0f}s — backend init hang; killing "
+                    f"{grace:.0f}s — backend init hang; killing "
                     "early instead of burning the phase timeout"
                 )
                 proc.kill()
@@ -575,7 +581,8 @@ def _run_phase(
                 # unlike a full-timeout hang (which already burned the whole
                 # phase budget), the early kill only cost the grace period —
                 # the flaky pool often recovers, so this IS worth a retry
-                if attempt < attempts:
+                # (when the deadline still has room for one)
+                if attempt < attempts and _remaining() > grace + 60:
                     log(
                         f"{name} phase init hang (attempt {attempt}/"
                         f"{attempts}); retrying in 30s"
@@ -585,7 +592,7 @@ def _run_phase(
                 return None
         if not timed_out:
             try:
-                proc.wait(timeout=timeout)
+                proc.wait(timeout=max(timeout - (time.monotonic() - t_phase), 5.0))
             except subprocess.TimeoutExpired:
                 proc.kill()
                 timed_out = True
@@ -838,20 +845,7 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
             result["scale_frequent_items"] = scale["frequent_items"]
 
     if _remaining() > 120:
-        serving = _run_phase(
-            "serving", _SERVING_BENCH, [npz_path], platform="tpu",
-            timeout=min(900, _remaining()),
-        )
-        if serving is not None:
-            p50 = serving["p50_ms"]
-            log(
-                f"serving (tpu): batch-32 recommend p50 {p50:.3f}ms/call, "
-                f"{serving['amortized_ms']:.3f}ms amortized"
-            )
-            result["serving_batch32_p50_ms"] = round(p50, 3)
-            result["serving_batch32_amortized_ms"] = round(
-                serving["amortized_ms"], 3
-            )
+        _record_serving(result, npz_path, "tpu")
 
     if _remaining() > 240:
         _record_replay(result, "tpu")
@@ -897,21 +891,27 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             result["scale_cpu_mesh8_shape"] = "20000x5000"
 
     if _remaining() > 120:
-        serving = _run_phase(
-            "serving", _SERVING_BENCH, [npz_path], platform="cpu",
-            timeout=min(900, _remaining()),
-        )
-        if serving is not None:
-            p50 = serving["p50_ms"]
-            log(f"serving (cpu): batch-32 recommend p50 {p50:.3f}ms")
-            result["serving_batch32_p50_ms"] = round(p50, 3)
-            result["serving_batch32_amortized_ms"] = round(
-                serving["amortized_ms"], 3
-            )
+        _record_serving(result, npz_path, "cpu")
 
     if _remaining() > 240:
         _record_replay(result, "cpu")
     return mining
+
+
+def _record_serving(result: dict, npz_path: str, platform: str) -> None:
+    serving = _run_phase(
+        "serving", _SERVING_BENCH, [npz_path], platform=platform,
+        timeout=min(900, _remaining()),
+    )
+    if serving is None:
+        return
+    p50 = serving["p50_ms"]
+    log(
+        f"serving ({platform}): batch-32 recommend p50 {p50:.3f}ms/call, "
+        f"{serving['amortized_ms']:.3f}ms amortized"
+    )
+    result["serving_batch32_p50_ms"] = round(p50, 3)
+    result["serving_batch32_amortized_ms"] = round(serving["amortized_ms"], 3)
 
 
 def _record_replay(result: dict, platform: str) -> None:

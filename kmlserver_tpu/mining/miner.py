@@ -311,23 +311,64 @@ def mine(
                 )
                 mined_baskets, _ = prune_infrequent(baskets, min_count)
                 pruned_vocab = mined_baskets.n_tracks
-        with timer.phase("pair_counts"):
-            counts, x = pair_count_fn(
-                mined_baskets, mesh,
-                bitpack_threshold_elems=cfg.bitpack_threshold_elems,
-                sharded_impl=cfg.sharded_impl,
-            )
-            jax.block_until_ready(counts)
-        with timer.phase("rule_emission"):
-            tensors = rules.mine_rules_from_counts(
-                counts,
-                n_playlists=mined_baskets.n_playlists,
-                min_support=cfg.min_support,
-                k_max=cfg.k_max_consequents,
-                mode=cfg.confidence_mode,
-                min_confidence=cfg.min_confidence,
-                n_total_songs=n_total,
-            )
+        # the fused single-jit path (encode→matmul→emission, one compiled
+        # program + one batched fetch) applies whenever no downstream step
+        # needs the one-hot or count matrix on device: single-device dense
+        # mining without an itemset census or triple/quad extensions. The
+        # sharded, bit-packed, and census paths keep the staged pipeline.
+        elems = mined_baskets.n_playlists * mined_baskets.n_tracks
+        wants_bitpack = (
+            cfg.bitpack_threshold_elems is not None
+            and elems > cfg.bitpack_threshold_elems
+            and jax.default_backend() == "tpu"
+        )
+        use_fused = (
+            mesh is None and not wants_bitpack and cfg.max_itemset_len < 3
+        )
+        counts = x = None
+        if use_fused:
+            with timer.phase("fused_mine"):
+                min_count = support.min_count_for(
+                    cfg.min_support, mined_baskets.n_playlists
+                )
+                emitted = jax.device_get(
+                    rules.fused_dense_rule_tensors(
+                        jnp.asarray(mined_baskets.playlist_rows),
+                        jnp.asarray(mined_baskets.track_ids),
+                        jnp.int32(min_count),
+                        n_playlists=mined_baskets.n_playlists,
+                        n_tracks=mined_baskets.n_tracks,
+                        k_max=cfg.k_max_consequents,
+                    )
+                )
+                tensors = rules.assemble_rule_tensors(
+                    *emitted,
+                    n_playlists=mined_baskets.n_playlists,
+                    min_support=cfg.min_support,
+                    k_max=cfg.k_max_consequents,
+                    mode=cfg.confidence_mode,
+                    min_confidence=cfg.min_confidence,
+                    n_total_songs=n_total,
+                    n_tracks=mined_baskets.n_tracks,
+                )
+        else:
+            with timer.phase("pair_counts"):
+                counts, x = pair_count_fn(
+                    mined_baskets, mesh,
+                    bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+                    sharded_impl=cfg.sharded_impl,
+                )
+                jax.block_until_ready(counts)
+            with timer.phase("rule_emission"):
+                tensors = rules.mine_rules_from_counts(
+                    counts,
+                    n_playlists=mined_baskets.n_playlists,
+                    min_support=cfg.min_support,
+                    k_max=cfg.k_max_consequents,
+                    mode=cfg.confidence_mode,
+                    min_confidence=cfg.min_confidence,
+                    n_total_songs=n_total,
+                )
         triple_data = None
         quad_data = None
         triple_merge_applied = None

@@ -280,8 +280,43 @@ def merge_confidence_contributions(
     )
 
 
-def mine_rules_from_counts(
-    pair_count_matrix: jax.Array,
+@partial(jax.jit, static_argnames=("n_playlists", "n_tracks", "k_max"))
+def fused_dense_rule_tensors(
+    playlist_rows: jax.Array,
+    track_ids: jax.Array,
+    min_count: jax.Array,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    k_max: int,
+):
+    """One-hot encode → MXU pair matmul → threshold/top-k emission as ONE
+    compiled program: membership pairs in, finished rule tensors out.
+
+    The unfused path (``pair_count_fn`` + :func:`mine_rules_from_counts`)
+    dispatches eager encode ops, syncs on the count matrix, then issues four
+    separate device→host fetches — each paying a full host<->device round
+    trip, which dominates the mining bracket when the link is a remote-TPU
+    tunnel (~65 ms/trip). Fusing also lets XLA schedule encode/matmul/top-k
+    without host turnarounds. Used by ``mining.miner.mine`` whenever no
+    intermediate (one-hot matrix, count matrix) is needed downstream."""
+    from . import encode, support
+
+    x = encode.onehot_matrix(
+        playlist_rows, track_ids, n_playlists=n_playlists, n_tracks=n_tracks
+    )
+    counts = support.pair_counts(x)
+    rule_ids, rule_counts, row_valid = emit_rule_tensors(
+        counts, min_count, k_max=k_max
+    )
+    return rule_ids, rule_counts, row_valid, jnp.diagonal(counts)
+
+
+def assemble_rule_tensors(
+    rule_ids: np.ndarray,
+    rule_counts: np.ndarray,
+    row_valid: np.ndarray,
+    item_counts: np.ndarray,
     *,
     n_playlists: int,
     min_support: float,
@@ -289,23 +324,13 @@ def mine_rules_from_counts(
     mode: str = "support",
     min_confidence: float = 0.0,
     n_total_songs: int | None = None,
+    n_tracks: int | None = None,
 ) -> RuleTensors:
-    """Full emission: device threshold/top-k, then host assembly + stats.
-
-    ``n_total_songs``: the dataset's full unique-track count when the count
-    matrix covers a PRUNED vocabulary (Apriori pre-filter) — keeps the
-    missing-songs counter meaning what the reference prints
-    (total_songs - frequent keys, machine-learning/main.py:304)."""
+    """Host-side assembly shared by the fused and unfused emission paths:
+    confidence filtering/derivation + provenance/overflow stats."""
     if mode not in ("support", "confidence"):
         raise ValueError(f"confidence mode must be 'support' or 'confidence', got {mode!r}")
     min_count = min_count_for(min_support, n_playlists)
-    rule_ids, rule_counts, row_valid = emit_rule_tensors(
-        pair_count_matrix, jnp.int32(min_count), k_max=k_max
-    )
-    rule_ids = np.asarray(rule_ids)
-    rule_counts = np.asarray(rule_counts)
-    row_valid = np.asarray(row_valid)
-    item_counts = np.asarray(jnp.diagonal(pair_count_matrix))
     n_frequent = int((item_counts >= min_count).sum())
     if mode == "confidence":
         # confidence filter applied HOST-SIDE in float64, so device float32
@@ -331,8 +356,46 @@ def mine_rules_from_counts(
         min_confidence=min_confidence,
         n_frequent_items=n_frequent,
         n_songs_missing=(
-            n_total_songs if n_total_songs is not None else int(pair_count_matrix.shape[0])
+            n_total_songs if n_total_songs is not None else int(n_tracks)
         ) - n_frequent,
         overflow_rows=int((row_valid > k_max).sum()),
         row_valid_counts=row_valid.astype(np.int32),
+    )
+
+
+def mine_rules_from_counts(
+    pair_count_matrix: jax.Array,
+    *,
+    n_playlists: int,
+    min_support: float,
+    k_max: int,
+    mode: str = "support",
+    min_confidence: float = 0.0,
+    n_total_songs: int | None = None,
+) -> RuleTensors:
+    """Full emission from a materialized count matrix: device
+    threshold/top-k, then host assembly + stats. The path for sharded and
+    bit-packed mining (where the counts already exist); the dense
+    single-device path uses :func:`fused_dense_rule_tensors` instead.
+
+    ``n_total_songs``: the dataset's full unique-track count when the count
+    matrix covers a PRUNED vocabulary (Apriori pre-filter) — keeps the
+    missing-songs counter meaning what the reference prints
+    (total_songs - frequent keys, machine-learning/main.py:304)."""
+    min_count = min_count_for(min_support, n_playlists)
+    rule_ids, rule_counts, row_valid = emit_rule_tensors(
+        pair_count_matrix, jnp.int32(min_count), k_max=k_max
+    )
+    diag = jnp.diagonal(pair_count_matrix)
+    # one batched fetch — four sequential np.asarray calls would pay four
+    # host<->device round trips on a tunneled backend
+    rule_ids, rule_counts, row_valid, item_counts = jax.device_get(
+        (rule_ids, rule_counts, row_valid, diag)
+    )
+    return assemble_rule_tensors(
+        rule_ids, rule_counts, row_valid, item_counts,
+        n_playlists=n_playlists, min_support=min_support, k_max=k_max,
+        mode=mode, min_confidence=min_confidence,
+        n_total_songs=n_total_songs,
+        n_tracks=int(pair_count_matrix.shape[0]),
     )

@@ -18,11 +18,17 @@ from .app import RecommendApp, serve
 
 
 def main() -> int:
+    # the reference configures DEBUG-level stdout logging for ITS app
+    # (rest_api/app/main.py:18-29). Scope DEBUG to this package's logger
+    # only — putting the ROOT logger at DEBUG floods stdout with ~170 KB of
+    # jax compile chatter per reload (and can block the process mid-warmup
+    # when a log collector stops draining the pipe)
     logging.basicConfig(
-        level=logging.DEBUG,
+        level=logging.INFO,
         stream=sys.stdout,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    logging.getLogger("kmlserver_tpu").setLevel(logging.DEBUG)
     cfg = ServingConfig.from_env()
     app = RecommendApp(cfg)
     app.engine.start_polling()

@@ -95,6 +95,45 @@ class TestClassify:
         assert bench._classify("TypeError: boom", False) == "hard"
 
 
+class TestRunPhaseWatchdog:
+    def test_init_hang_killed_early_and_retried(self, monkeypatch):
+        import time as time_mod
+
+        monkeypatch.setattr(bench, "STARTUP_GRACE_S", 1.5)
+        sleeps = []
+        monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+        code = "import time\ntime.sleep(30)"  # never prints a device line
+        t0 = time_mod.monotonic()
+        out = bench._run_phase(
+            "watchdog-test", code, [], platform="tpu", timeout=60, attempts=2
+        )
+        elapsed = time_mod.monotonic() - t0
+        assert out is None
+        # two ~1.5s grace windows, NOT the 60s phase timeout
+        assert elapsed < 20
+        assert 30 in sleeps  # the init hang consumed a retry with backoff
+
+    def test_device_line_disarms_watchdog(self, monkeypatch):
+        monkeypatch.setattr(bench, "STARTUP_GRACE_S", 1.0)
+        code = (
+            "import sys, time\n"
+            "print('device: tpu (fake)', file=sys.stderr, flush=True)\n"
+            "time.sleep(2)\n"  # longer than the grace — must NOT be killed
+            "print('{\"ok\": 1}')\n"
+        )
+        out = bench._run_phase(
+            "watchdog-test", code, [], platform="tpu", timeout=30, attempts=1
+        )
+        assert out == {"ok": 1}
+
+    def test_cpu_phase_needs_no_device_line(self):
+        code = "print('{\"ok\": 2}')"
+        out = bench._run_phase(
+            "cpu-test", code, [], platform="cpu", timeout=30, attempts=1
+        )
+        assert out == {"ok": 2}
+
+
 class TestProbeHistory:
     def test_forced_cpu_history_shape(self):
         prober = bench.TpuProber(probe_timeout_s=1.0, interval_s=1.0)

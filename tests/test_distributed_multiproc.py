@@ -63,6 +63,25 @@ cfg = MiningConfig(
 summary = run_mining_job(cfg, mesh=mesh)
 print(f"RANK {rank} WROTE {bool(summary.artifact_paths)} "
       f"TOKEN {bool(summary.token)} MISSING {summary.n_songs_missing}")
+
+# config-4's distributed dependency: the BIT-PACKED pair-count path with the
+# word axis dp-sharded across PROCESS boundaries (the DCN analogue), Pallas
+# kernel per device (interpreted on CPU), partial counts psum-ed globally.
+# Every rank must read back the full replicated counts, equal to a numpy
+# ground truth.
+import numpy as np
+from kmlserver_tpu.data.synthetic import synthetic_baskets
+from kmlserver_tpu.parallel.mesh import make_mesh
+from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
+
+b = synthetic_baskets(n_playlists=50, n_tracks=30, target_rows=400, seed=11)
+flat = make_mesh("auto")  # all 4 devices (2 per process) on dp
+counts = sharded_bitpack_pair_counts(b, flat)
+assert counts.is_fully_replicated, counts.sharding
+x = np.zeros((b.n_playlists, b.n_tracks), np.int32)
+x[b.playlist_rows, b.track_ids] = 1
+np.testing.assert_array_equal(np.asarray(counts), x.T @ x)
+print(f"RANK {rank} BITPACK EXACT")
 """
 
 
@@ -113,6 +132,10 @@ def test_two_process_mining_job(tmp_path):
     wrote = [f"RANK {r} WROTE True" in outs[r] for r in range(2)]
     assert wrote == [True, False], outs
     assert "TOKEN True" in outs[0] and "TOKEN False" in outs[1]
+
+    # the cross-process bitpack path verified exact on BOTH ranks
+    for r in range(2):
+        assert f"RANK {r} BITPACK EXACT" in outs[r], outs[r]
 
     # artifacts landed once, on the shared "PVC"
     pickles = tmp_path / "dist" / "pickles"

@@ -150,6 +150,31 @@ class TestRuleEmission:
             expected = reference_fast_rules(baskets, min_support)  # all lengths
             assert got == expected, f"trial {trial}"
 
+    def test_fused_path_identical_to_staged(self, rng):
+        """The single-jit fused path (encode→matmul→emit in one program)
+        must produce byte-identical tensors to the staged pipeline — it is
+        a round-trip optimization, never a semantic fork."""
+        from kmlserver_tpu.config import MiningConfig
+        from kmlserver_tpu.mining.miner import mine
+
+        for min_support in (0.05, 0.12):
+            baskets = random_baskets(rng, n_playlists=60, n_tracks=16, mean_len=5)
+            b = build_baskets(table_from_baskets(baskets))
+            fused = mine(b, MiningConfig(min_support=min_support, k_max_consequents=16))
+            # max_itemset_len=3 forces the staged pipeline (census needs
+            # the count matrix); rule tensors themselves must not differ
+            staged = mine(b, MiningConfig(
+                min_support=min_support, k_max_consequents=16, max_itemset_len=3,
+            ))
+            assert "fused_mine" in fused.phase_timings
+            assert "pair_counts" in staged.phase_timings
+            np.testing.assert_array_equal(fused.tensors.rule_ids, staged.tensors.rule_ids)
+            np.testing.assert_array_equal(fused.tensors.rule_counts, staged.tensors.rule_counts)
+            np.testing.assert_array_equal(fused.tensors.rule_confs, staged.tensors.rule_confs)
+            np.testing.assert_array_equal(fused.tensors.item_counts, staged.tensors.item_counts)
+            assert fused.tensors.overflow_rows == staged.tensors.overflow_rows
+            assert fused.tensors.n_songs_missing == staged.tensors.n_songs_missing
+
     def test_missing_songs_counter(self, rng):
         baskets = random_baskets(rng, n_playlists=50, n_tracks=14, mean_len=4)
         min_support = 0.12

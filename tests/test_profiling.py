@@ -52,6 +52,14 @@ def test_mine_reports_phase_timings():
     )
     result = mine(baskets, MiningConfig(min_support=0.05, k_max_consequents=8))
     assert result.phase_timings is not None
-    assert "pair_counts" in result.phase_timings
-    assert "rule_emission" in result.phase_timings
+    # default config takes the fused single-jit path (one phase); the
+    # staged pipeline reports its per-stage phases
+    assert "fused_mine" in result.phase_timings
     assert sum(result.phase_timings.values()) <= result.duration_s + 0.5
+
+    staged = mine(
+        baskets,
+        MiningConfig(min_support=0.05, k_max_consequents=8, max_itemset_len=3),
+    )
+    assert "pair_counts" in staged.phase_timings
+    assert "rule_emission" in staged.phase_timings
