@@ -688,14 +688,15 @@ def replay_phase(platform: str) -> dict | None:
         env = _phase_env(platform)
         env.update(job_env)
         log(f"[replay] running the real mining job on {platform}...")
+        job_timeout = min(900.0, max(_remaining(), 60.0))
         try:
             job = subprocess.run(
                 [sys.executable, "-m", "kmlserver_tpu.mining.job"],
-                capture_output=True, text=True, timeout=900, env=env,
+                capture_output=True, text=True, timeout=job_timeout, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
-            log("replay skipped: mining job hung past 900s")
+            log(f"replay skipped: mining job hung past {job_timeout:.0f}s")
             return None
         if job.returncode != 0:
             for line in job.stdout.splitlines()[-10:]:
@@ -735,7 +736,7 @@ def replay_phase(platform: str) -> dict | None:
                 return None
             url = f"http://127.0.0.1:{port_holder[0]}"
             # jit warmup happens on first load; gate on readiness
-            if not _wait_ready(url, deadline_s=300):
+            if not _wait_ready(url, deadline_s=min(300.0, max(_remaining(), 30.0))):
                 log("replay skipped: server /readyz never went 200")
                 for line in srv_lines[-10:]:
                     log(f"[replay-server] {line}")

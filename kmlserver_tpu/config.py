@@ -99,6 +99,11 @@ class MiningConfig:
     prune_vocab_threshold: int = 4096
     # Write the tensor-native artifact (rules npz) alongside the pickles.
     write_tensor_artifact: bool = True
+    # On a CPU backend (no TPU reachable), count pair supports with the
+    # native bit-packed POPCNT kernel (native/kmls_popcount.cpp) instead of
+    # XLA:CPU's int8 matmul — exact, ~40x faster on the dominant phase.
+    # Ignored on TPU; falls back automatically when the .so can't build.
+    native_cpu_pair_counts: bool = True
 
     @property
     def pickles_dir(self) -> str:
@@ -134,6 +139,7 @@ class MiningConfig:
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
             prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 4096),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
+            native_cpu_pair_counts=_getenv_bool("KMLS_NATIVE_PAIR_COUNTS", True),
         )
 
 

@@ -322,11 +322,45 @@ def mine(
             and elems > cfg.bitpack_threshold_elems
             and jax.default_backend() == "tpu"
         )
+        # CPU fallback with the native POPCNT kernel: when no TPU is
+        # reachable, XLA:CPU's int8 matmul dominates the bracket (~75%);
+        # the native bit-packed counter is the same exact XᵀX ~40x faster
+        # (native/kmls_popcount.cpp). Same eligibility as the fused path
+        # (no downstream step may need the one-hot or counts on device).
+        from ..ops import cpu_popcount
+
+        use_native_cpu = (
+            mesh is None
+            and cfg.max_itemset_len < 3
+            and cfg.native_cpu_pair_counts
+            and jax.default_backend() == "cpu"
+            and cpu_popcount.available()
+        )
         use_fused = (
-            mesh is None and not wants_bitpack and cfg.max_itemset_len < 3
+            mesh is None
+            and not wants_bitpack
+            and cfg.max_itemset_len < 3
+            and not use_native_cpu
         )
         counts = x = None
-        if use_fused:
+        if use_native_cpu:
+            with timer.phase("native_pair_counts"):
+                counts_np = cpu_popcount.pair_counts(
+                    mined_baskets.playlist_rows, mined_baskets.track_ids,
+                    n_playlists=mined_baskets.n_playlists,
+                    n_tracks=mined_baskets.n_tracks,
+                )
+            with timer.phase("rule_emission"):
+                tensors = rules.mine_rules_from_counts_np(
+                    counts_np,
+                    n_playlists=mined_baskets.n_playlists,
+                    min_support=cfg.min_support,
+                    k_max=cfg.k_max_consequents,
+                    mode=cfg.confidence_mode,
+                    min_confidence=cfg.min_confidence,
+                    n_total_songs=n_total,
+                )
+        elif use_fused:
             with timer.phase("fused_mine"):
                 min_count = support.min_count_for(
                     cfg.min_support, mined_baskets.n_playlists

@@ -1,0 +1,136 @@
+"""ctypes bindings for the native CPU pair-support counter
+(native/kmls_popcount.cpp) — the CPU-fallback analogue of the Pallas
+popcount kernel.
+
+When the backend is CPU (no TPU reachable), XLA:CPU's int8 one-hot matmul
+dominates the mining bracket; the native kernel computes the same exact
+``XᵀX`` pair-count matrix from bit-packed rows with the POPCNT unit,
+threaded, an order of magnitude faster. Bit-packing happens host-side with
+``np.packbits`` (little bit order: bit p of row i's words ⇔ playlist p
+contains track i); zero padding contributes zero counts.
+
+Build/load follows the CSV loader's pattern (data/native.py): ``make -C
+native`` on demand, graceful fallback when the toolchain or .so is absent,
+``KMLS_NATIVE=0`` kills all native paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libkmls_popcount.so")
+
+# must match kAbiVersion in native/kmls_popcount.cpp
+_ABI_VERSION = 1
+
+_lib: ctypes.CDLL | None = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.kmls_popcount_abi_version.restype = ctypes.c_int32
+    lib.kmls_popcount_abi_version.argtypes = []
+    got = lib.kmls_popcount_abi_version()
+    if got != _ABI_VERSION:
+        raise OSError(
+            f"native popcount ABI {got} != expected {_ABI_VERSION} "
+            f"(stale build: run make -C native)"
+        )
+    lib.kmls_pair_counts.restype = None
+    lib.kmls_pair_counts.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    return lib
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR], check=True, capture_output=quiet
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return os.path.exists(_SO_PATH)  # no toolchain: use what exists
+    return os.path.exists(_SO_PATH)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    if os.environ.get("KMLS_NATIVE", "1") == "0":
+        return None
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def bitpack_rows(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+) -> np.ndarray:
+    """→ ``(n_tracks, ceil(P/64)) uint64``: bit p of row t set iff playlist
+    p contains track t. Duplicate membership rows OR idempotently (same as
+    the device one-hot's scatter-max, ops/encode.py)."""
+    x = np.zeros((n_tracks, n_playlists), dtype=bool)
+    x[track_ids, playlist_rows] = True
+    packed8 = np.packbits(x, axis=1, bitorder="little")  # (V, ceil(P/8)) uint8
+    w64 = (n_playlists + 63) // 64
+    if packed8.shape[1] < w64 * 8:
+        packed8 = np.pad(packed8, ((0, 0), (0, w64 * 8 - packed8.shape[1])))
+    return np.ascontiguousarray(packed8).view(np.uint64)
+
+
+def pair_counts(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    n_threads: int | None = None,
+) -> np.ndarray:
+    """Exact ``XᵀX`` pair-count matrix (V, V) int32 via the native kernel.
+
+    Raises RuntimeError when the native library is unavailable — callers
+    gate on :func:`available` and use the XLA path otherwise."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native popcount unavailable (build native/ first)")
+    if n_threads is None:
+        n_threads = int(os.environ.get("KMLS_NATIVE_THREADS", "0"))
+    bt = bitpack_rows(
+        playlist_rows, track_ids,
+        n_playlists=n_playlists, n_tracks=n_tracks,
+    )
+    out = np.empty((n_tracks, n_tracks), dtype=np.int32)
+    if n_tracks == 0:
+        return out
+    lib.kmls_pair_counts(
+        bt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.c_int32(n_tracks),
+        ctypes.c_int64(bt.shape[1]),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n_threads),
+    )
+    return out

@@ -312,6 +312,68 @@ def fused_dense_rule_tensors(
     return rule_ids, rule_counts, row_valid, jnp.diagonal(counts)
 
 
+def emit_rule_tensors_np(
+    pair_count_matrix: np.ndarray, min_count: int, *, k_max: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Numpy twin of :func:`emit_rule_tensors` for the native-CPU mining
+    path — XLA:CPU's ``top_k`` costs ~400 ms at ds2 shape where
+    argpartition costs ~100 ms.
+
+    Tie semantics replicated EXACTLY (equal counts rank by ascending column
+    index, like lax.top_k) via a composite integer key ``score·V + (V-1-j)``
+    that is strictly totally ordered, so partition/sort order is unique."""
+    v = pair_count_matrix.shape[0]
+    counts = pair_count_matrix.astype(np.int64, copy=False)
+    valid = counts >= min_count
+    np.fill_diagonal(valid, False)
+    row_valid_counts = valid.sum(axis=1, dtype=np.int32)
+    score = np.where(valid, counts, -1)
+    key = score * v + (v - 1 - np.arange(v, dtype=np.int64)[None, :])
+    k = min(k_max, v)
+    if k < v:
+        part = np.argpartition(-key, k - 1, axis=1)[:, :k]
+    else:
+        part = np.broadcast_to(np.arange(v)[None, :], (v, v)).copy()
+    part_key = np.take_along_axis(key, part, axis=1)
+    order = np.argsort(-part_key, axis=1)
+    top_ids = np.take_along_axis(part, order, axis=1)
+    top_counts = np.take_along_axis(score, top_ids, axis=1)
+    keep = top_counts > 0
+    rule_ids = np.where(keep, top_ids, -1).astype(np.int32)
+    rule_counts = np.where(keep, top_counts, 0).astype(np.int32)
+    if k < k_max:  # pad up to the declared row capacity
+        pad = ((0, 0), (0, k_max - k))
+        rule_ids = np.pad(rule_ids, pad, constant_values=-1)
+        rule_counts = np.pad(rule_counts, pad)
+    return rule_ids, rule_counts, row_valid_counts
+
+
+def mine_rules_from_counts_np(
+    pair_count_matrix: np.ndarray,
+    *,
+    n_playlists: int,
+    min_support: float,
+    k_max: int,
+    mode: str = "support",
+    min_confidence: float = 0.0,
+    n_total_songs: int | None = None,
+) -> RuleTensors:
+    """Host-only emission from a host count matrix (the native-CPU path):
+    no device round trip anywhere."""
+    min_count = min_count_for(min_support, n_playlists)
+    rule_ids, rule_counts, row_valid = emit_rule_tensors_np(
+        pair_count_matrix, min_count, k_max=k_max
+    )
+    return assemble_rule_tensors(
+        rule_ids, rule_counts, row_valid,
+        np.diagonal(pair_count_matrix).astype(np.int32, copy=True),
+        n_playlists=n_playlists, min_support=min_support, k_max=k_max,
+        mode=mode, min_confidence=min_confidence,
+        n_total_songs=n_total_songs,
+        n_tracks=int(pair_count_matrix.shape[0]),
+    )
+
+
 def assemble_rule_tensors(
     rule_ids: np.ndarray,
     rule_counts: np.ndarray,

@@ -210,3 +210,58 @@ def test_sample_ratio_head_slice(tmp_path):
     half = read_tracks(path, sample_ratio=0.5)
     assert len(half) == max(1, len(full) // 2)
     np.testing.assert_array_equal(half.track_name, full.track_name[: len(half)])
+
+
+# ---------- native CPU pair-support counter (native/kmls_popcount.cpp) ----------
+
+
+@pytest.fixture
+def cpu_popcount():
+    """The native popcount module, or skip — a toolchain that builds the
+    CSV loader but not this .so must degrade gracefully, exactly like the
+    product path does (miner.py falls back to XLA)."""
+    from kmlserver_tpu.ops import cpu_popcount as mod
+
+    if not mod.available():
+        pytest.skip("native popcount library unavailable on this toolchain")
+    return mod
+
+
+class TestNativePopcount:
+    def test_pair_counts_match_numpy_oracle(self, rng, cpu_popcount):
+        for trial, (p, v) in enumerate([(70, 20), (129, 65), (64, 3)]):
+            rows = rng.integers(0, p, size=400 + trial)
+            ids = rng.integers(0, v, size=400 + trial)
+            counts = cpu_popcount.pair_counts(
+                rows, ids, n_playlists=p, n_tracks=v)
+            x = np.zeros((p, v), np.int64)
+            x[rows, ids] = 1  # duplicate memberships counted once
+            np.testing.assert_array_equal(counts, (x.T @ x).astype(np.int32))
+
+    def test_bitpack_rows_little_bit_order(self, cpu_popcount):
+        # track 0 in playlists {0, 64}: bit 0 of word 0 and bit 0 of word 1
+        bt = cpu_popcount.bitpack_rows(
+            np.array([0, 64]), np.array([0, 0]), n_playlists=65, n_tracks=1)
+        assert bt.shape == (1, 2)
+        assert bt[0, 0] == 1 and bt[0, 1] == 1
+
+    def test_thread_counts_agree(self, rng, cpu_popcount):
+        rows = rng.integers(0, 500, size=3000)
+        ids = rng.integers(0, 100, size=3000)
+        kw = dict(n_playlists=500, n_tracks=100)
+        single = cpu_popcount.pair_counts(rows, ids, n_threads=1, **kw)
+        multi = cpu_popcount.pair_counts(rows, ids, n_threads=8, **kw)
+        np.testing.assert_array_equal(single, multi)
+
+    def test_kill_switch(self, monkeypatch, cpu_popcount):
+        monkeypatch.setenv("KMLS_NATIVE", "0")
+        assert not cpu_popcount.available()
+        with pytest.raises(RuntimeError):
+            cpu_popcount.pair_counts(
+                np.array([0]), np.array([0]), n_playlists=1, n_tracks=1)
+
+    def test_empty_vocab(self, cpu_popcount):
+        out = cpu_popcount.pair_counts(
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            n_playlists=0, n_tracks=0)
+        assert out.shape == (0, 0)

@@ -150,30 +150,68 @@ class TestRuleEmission:
             expected = reference_fast_rules(baskets, min_support)  # all lengths
             assert got == expected, f"trial {trial}"
 
-    def test_fused_path_identical_to_staged(self, rng):
-        """The single-jit fused path (encode→matmul→emit in one program)
-        must produce byte-identical tensors to the staged pipeline — it is
-        a round-trip optimization, never a semantic fork."""
+    def test_all_mining_paths_identical(self, rng):
+        """The three single-device paths — native-CPU POPCNT counts, the
+        single-jit fused program, and the staged pipeline — must produce
+        byte-identical tensors: they are perf alternatives, never semantic
+        forks."""
         from kmlserver_tpu.config import MiningConfig
         from kmlserver_tpu.mining.miner import mine
+        from kmlserver_tpu.ops import cpu_popcount
 
         for min_support in (0.05, 0.12):
             baskets = random_baskets(rng, n_playlists=60, n_tracks=16, mean_len=5)
             b = build_baskets(table_from_baskets(baskets))
-            fused = mine(b, MiningConfig(min_support=min_support, k_max_consequents=16))
+            results = {}
+            # default on a CPU backend: the native kernel (when it built)
+            default = mine(b, MiningConfig(min_support=min_support, k_max_consequents=16))
+            if cpu_popcount.available():
+                assert "native_pair_counts" in default.phase_timings
+                results["native"] = default
+            fused = mine(b, MiningConfig(
+                min_support=min_support, k_max_consequents=16,
+                native_cpu_pair_counts=False,
+            ))
+            assert "fused_mine" in fused.phase_timings
+            results["fused"] = fused
             # max_itemset_len=3 forces the staged pipeline (census needs
             # the count matrix); rule tensors themselves must not differ
             staged = mine(b, MiningConfig(
                 min_support=min_support, k_max_consequents=16, max_itemset_len=3,
             ))
-            assert "fused_mine" in fused.phase_timings
             assert "pair_counts" in staged.phase_timings
-            np.testing.assert_array_equal(fused.tensors.rule_ids, staged.tensors.rule_ids)
-            np.testing.assert_array_equal(fused.tensors.rule_counts, staged.tensors.rule_counts)
-            np.testing.assert_array_equal(fused.tensors.rule_confs, staged.tensors.rule_confs)
-            np.testing.assert_array_equal(fused.tensors.item_counts, staged.tensors.item_counts)
-            assert fused.tensors.overflow_rows == staged.tensors.overflow_rows
-            assert fused.tensors.n_songs_missing == staged.tensors.n_songs_missing
+            for name, other in results.items():
+                np.testing.assert_array_equal(
+                    other.tensors.rule_ids, staged.tensors.rule_ids, err_msg=name)
+                np.testing.assert_array_equal(
+                    other.tensors.rule_counts, staged.tensors.rule_counts, err_msg=name)
+                np.testing.assert_array_equal(
+                    other.tensors.rule_confs, staged.tensors.rule_confs, err_msg=name)
+                np.testing.assert_array_equal(
+                    other.tensors.item_counts, staged.tensors.item_counts, err_msg=name)
+                assert other.tensors.overflow_rows == staged.tensors.overflow_rows
+                assert other.tensors.n_songs_missing == staged.tensors.n_songs_missing
+
+    def test_numpy_emission_matches_jit_including_ties(self, rng):
+        """emit_rule_tensors_np must replicate lax.top_k's tie semantics
+        (equal counts rank by ascending index) bit-for-bit — tie-heavy
+        matrices are the adversarial case for the composite-key trick."""
+        for trial in range(4):
+            v = [7, 32, 65, 129][trial]
+            # few distinct values → many ties within every row
+            m = rng.integers(0, 4, size=(v, v)).astype(np.int32)
+            m = m + m.T  # symmetric like a real count matrix
+            np.fill_diagonal(m, rng.integers(1, 9, size=v).astype(np.int32))
+            for k_max in (3, v, v + 10):
+                jit_ids, jit_counts, jit_valid = (
+                    np.asarray(a) for a in rules.emit_rule_tensors(
+                        jnp.asarray(m), jnp.int32(2), k_max=k_max)
+                )
+                np_ids, np_counts, np_valid = rules.emit_rule_tensors_np(
+                    m, 2, k_max=k_max)
+                np.testing.assert_array_equal(np_ids, jit_ids)
+                np.testing.assert_array_equal(np_counts, jit_counts)
+                np.testing.assert_array_equal(np_valid, jit_valid)
 
     def test_missing_songs_counter(self, rng):
         baskets = random_baskets(rng, n_playlists=50, n_tracks=14, mean_len=4)

@@ -52,10 +52,21 @@ def test_mine_reports_phase_timings():
     )
     result = mine(baskets, MiningConfig(min_support=0.05, k_max_consequents=8))
     assert result.phase_timings is not None
-    # default config takes the fused single-jit path (one phase); the
-    # staged pipeline reports its per-stage phases
-    assert "fused_mine" in result.phase_timings
+    # default on a CPU backend: native POPCNT counts (fused single-jit
+    # path when the native kernel didn't build); the staged pipeline
+    # reports its per-stage phases
+    from kmlserver_tpu.ops import cpu_popcount
+
+    expected_phase = (
+        "native_pair_counts" if cpu_popcount.available() else "fused_mine"
+    )
+    assert expected_phase in result.phase_timings
     assert sum(result.phase_timings.values()) <= result.duration_s + 0.5
+
+    fused = mine(baskets, MiningConfig(
+        min_support=0.05, k_max_consequents=8, native_cpu_pair_counts=False,
+    ))
+    assert "fused_mine" in fused.phase_timings
 
     staged = mine(
         baskets,
