@@ -850,6 +850,18 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
 
     if _remaining() > 240:
         _record_replay(result, "tpu")
+
+    if _remaining() > 300:
+        # supplementary CPU replay: through this environment's remote-TPU
+        # tunnel every request pays ~65 ms of round trip, which measures
+        # the tunnel, not the serving stack — a production pod has a LOCAL
+        # chip. The CPU-stack replay (native mining fallback + host
+        # kernels) is the closer proxy for framework overhead; record it
+        # under cpu_-prefixed keys next to the tunnel numbers.
+        cpu_replay: dict = {}
+        _record_replay(cpu_replay, "cpu")
+        for key, val in cpu_replay.items():
+            result[f"cpu_{key}"] = val
     return mining
 
 

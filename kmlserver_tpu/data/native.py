@@ -18,18 +18,10 @@ from __future__ import annotations
 import ctypes
 import dataclasses
 import os
-import subprocess
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
-_SO_PATH = os.path.join(_NATIVE_DIR, "libkmls_csv.so")
-
-_lib: ctypes.CDLL | None = None
-
+from ..utils import nativelib
 
 # must match KMLS_ABI_VERSION in native/kmls_csv.cpp
 _ABI_VERSION = 2
@@ -74,41 +66,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+_loader = nativelib.NativeLib("libkmls_csv.so", _bind)
+
+
 def ensure_built(quiet: bool = True) -> bool:
     """Build (or incrementally rebuild) the .so; returns availability.
 
-    Always runs make — its kmls_csv.cpp dependency makes this a no-op when
-    current, and it replaces a STALE .so left by an older checkout, which
-    would otherwise silently serve an outdated parser ABI."""
-    try:
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
-            check=True,
-            capture_output=quiet,
-        )
-    except (subprocess.CalledProcessError, FileNotFoundError):
-        return os.path.exists(_SO_PATH)  # no toolchain: use what exists
-    return os.path.exists(_SO_PATH)
+    Runs make once per process — its kmls_csv.cpp dependency makes it a
+    no-op when current, and it replaces a STALE .so left by an older
+    checkout, which would otherwise silently serve an outdated parser ABI."""
+    nativelib.run_make_once(quiet)
+    return os.path.exists(_loader.so_path)
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib
-    # the kill switch is honored on every call, not just before first load
-    if os.environ.get("KMLS_NATIVE", "1") == "0":
-        return None
-    if _lib is not None:
-        return _lib
-    if not ensure_built():
-        return None
-    try:
-        _lib = _bind(ctypes.CDLL(_SO_PATH))
-    except OSError:
-        return None
-    return _lib
+    return _loader.load()
 
 
 def available() -> bool:
-    return _load() is not None
+    return _loader.available()
 
 
 @dataclasses.dataclass

@@ -18,20 +18,13 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
-_SO_PATH = os.path.join(_NATIVE_DIR, "libkmls_popcount.so")
+from ..utils import nativelib
 
 # must match kAbiVersion in native/kmls_popcount.cpp
 _ABI_VERSION = 1
-
-_lib: ctypes.CDLL | None = None
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -54,33 +47,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+_loader = nativelib.NativeLib("libkmls_popcount.so", _bind)
+
+
 def ensure_built(quiet: bool = True) -> bool:
-    try:
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR], check=True, capture_output=quiet
-        )
-    except (subprocess.CalledProcessError, FileNotFoundError):
-        return os.path.exists(_SO_PATH)  # no toolchain: use what exists
-    return os.path.exists(_SO_PATH)
+    nativelib.run_make_once(quiet)
+    return os.path.exists(_loader.so_path)
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib
-    if os.environ.get("KMLS_NATIVE", "1") == "0":
-        return None
-    if _lib is not None:
-        return _lib
-    if not ensure_built():
-        return None
-    try:
-        _lib = _bind(ctypes.CDLL(_SO_PATH))
-    except OSError:
-        return None
-    return _lib
+    return _loader.load()
 
 
 def available() -> bool:
-    return _load() is not None
+    return _loader.available()
 
 
 def bitpack_rows(

@@ -328,7 +328,16 @@ def emit_rule_tensors_np(
     np.fill_diagonal(valid, False)
     row_valid_counts = valid.sum(axis=1, dtype=np.int32)
     score = np.where(valid, counts, -1)
-    key = score * v + (v - 1 - np.arange(v, dtype=np.int64)[None, :])
+    # int32 keys when the range fits (counts ≤ P make this the common
+    # case): argpartition over the (V, V) key matrix is memory-bound
+    key_dtype = (
+        np.int32
+        if (int(score.max(initial=0)) + 1) * v < np.iinfo(np.int32).max
+        else np.int64
+    )
+    key = score.astype(key_dtype) * key_dtype(v) + (
+        v - 1 - np.arange(v, dtype=key_dtype)[None, :]
+    )
     k = min(k_max, v)
     if k < v:
         part = np.argpartition(-key, k - 1, axis=1)[:, :k]

@@ -55,7 +55,7 @@ def test_facade_uses_native_and_matches(tmp_path, monkeypatch):
     write_tracks_csv(path, table)
     via_native = read_tracks(path)
     monkeypatch.setenv("KMLS_NATIVE", "0")
-    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native._loader, "_lib", None)
     via_pandas = read_tracks(path)
     np.testing.assert_array_equal(via_native.pid, via_pandas.pid)
     np.testing.assert_array_equal(via_native.track_name, via_pandas.track_name)
@@ -125,7 +125,7 @@ def test_float_pid_rejected_on_both_paths(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="pid"):
         read_tracks(str(path))  # native path raises, falls back, pandas raises
     monkeypatch.setenv("KMLS_NATIVE", "0")
-    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native._loader, "_lib", None)
     with pytest.raises(ValueError, match="pid"):
         read_tracks(str(path))
     # out-of-int64-range pid must error on the pandas path too (the native
@@ -151,7 +151,7 @@ def test_empty_cell_parity_with_pandas(tmp_path, monkeypatch):
     path.write_text("pid,track_name,artist_name\n1,,z\n2,y,\n")
     via_native = read_tracks(str(path))
     monkeypatch.setenv("KMLS_NATIVE", "0")
-    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native._loader, "_lib", None)
     via_pandas = read_tracks(str(path))
     assert via_native.track_name.tolist() == ["", "y"]
     np.testing.assert_array_equal(via_native.track_name, via_pandas.track_name)
