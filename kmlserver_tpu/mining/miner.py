@@ -125,6 +125,29 @@ def pair_count_fn(
     return support.pair_counts(x), x
 
 
+def native_cpu_eligible(cfg: MiningConfig, mesh=None) -> bool:
+    """True when the native POPCNT fallback carries pair counting: CPU
+    backend, single device, and no downstream step (itemset census,
+    triple/quad extensions) needing device intermediates. May trigger the
+    one-time native build — call OUTSIDE any timed bracket. The ONE copy
+    of this gate — the sweep harness must stay in lockstep with the miner."""
+    return (
+        mesh is None
+        and cfg.max_itemset_len < 3
+        and cfg.native_cpu_pair_counts
+        and jax.default_backend() == "cpu"
+        and cpu_popcount.available()
+    )
+
+
+def native_pair_counts(baskets: Baskets) -> np.ndarray:
+    """The native counter invoked exactly as the miner invokes it."""
+    return cpu_popcount.pair_counts(
+        baskets.playlist_rows, baskets.track_ids,
+        n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+    )
+
+
 PAIR_CAPACITY = 1 << 16
 
 
@@ -303,13 +326,7 @@ def mine(
     # g++ build it triggers) resolves BEFORE the reference-parity timer:
     # library setup is environment preparation, not rule generation — the
     # same reason the bench excludes jit compilation via warm-up
-    native_cpu_ok = (
-        mesh is None
-        and cfg.max_itemset_len < 3
-        and cfg.native_cpu_pair_counts
-        and jax.default_backend() == "cpu"
-        and cpu_popcount.available()
-    )
+    native_cpu_ok = native_cpu_eligible(cfg, mesh)
     t0 = time.perf_counter()
     n_total = baskets.n_tracks
     pruned_vocab = None
@@ -348,11 +365,7 @@ def mine(
         counts = x = None
         if use_native_cpu:
             with timer.phase("native_pair_counts"):
-                counts_np = cpu_popcount.pair_counts(
-                    mined_baskets.playlist_rows, mined_baskets.track_ids,
-                    n_playlists=mined_baskets.n_playlists,
-                    n_tracks=mined_baskets.n_tracks,
-                )
+                counts_np = native_pair_counts(mined_baskets)
             with timer.phase("rule_emission"):
                 tensors = rules.mine_rules_from_counts_np(
                     counts_np,

@@ -31,7 +31,9 @@ from ..data.csv import read_tracks
 from ..io import registry
 from ..io.artifacts import atomic_write_text
 from ..ops import rules as rules_mod
-from .miner import pair_count_fn, prune_infrequent
+from .miner import (
+    native_cpu_eligible, native_pair_counts, pair_count_fn, prune_infrequent,
+)
 from .vocab import build_baskets
 
 RESULTS_FILE = "fp_growth_experiment_results.csv"
@@ -52,6 +54,9 @@ def run_sweep(
     baskets = build_baskets(table)
     n_total = baskets.n_tracks
 
+    # resolved before the timer: may trigger the one-time native build
+    use_native = native_cpu_eligible(cfg)
+
     t0 = time.perf_counter()
     # pruning must use the SMALLEST support in the sweep to stay exact for
     # every point
@@ -62,17 +67,23 @@ def run_sweep(
         mined_baskets, _ = prune_infrequent(
             baskets, min_count_for(float(supports.min()), baskets.n_playlists)
         )
-    counts, _ = pair_count_fn(
-        mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems
-    )
-    jax.block_until_ready(counts)
+    if use_native:
+        # the miner's native CPU fallback, via its own gate + call helpers
+        counts = native_pair_counts(mined_baskets)
+        emit = rules_mod.mine_rules_from_counts_np
+    else:
+        counts, _ = pair_count_fn(
+            mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems
+        )
+        jax.block_until_ready(counts)
+        emit = rules_mod.mine_rules_from_counts
     count_s = time.perf_counter() - t0
     print(f"pair counts once: {count_s:.3f}s (shared across the sweep)")
 
     records = []
     for s in supports:
         t0 = time.perf_counter()
-        tensors = rules_mod.mine_rules_from_counts(
+        tensors = emit(
             counts,
             n_playlists=mined_baskets.n_playlists,
             min_support=float(s),
