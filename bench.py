@@ -903,6 +903,26 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             result["scale_cpu_mesh8_frequent_items"] = scale["frequent_items"]
             result["scale_cpu_mesh8_shape"] = "20000x5000"
 
+    if _remaining() > 180:
+        # half-million-playlist mine through the NATIVE fallback (Apriori
+        # prune → C++ bitpack scatter → tiled POPCNT counts): real
+        # large-scale evidence that doesn't need the chip at all
+        # --require-native: without the native library this shape would
+        # fall through to a ~25 GB dense one-hot on XLA:CPU — fail fast
+        # and keep the budget for the serving/replay phases instead
+        scale_n = _run_phase(
+            "scale-cpu-native", _SCALE_BENCH,
+            ["--playlists", "500000", "--tracks", "50000",
+             "--rows", "25000000", "--min-support", "0.002",
+             "--require-native"],
+            platform="cpu", timeout=min(600, _remaining()),
+        )
+        if scale_n is not None:
+            result["scale_cpu_native_mine_s"] = scale_n["mine_s"]
+            result["scale_cpu_native_rows_per_s"] = scale_n["rows_per_s"]
+            result["scale_cpu_native_frequent_items"] = scale_n["frequent_items"]
+            result["scale_cpu_native_shape"] = "500000x50000"
+
     if _remaining() > 120:
         _record_serving(result, npz_path, "cpu")
 

@@ -5,9 +5,10 @@ popcount kernel.
 When the backend is CPU (no TPU reachable), XLA:CPU's int8 one-hot matmul
 dominates the mining bracket; the native kernel computes the same exact
 ``XᵀX`` pair-count matrix from bit-packed rows with the POPCNT unit,
-threaded, an order of magnitude faster. Bit-packing happens host-side with
-``np.packbits`` (little bit order: bit p of row i's words ⇔ playlist p
-contains track i); zero padding contributes zero counts.
+L2-tiled, an order of magnitude faster. Bit-packing is one native scatter
+pass over the membership rows (no V×P transient, so config-4-class shapes
+fit; little bit order: bit p of row t's words ⇔ playlist p contains track
+t); zero padding contributes zero counts.
 
 Build/load follows the CSV loader's pattern (data/native.py): ``make -C
 native`` on demand, graceful fallback when the toolchain or .so is absent,
@@ -24,7 +25,7 @@ import numpy as np
 from ..utils import nativelib
 
 # must match kAbiVersion in native/kmls_popcount.cpp
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -43,6 +44,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int32,
+    ]
+    lib.kmls_bitpack_rows.restype = None
+    lib.kmls_bitpack_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64),
     ]
     return lib
 
@@ -72,14 +81,37 @@ def bitpack_rows(
 ) -> np.ndarray:
     """→ ``(n_tracks, ceil(P/64)) uint64``: bit p of row t set iff playlist
     p contains track t. Duplicate membership rows OR idempotently (same as
-    the device one-hot's scatter-max, ops/encode.py)."""
-    x = np.zeros((n_tracks, n_playlists), dtype=bool)
-    x[track_ids, playlist_rows] = True
-    packed8 = np.packbits(x, axis=1, bitorder="little")  # (V, ceil(P/8)) uint8
+    the device one-hot's scatter-max, ops/encode.py).
+
+    Packed by the native scatter — one linear pass over the rows with no
+    V×P transient, so it scales to config-4-class shapes (a numpy
+    ``packbits`` route needs the full bool matrix: 4.5 GB at a pruned
+    1M-playlist input)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native popcount unavailable (build native/ first)")
     w64 = (n_playlists + 63) // 64
-    if packed8.shape[1] < w64 * 8:
-        packed8 = np.pad(packed8, ((0, 0), (0, w64 * 8 - packed8.shape[1])))
-    return np.ascontiguousarray(packed8).view(np.uint64)
+    bt = np.zeros((n_tracks, max(w64, 1)), dtype=np.uint64)
+    rows = np.ascontiguousarray(playlist_rows, dtype=np.int64)
+    ids = np.ascontiguousarray(track_ids, dtype=np.int32)
+    if len(rows):
+        # the native scatter is unchecked — keep the bounds guard numpy's
+        # fancy indexing used to provide (an out-of-range id would be a
+        # silent out-of-bounds heap write, not an IndexError)
+        if int(rows.min()) < 0 or int(rows.max()) >= n_playlists:
+            raise ValueError(
+                f"playlist_rows out of range [0, {n_playlists})"
+            )
+        if int(ids.min()) < 0 or int(ids.max()) >= n_tracks:
+            raise ValueError(f"track_ids out of range [0, {n_tracks})")
+        lib.kmls_bitpack_rows(
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(len(rows)),
+            ctypes.c_int64(bt.shape[1]),
+            bt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+    return bt
 
 
 def pair_counts(

@@ -51,7 +51,21 @@ def main() -> int:
     )
     parser.add_argument("--k-max", type=int, default=64)
     parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument(
+        "--require-native", action="store_true",
+        help="exit 3 unless the native CPU pair-count path is available — "
+        "at large shapes the dense fallback would allocate a V x P one-hot "
+        "(tens of GB) instead of failing fast",
+    )
     args = parser.parse_args()
+
+    if args.require_native:
+        from kmlserver_tpu.ops import cpu_popcount
+
+        if not cpu_popcount.available():
+            log("native pair-count library unavailable; refusing to fall "
+                "back to the dense path at this shape (--require-native)")
+            return 3
 
     import numpy as np
 

@@ -260,6 +260,19 @@ class TestNativePopcount:
             cpu_popcount.pair_counts(
                 np.array([0]), np.array([0]), n_playlists=1, n_tracks=1)
 
+    def test_out_of_range_ids_rejected(self, cpu_popcount):
+        # the native scatter is unchecked C — the binding must reject bad
+        # ids with a clean error, not write past the allocation
+        with pytest.raises(ValueError, match="track_ids"):
+            cpu_popcount.pair_counts(
+                np.array([0]), np.array([5]), n_playlists=4, n_tracks=5)
+        with pytest.raises(ValueError, match="playlist_rows"):
+            cpu_popcount.pair_counts(
+                np.array([4]), np.array([0]), n_playlists=4, n_tracks=5)
+        with pytest.raises(ValueError, match="playlist_rows"):
+            cpu_popcount.pair_counts(
+                np.array([-1]), np.array([0]), n_playlists=4, n_tracks=5)
+
     def test_empty_vocab(self, cpu_popcount):
         out = cpu_popcount.pair_counts(
             np.empty(0, np.int64), np.empty(0, np.int64),
