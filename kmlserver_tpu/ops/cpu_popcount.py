@@ -25,7 +25,7 @@ import numpy as np
 from ..utils import nativelib
 
 # must match kAbiVersion in native/kmls_popcount.cpp
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -52,6 +52,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.kmls_pair_counts_sparse.restype = None
+    lib.kmls_pair_counts_sparse.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
     ]
     return lib
 
@@ -87,23 +96,26 @@ def bitpack_rows(
     V×P transient, so it scales to config-4-class shapes (a numpy
     ``packbits`` route needs the full bool matrix: 4.5 GB at a pruned
     1M-playlist input)."""
+    rows = np.ascontiguousarray(playlist_rows, dtype=np.int64)
+    ids = np.ascontiguousarray(track_ids, dtype=np.int32)
+    if len(rows):
+        _validate(rows, ids, n_playlists, n_tracks)
+    return _bitpack_unchecked(
+        rows, ids, n_playlists=n_playlists, n_tracks=n_tracks
+    )
+
+
+def _bitpack_unchecked(
+    rows: np.ndarray, ids: np.ndarray, *, n_playlists: int, n_tracks: int
+) -> np.ndarray:
+    """The scatter itself: contiguous int64/int32 inputs, ALREADY bounds-
+    validated by the caller (the C side is unchecked)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native popcount unavailable (build native/ first)")
     w64 = (n_playlists + 63) // 64
     bt = np.zeros((n_tracks, max(w64, 1)), dtype=np.uint64)
-    rows = np.ascontiguousarray(playlist_rows, dtype=np.int64)
-    ids = np.ascontiguousarray(track_ids, dtype=np.int32)
     if len(rows):
-        # the native scatter is unchecked — keep the bounds guard numpy's
-        # fancy indexing used to provide (an out-of-range id would be a
-        # silent out-of-bounds heap write, not an IndexError)
-        if int(rows.min()) < 0 or int(rows.max()) >= n_playlists:
-            raise ValueError(
-                f"playlist_rows out of range [0, {n_playlists})"
-            )
-        if int(ids.min()) < 0 or int(ids.max()) >= n_tracks:
-            raise ValueError(f"track_ids out of range [0, {n_tracks})")
         lib.kmls_bitpack_rows(
             rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -114,6 +126,51 @@ def bitpack_rows(
     return bt
 
 
+def _validate(
+    rows: np.ndarray, ids: np.ndarray, n_playlists: int, n_tracks: int
+) -> None:
+    """Bounds guard the unchecked C kernels (an out-of-range id would be a
+    silent out-of-bounds heap write, not an IndexError)."""
+    if int(rows.min()) < 0 or int(rows.max()) >= n_playlists:
+        raise ValueError(f"playlist_rows out of range [0, {n_playlists})")
+    if int(ids.min()) < 0 or int(ids.max()) >= n_tracks:
+        raise ValueError(f"track_ids out of range [0, {n_tracks})")
+
+
+def _effective_threads() -> int:
+    """Threads the bitset kernel will actually use (the sparse kernel is
+    single-threaded — its scatter targets collide across playlists)."""
+    env = int(os.environ.get("KMLS_NATIVE_THREADS", "0"))
+    if env > 0:
+        return env
+    try:
+        return min(len(os.sched_getaffinity(0)), 16)
+    except AttributeError:  # non-linux
+        return min(os.cpu_count() or 4, 16)
+
+
+def choose_method(
+    playlist_rows: np.ndarray, *, n_playlists: int, n_tracks: int
+) -> str:
+    """Cost-model dispatch between the two exact counters.
+
+    bitset cost ≈ V²/2 · ceil(P/64) sequential popcnt word-ops, divided
+    across its threads; sparse cost ≈ Σ_p C(k_p, 2) random scatter-adds
+    (+ one counting-sort pass + the V²/2 mirror/memset), single-threaded.
+    A scatter-add is ~8× a word-op (random writes into the (V, V) matrix
+    vs streamed AND+POPCNT — calibrated on this class of hardware), so
+    compare word-op-equivalents. Dense-ish small inputs (ds2) still pick
+    bitset; huge sparse inputs (config 4) avoid the V²·W scan entirely."""
+    k = np.bincount(playlist_rows, minlength=n_playlists)
+    pair_mass = float((k.astype(np.float64) * (k - 1)).sum() / 2.0)
+    half_matrix = n_tracks * float(n_tracks) / 2.0
+    sparse_cost = 8.0 * pair_mass + 2.0 * len(playlist_rows) + half_matrix
+    bitset_cost = (
+        half_matrix * ((n_playlists + 63) // 64) / _effective_threads()
+    )
+    return "sparse" if sparse_cost < bitset_cost else "bitset"
+
+
 def pair_counts(
     playlist_rows: np.ndarray,
     track_ids: np.ndarray,
@@ -121,8 +178,15 @@ def pair_counts(
     n_playlists: int,
     n_tracks: int,
     n_threads: int | None = None,
+    method: str = "auto",
 ) -> np.ndarray:
-    """Exact ``XᵀX`` pair-count matrix (V, V) int32 via the native kernel.
+    """Exact ``XᵀX`` pair-count matrix (V, V) int32 via the native kernels.
+
+    ``method``: "auto" (cost model, default), "bitset", or "sparse" —
+    identical results, different asymptotics (see :func:`choose_method`).
+    Env override ``KMLS_NATIVE_PAIR_METHOD`` beats "auto". PRECONDITION:
+    (playlist, track) pairs deduplicated — the Baskets contract — or the
+    sparse path double-counts where the bitset path ORs idempotently.
 
     Raises RuntimeError when the native library is unavailable — callers
     gate on :func:`available` and use the XLA path otherwise."""
@@ -131,13 +195,34 @@ def pair_counts(
         raise RuntimeError("native popcount unavailable (build native/ first)")
     if n_threads is None:
         n_threads = int(os.environ.get("KMLS_NATIVE_THREADS", "0"))
-    bt = bitpack_rows(
-        playlist_rows, track_ids,
-        n_playlists=n_playlists, n_tracks=n_tracks,
-    )
-    out = np.empty((n_tracks, n_tracks), dtype=np.int32)
-    if n_tracks == 0:
+    if n_tracks == 0 or len(playlist_rows) == 0:
+        return np.zeros((n_tracks, n_tracks), dtype=np.int32)
+    rows = np.ascontiguousarray(playlist_rows, dtype=np.int64)
+    ids = np.ascontiguousarray(track_ids, dtype=np.int32)
+    _validate(rows, ids, n_playlists, n_tracks)
+    if method == "auto":
+        method = os.environ.get("KMLS_NATIVE_PAIR_METHOD", "auto")
+    if method == "auto":
+        method = choose_method(
+            rows, n_playlists=n_playlists, n_tracks=n_tracks
+        )
+    if method == "sparse":
+        out = np.zeros((n_tracks, n_tracks), dtype=np.int32)  # C side adds
+        lib.kmls_pair_counts_sparse(
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(len(rows)),
+            ctypes.c_int64(n_playlists),
+            ctypes.c_int32(n_tracks),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
         return out
+    if method != "bitset":
+        raise ValueError(f"method must be auto|bitset|sparse, got {method!r}")
+    out = np.empty((n_tracks, n_tracks), dtype=np.int32)  # C side fully writes
+    bt = _bitpack_unchecked(
+        rows, ids, n_playlists=n_playlists, n_tracks=n_tracks
+    )
     lib.kmls_pair_counts(
         bt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         ctypes.c_int32(n_tracks),

@@ -1,21 +1,27 @@
-// kmls_popcount — native CPU pair-support counter over bit-packed baskets.
+// kmls_popcount — native CPU pair-support counters.
 //
 // The CPU-fallback analogue of the Pallas popcount kernel
 // (kmlserver_tpu/ops/popcount.py): when no TPU is reachable, the mining
 // bracket otherwise spends ~75% of its time in XLA:CPU's int8 one-hot
-// matmul. Bit-packing the playlist axis and counting pair supports with
-// the POPCNT unit does the same exact computation an order of magnitude
-// faster:
+// matmul. Two exact strategies, both producing the XᵀX matrix of
+// ops/support.py pair_counts (symmetric int32, singleton supports on the
+// diagonal); the Python binding picks by cost model:
 //
-//     C[i][j] = sum_w popcount(bt[i][w] & bt[j][w])
+//  - BITSET: C[i][j] = sum_w popcount(bt[i][w] & bt[j][w]) over row-major
+//    bitsets bt (v rows, w64 uint64 words per row), i-rows tiled into L2.
+//    Cost ~ v²/2 · w64 word-ops regardless of density — wins when the
+//    matrix is small or dense.
+//  - SPARSE: group memberships by playlist (counting sort), then for each
+//    playlist scatter-add every unordered track pair. Cost ~
+//    sum_p C(k_p, 2) scatter-adds — wins at large, sparse shapes (a 10M ×
+//    1M-input's bitset scan is ~5·10¹² word-ops; its pair mass is ~10¹⁰).
 //
-// over row-major bitsets bt (v rows, w64 uint64 words per row); C is
-// symmetric with singleton supports on the diagonal, exactly the XᵀX
-// matrix of ops/support.py pair_counts (int32).
-//
-// Threaded with a strided row partition (row i costs v-i pair loops, so
-// contiguous blocks would load-imbalance). C ABI only, consumed via
-// ctypes; the caller owns all buffers.
+// Threaded with a strided partition (bitset path only; the sparse
+// scatter's writes collide across playlists). C ABI only, consumed via
+// ctypes; the caller owns all buffers. PRECONDITION (both): (playlist,
+// track) pairs are deduplicated — the Baskets contract
+// (kmlserver_tpu/mining/vocab.py build_baskets) — matching the one-hot
+// encoder's boolean set semantics; a duplicate row would double-count.
 
 #include <cstdint>
 #include <thread>
@@ -23,7 +29,7 @@
 
 namespace {
 
-constexpr int32_t kAbiVersion = 2;
+constexpr int32_t kAbiVersion = 3;
 
 // Rows per i-block: IB rows stay L2-resident while each j-row streams
 // through ONCE per block, cutting DRAM traffic from V²·row_bytes to
@@ -79,6 +85,48 @@ void kmls_bitpack_rows(const int64_t* playlist_rows, const int32_t* track_ids,
   for (int64_t r = 0; r < n_rows; ++r) {
     bt[static_cast<int64_t>(track_ids[r]) * w64 + (playlist_rows[r] >> 6)] |=
         1ull << (playlist_rows[r] & 63);
+  }
+}
+
+// SPARSE pair counting: counting-sort memberships by playlist, then for
+// each playlist scatter-add all C(k, 2) unordered track pairs into the
+// upper triangle, finally mirror. out: (v, v) int32, caller-zeroed.
+// Single-threaded: scatter targets collide across playlists.
+void kmls_pair_counts_sparse(const int64_t* playlist_rows,
+                             const int32_t* track_ids, int64_t n_rows,
+                             int64_t n_playlists, int32_t v, int32_t* out) {
+  if (v <= 0 || n_rows <= 0) return;
+  // counting sort by playlist (rows arrive in arbitrary order)
+  std::vector<int64_t> offs(n_playlists + 1, 0);
+  for (int64_t r = 0; r < n_rows; ++r) offs[playlist_rows[r] + 1]++;
+  for (int64_t p = 0; p < n_playlists; ++p) offs[p + 1] += offs[p];
+  std::vector<int32_t> grouped(n_rows);
+  {
+    std::vector<int64_t> cursor(offs.begin(), offs.end() - 1);
+    for (int64_t r = 0; r < n_rows; ++r)
+      grouped[cursor[playlist_rows[r]]++] = track_ids[r];
+  }
+  for (int64_t p = 0; p < n_playlists; ++p) {
+    const int32_t* t = grouped.data() + offs[p];
+    const int64_t k = offs[p + 1] - offs[p];
+    for (int64_t a = 0; a < k; ++a) {
+      const int32_t ta = t[a];
+      out[static_cast<int64_t>(ta) * v + ta] += 1;  // singleton support
+      for (int64_t b = a + 1; b < k; ++b) {
+        const int32_t tb = t[b];
+        if (ta < tb) {
+          out[static_cast<int64_t>(ta) * v + tb] += 1;
+        } else {
+          out[static_cast<int64_t>(tb) * v + ta] += 1;
+        }
+      }
+    }
+  }
+  for (int32_t i = 0; i < v; ++i) {
+    for (int32_t j = i + 1; j < v; ++j) {
+      out[static_cast<int64_t>(j) * v + i] =
+          out[static_cast<int64_t>(i) * v + j];
+    }
   }
 }
 

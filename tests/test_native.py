@@ -232,11 +232,23 @@ class TestNativePopcount:
         for trial, (p, v) in enumerate([(70, 20), (129, 65), (64, 3)]):
             rows = rng.integers(0, p, size=400 + trial)
             ids = rng.integers(0, v, size=400 + trial)
+            # the documented precondition (Baskets contract): pairs deduped
+            key = np.unique(rows.astype(np.int64) * v + ids)
+            rows, ids = key // v, (key % v).astype(np.int32)
             counts = cpu_popcount.pair_counts(
                 rows, ids, n_playlists=p, n_tracks=v)
             x = np.zeros((p, v), np.int64)
-            x[rows, ids] = 1  # duplicate memberships counted once
+            x[rows, ids] = 1
             np.testing.assert_array_equal(counts, (x.T @ x).astype(np.int32))
+
+    def test_bitset_method_tolerates_duplicates(self, rng, cpu_popcount):
+        # the bitset path ORs idempotently — duplicates counted once (the
+        # sparse path requires the Baskets dedup contract instead)
+        rows = np.array([0, 0, 1, 1, 1])
+        ids = np.array([2, 2, 0, 0, 2])
+        counts = cpu_popcount.pair_counts(
+            rows, ids, n_playlists=2, n_tracks=3, method="bitset")
+        assert counts[2, 2] == 2 and counts[0, 0] == 1 and counts[0, 2] == 1
 
     def test_bitpack_rows_little_bit_order(self, cpu_popcount):
         # track 0 in playlists {0, 64}: bit 0 of word 0 and bit 0 of word 1
@@ -259,6 +271,32 @@ class TestNativePopcount:
         with pytest.raises(RuntimeError):
             cpu_popcount.pair_counts(
                 np.array([0]), np.array([0]), n_playlists=1, n_tracks=1)
+
+    def test_sparse_and_bitset_match_oracle(self, rng, cpu_popcount):
+        for trial, (p, v) in enumerate([(70, 20), (129, 65), (512, 40)]):
+            rows = rng.integers(0, p, size=500 + trial)
+            ids = rng.integers(0, v, size=500 + trial)
+            # dedup: the Baskets contract both kernels assume
+            key = rows.astype(np.int64) * v + ids
+            key = np.unique(key)
+            rows, ids = key // v, (key % v).astype(np.int32)
+            x = np.zeros((p, v), np.int64)
+            x[rows, ids] = 1
+            expected = (x.T @ x).astype(np.int32)
+            kw = dict(n_playlists=p, n_tracks=v)
+            for method in ("bitset", "sparse", "auto"):
+                got = cpu_popcount.pair_counts(rows, ids, method=method, **kw)
+                np.testing.assert_array_equal(got, expected, err_msg=method)
+
+    def test_choose_method_asymptotics(self, cpu_popcount):
+        # huge sparse shape → sparse; small dense shape → whichever the
+        # model picks must at least flip between regimes
+        sparse_rows = np.arange(100_000, dtype=np.int64) % 100_000
+        assert cpu_popcount.choose_method(
+            sparse_rows, n_playlists=100_000, n_tracks=50_000) == "sparse"
+        dense_rows = np.repeat(np.arange(64, dtype=np.int64), 64)
+        assert cpu_popcount.choose_method(
+            dense_rows, n_playlists=64, n_tracks=64) == "bitset"
 
     def test_out_of_range_ids_rejected(self, cpu_popcount):
         # the native scatter is unchecked C — the binding must reject bad
