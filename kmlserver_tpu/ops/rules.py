@@ -323,19 +323,36 @@ def emit_rule_tensors_np(
     index, like lax.top_k) via a composite integer key ``score·V + (V-1-j)``
     that is strictly totally ordered, so partition/sort order is unique."""
     v = pair_count_matrix.shape[0]
-    counts = pair_count_matrix.astype(np.int64, copy=False)
+    # int32 end to end when the key range fits (counts ≤ P make this the
+    # common case): the (V, V) passes are memory-bound, and int64
+    # intermediates double every one of them. The bound uses the
+    # OFF-diagonal max — the diagonal holds item supports, which dominate
+    # pair counts and never enter the score, so including them would flip
+    # to int64 needlessly (diagonal zeroed in place and restored: one O(V)
+    # touch instead of a (V, V) masked copy).
+    if pair_count_matrix.flags.writeable:
+        diag_save = np.diagonal(pair_count_matrix).copy()
+        np.fill_diagonal(pair_count_matrix, 0)
+        try:
+            max_count = int(pair_count_matrix.max(initial=0))
+        finally:
+            np.fill_diagonal(pair_count_matrix, diag_save)
+    else:  # read-only input (e.g. a jax-backed view): masked copy instead
+        masked = pair_count_matrix.copy()
+        np.fill_diagonal(masked, 0)
+        max_count = int(masked.max(initial=0))
+        del masked
+    key_dtype = (
+        np.int32
+        if (max_count + 1) * v < np.iinfo(np.int32).max
+        else np.int64
+    )
+    counts = pair_count_matrix.astype(key_dtype, copy=False)
     valid = counts >= min_count
     np.fill_diagonal(valid, False)
     row_valid_counts = valid.sum(axis=1, dtype=np.int32)
-    score = np.where(valid, counts, -1)
-    # int32 keys when the range fits (counts ≤ P make this the common
-    # case): argpartition over the (V, V) key matrix is memory-bound
-    key_dtype = (
-        np.int32
-        if (int(score.max(initial=0)) + 1) * v < np.iinfo(np.int32).max
-        else np.int64
-    )
-    key = score.astype(key_dtype) * key_dtype(v) + (
+    score = np.where(valid, counts, key_dtype(-1))
+    key = score * key_dtype(v) + (
         v - 1 - np.arange(v, dtype=key_dtype)[None, :]
     )
     k = min(k_max, v)
