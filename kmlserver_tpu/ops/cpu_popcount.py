@@ -25,7 +25,7 @@ import numpy as np
 from ..utils import nativelib
 
 # must match kAbiVersion in native/kmls_popcount.cpp
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -60,6 +60,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_int64,
         ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.kmls_emit_topk.restype = None
+    lib.kmls_emit_topk.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32),
     ]
     return lib
@@ -124,6 +134,41 @@ def _bitpack_unchecked(
             bt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         )
     return bt
+
+
+def emit_topk(
+    counts: np.ndarray, min_count: int, *, k_max: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Native rule emission: per-row top-k by (count desc, column asc) —
+    lax.top_k's exact tie order — padded to ``k_max``. Same outputs as
+    ``ops.rules.emit_rule_tensors_np`` (which stays as the fallback and
+    the cross-check twin).
+
+    Raises RuntimeError when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native popcount unavailable (build native/ first)")
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    v = counts.shape[0]
+    k = min(k_max, v)
+    rule_ids = np.empty((v, max(k, 0)), dtype=np.int32)
+    rule_counts = np.empty((v, max(k, 0)), dtype=np.int32)
+    row_valid = np.empty(v, dtype=np.int32)
+    if v:
+        lib.kmls_emit_topk(
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(v),
+            ctypes.c_int32(min_count),
+            ctypes.c_int32(k),
+            rule_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rule_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            row_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+    if k < k_max:  # pad up to the declared row capacity
+        pad = ((0, 0), (0, k_max - k))
+        rule_ids = np.pad(rule_ids, pad, constant_values=-1)
+        rule_counts = np.pad(rule_counts, pad)
+    return rule_ids, rule_counts, row_valid
 
 
 def _validate(

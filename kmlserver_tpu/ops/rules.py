@@ -385,11 +385,26 @@ def mine_rules_from_counts_np(
     n_total_songs: int | None = None,
 ) -> RuleTensors:
     """Host-only emission from a host count matrix (the native-CPU path):
-    no device round trip anywhere."""
+    no device round trip anywhere. Prefers the native C++ top-k (a bounded
+    per-row heap, ~5 ms at ds2 shape vs ~82 ms for the numpy argpartition
+    route); :func:`emit_rule_tensors_np` remains the fallback and the
+    cross-check twin — all three emitters are pinned identical by test."""
     min_count = min_count_for(min_support, n_playlists)
-    rule_ids, rule_counts, row_valid = emit_rule_tensors_np(
-        pair_count_matrix, min_count, k_max=k_max
-    )
+    emitted = None
+    from . import cpu_popcount
+
+    if cpu_popcount.available():
+        try:
+            emitted = cpu_popcount.emit_topk(
+                pair_count_matrix, min_count, k_max=k_max
+            )
+        except RuntimeError:
+            emitted = None
+    if emitted is None:
+        emitted = emit_rule_tensors_np(
+            pair_count_matrix, min_count, k_max=k_max
+        )
+    rule_ids, rule_counts, row_valid = emitted
     return assemble_rule_tensors(
         rule_ids, rule_counts, row_valid,
         np.diagonal(pair_count_matrix).astype(np.int32, copy=True),

@@ -23,13 +23,14 @@
 // (kmlserver_tpu/mining/vocab.py build_baskets) — matching the one-hot
 // encoder's boolean set semantics; a duplicate row would double-count.
 
+#include <algorithm>
 #include <cstdint>
 #include <thread>
 #include <vector>
 
 namespace {
 
-constexpr int32_t kAbiVersion = 3;
+constexpr int32_t kAbiVersion = 4;
 
 // Rows per i-block: IB rows stay L2-resident while each j-row streams
 // through ONCE per block, cutting DRAM traffic from V²·row_bytes to
@@ -126,6 +127,63 @@ void kmls_pair_counts_sparse(const int64_t* playlist_rows,
     for (int32_t j = i + 1; j < v; ++j) {
       out[static_cast<int64_t>(j) * v + i] =
           out[static_cast<int64_t>(i) * v + j];
+    }
+  }
+}
+
+// Rule emission: per-row top-k of the count matrix by (count desc, column
+// asc) — EXACTLY lax.top_k's tie order (ops/rules.py emit_rule_tensors) —
+// over valid entries (off-diagonal, count >= min_count). For each row:
+// out_ids (v, k) int32 consequent columns (-1 padded), out_counts (v, k)
+// int32 (0 padded), out_row_valid (v) int32 = TRUE valid count (may
+// exceed k; truncation-overflow detection happens in Python). A bounded
+// ascending scan with a composite int64 key (count·v + (v-1-j), strictly
+// totally ordered) and a min-heap of size k replaces a (V, V) numpy
+// argpartition pass (~82 ms -> ~5 ms at ds2 shape).
+void kmls_emit_topk(const int32_t* counts, int32_t v, int32_t min_count,
+                    int32_t k, int32_t* out_ids, int32_t* out_counts,
+                    int32_t* out_row_valid) {
+  std::vector<int64_t> heap;  // min-heap on the composite key
+  heap.reserve(k > 0 ? k : 1);
+  const auto key_of = [v](int32_t count, int32_t j) {
+    return static_cast<int64_t>(count) * v + (v - 1 - j);
+  };
+  for (int32_t i = 0; i < v; ++i) {
+    const int32_t* row = counts + static_cast<int64_t>(i) * v;
+    heap.clear();
+    int32_t n_valid = 0;
+    for (int32_t j = 0; j < v; ++j) {
+      const int32_t c = row[j];
+      if (c < min_count || j == i) continue;
+      ++n_valid;
+      // the twins drop count-0 entries even when min_count <= 0
+      // (emit_rule_tensors' `keep = top_counts > 0`) — match exactly
+      if (k <= 0 || c <= 0) continue;
+      const int64_t key = key_of(c, j);
+      if (static_cast<int32_t>(heap.size()) < k) {
+        heap.push_back(key);
+        std::push_heap(heap.begin(), heap.end(), std::greater<int64_t>());
+      } else if (key > heap.front()) {
+        std::pop_heap(heap.begin(), heap.end(), std::greater<int64_t>());
+        heap.back() = key;
+        std::push_heap(heap.begin(), heap.end(), std::greater<int64_t>());
+      }
+    }
+    out_row_valid[i] = n_valid;
+    // sort_heap with greater<> leaves the keys in DESCENDING order —
+    // exactly the emit order (highest count first, ties by smaller j)
+    std::sort_heap(heap.begin(), heap.end(), std::greater<int64_t>());
+    int32_t* ids_row = out_ids + static_cast<int64_t>(i) * k;
+    int32_t* cnt_row = out_counts + static_cast<int64_t>(i) * k;
+    const int32_t filled = static_cast<int32_t>(heap.size());
+    for (int32_t s = 0; s < filled; ++s) {
+      const int64_t key = heap[s];
+      ids_row[s] = static_cast<int32_t>(v - 1 - (key % v));
+      cnt_row[s] = static_cast<int32_t>(key / v);
+    }
+    for (int32_t s = filled; s < k; ++s) {
+      ids_row[s] = -1;
+      cnt_row[s] = 0;
     }
   }
 }

@@ -192,10 +192,13 @@ class TestRuleEmission:
                 assert other.tensors.overflow_rows == staged.tensors.overflow_rows
                 assert other.tensors.n_songs_missing == staged.tensors.n_songs_missing
 
-    def test_numpy_emission_matches_jit_including_ties(self, rng):
-        """emit_rule_tensors_np must replicate lax.top_k's tie semantics
-        (equal counts rank by ascending index) bit-for-bit — tie-heavy
-        matrices are the adversarial case for the composite-key trick."""
+    def test_all_emitters_match_jit_including_ties(self, rng):
+        """emit_rule_tensors_np AND the native C++ top-k must replicate
+        lax.top_k's tie semantics (equal counts rank by ascending index)
+        bit-for-bit — tie-heavy matrices are the adversarial case for the
+        composite-key trick on both."""
+        from kmlserver_tpu.ops import cpu_popcount
+
         for trial in range(4):
             v = [7, 32, 65, 129][trial]
             # few distinct values → many ties within every row
@@ -203,15 +206,18 @@ class TestRuleEmission:
             m = m + m.T  # symmetric like a real count matrix
             np.fill_diagonal(m, rng.integers(1, 9, size=v).astype(np.int32))
             for k_max in (3, v, v + 10):
-                jit_ids, jit_counts, jit_valid = (
+                expected = tuple(
                     np.asarray(a) for a in rules.emit_rule_tensors(
                         jnp.asarray(m), jnp.int32(2), k_max=k_max)
                 )
-                np_ids, np_counts, np_valid = rules.emit_rule_tensors_np(
-                    m, 2, k_max=k_max)
-                np.testing.assert_array_equal(np_ids, jit_ids)
-                np.testing.assert_array_equal(np_counts, jit_counts)
-                np.testing.assert_array_equal(np_valid, jit_valid)
+                emitters = {"numpy": rules.emit_rule_tensors_np(m, 2, k_max=k_max)}
+                if cpu_popcount.available():
+                    emitters["native"] = cpu_popcount.emit_topk(m, 2, k_max=k_max)
+                for name, got in emitters.items():
+                    for got_a, exp_a in zip(got, expected):
+                        np.testing.assert_array_equal(
+                            got_a, exp_a, err_msg=f"{name} k_max={k_max} v={v}"
+                        )
 
     def test_missing_songs_counter(self, rng):
         baskets = random_baskets(rng, n_playlists=50, n_tracks=14, mean_len=4)
