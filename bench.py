@@ -791,11 +791,22 @@ def _mfu_keys(mining: dict, prefix: str = "mining") -> dict:
     return out
 
 
-def run_mining(platform: str, npz_path: str) -> dict | None:
+def run_mining(
+    platform: str,
+    npz_path: str,
+    attempts: int | None = None,
+    timeout: float | None = None,
+) -> dict | None:
+    """The headline phase keeps a 300 s floor even near the deadline (a
+    bench with no mining number is worthless); OPTIONAL callers must pass
+    a deadline-respecting timeout instead."""
     mining = _run_phase(
         "mining", _MINING_BENCH, [npz_path, str(MIN_SUPPORT), str(REPEATS)],
-        platform=platform, attempts=3 if platform == "tpu" else 2,
-        timeout=min(1800, max(_remaining(), 300)),
+        platform=platform,
+        attempts=attempts if attempts is not None
+        else (3 if platform == "tpu" else 2),
+        timeout=timeout if timeout is not None
+        else min(1800, max(_remaining(), 300)),
     )
     return mining
 
@@ -1007,6 +1018,16 @@ def main() -> int:
                 )
                 platform = "cpu"
                 mining = cpu_mining = run_cpu_suite(result, f.name)
+            elif _remaining() > 180:
+                # cheap CPU comparison point (native POPCNT path) so every
+                # TPU artifact also carries the no-accelerator number —
+                # optional, so its timeout respects the deadline (the
+                # already-measured TPU headline must not be lost to a
+                # harness kill past DEADLINE_S)
+                cpu_mining = run_mining(
+                    "cpu", f.name, attempts=1,
+                    timeout=min(600, max(_remaining() - 30, 60)),
+                )
         else:
             # CPU evidence first, re-probing the pool in the background the
             # whole time; if the pool comes back, the TPU suite runs too.
@@ -1073,9 +1094,18 @@ def main() -> int:
     line.update(_mfu_keys(mining))
     if cpu_mining is not None and cpu_mining is not mining:
         # the TPU suite took over the headline; keep the CPU evidence too,
-        # under unambiguous keys
+        # under unambiguous keys. Through this environment's tunnel the
+        # TPU bracket pays ~2 host<->device round trips, so the native CPU
+        # path can be FASTER — surface the best measured number explicitly
+        # rather than burying it.
         line["mining_cpu_s"] = round(cpu_mining["median_s"], 4)
         line.update(_mfu_keys(cpu_mining, prefix="mining_cpu"))
+        best_s = min(median_s, cpu_mining["median_s"])
+        line["best_mining_s"] = round(best_s, 4)
+        line["best_mining_platform"] = (
+            "tpu" if best_s == median_s else "cpu"
+        )
+        line["vs_baseline_best"] = round(BASELINE_RULE_GEN_S / best_s, 1)
     line.update(result)
     line["probe_history"] = prober.history_snapshot()
     print(json.dumps(line))
