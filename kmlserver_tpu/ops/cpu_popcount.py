@@ -1,18 +1,24 @@
-"""ctypes bindings for the native CPU pair-support counter
-(native/kmls_popcount.cpp) — the CPU-fallback analogue of the Pallas
-popcount kernel.
+"""ctypes bindings for the native CPU mining kernels
+(native/kmls_popcount.cpp) — the CPU-fallback analogue of the device
+compute path.
 
 When the backend is CPU (no TPU reachable), XLA:CPU's int8 one-hot matmul
-dominates the mining bracket; the native kernel computes the same exact
-``XᵀX`` pair-count matrix from bit-packed rows with the POPCNT unit,
-L2-tiled, an order of magnitude faster. Bit-packing is one native scatter
-pass over the membership rows (no V×P transient, so config-4-class shapes
-fit; little bit order: bit p of row t's words ⇔ playlist p contains track
-t); zero padding contributes zero counts.
+and top_k dominate the mining bracket; the native kernels do the same
+exact work an order of magnitude faster:
 
-Build/load follows the CSV loader's pattern (data/native.py): ``make -C
-native`` on demand, graceful fallback when the toolchain or .so is absent,
-``KMLS_NATIVE=0`` kills all native paths.
+- :func:`pair_counts` — the ``XᵀX`` pair-count matrix, by either an
+  L2-tiled POPCNT scan over bit-packed rows or a sparse per-playlist pair
+  scatter whose cost is the pair mass Σ_p C(k_p, 2); a cost model picks
+  (:func:`choose_method`).
+- :func:`bitpack_rows` — one scatter pass over the membership rows, no
+  V×P transient (little bit order: bit p of row t's words ⇔ playlist p
+  contains track t; zero padding contributes zero counts).
+- :func:`emit_topk` — per-row rule emission with lax.top_k's exact tie
+  order via a bounded min-heap.
+
+Build/load follows the CSV loader's pattern (data/native.py, shared
+``utils.nativelib``): ``make -C native`` on demand, graceful fallback when
+the toolchain or .so is absent, ``KMLS_NATIVE=0`` kills all native paths.
 """
 
 from __future__ import annotations
