@@ -62,6 +62,15 @@ class TestConfig:
         assert cfg.k_best_tracks == 7
         assert cfg.polling_wait_in_minutes == 1.0
 
+    def test_tpu_rebuild_knob_env_contract(self, monkeypatch):
+        # the KMLS_* knobs added by the rebuild must parse from env too
+        monkeypatch.setenv("KMLS_NATIVE_PAIR_COUNTS", "0")
+        mining = MiningConfig.from_env(dotenv_path=None)
+        assert mining.native_cpu_pair_counts is False
+        monkeypatch.setenv("KMLS_BATCH_MAX_INFLIGHT", "2")
+        serving = ServingConfig.from_env(dotenv_path=None)
+        assert serving.batch_max_inflight == 2
+
 
 class TestArtifacts:
     def test_pickle_roundtrip(self, tmp_path):
