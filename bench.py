@@ -303,18 +303,57 @@ for _ in range(20):
     support.pair_counts(x).block_until_ready()
     mm.append(time.perf_counter() - t0)
 matmul_s = statistics.median(mm)
-# amortized: dispatch a pipeline of async calls, block once at the end.
-# Per-blocked-call timing is floored by the host<->device round trip
-# (~65ms through this environment's remote-TPU tunnel) — the pipelined
-# rate is the device's actual throughput, and the honest MFU numerator.
-N_AMORT = 50 if dev.platform == "tpu" else 10  # CPU: ~1s/call, cap the cost
-t0 = time.perf_counter()
-rs = [support.pair_counts(x) for _ in range(N_AMORT)]
-jax.block_until_ready(rs)
-matmul_amortized_s = (time.perf_counter() - t0) / N_AMORT
-print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call blocked, "
-      f"{matmul_amortized_s * 1e3:.3f}ms amortized over {N_AMORT}",
-      file=sys.stderr, flush=True)
+# Device-resident chained timing — the honest MFU numerator. N matmuls run
+# inside ONE compiled scan, each iteration data-dependent on the last
+# (min(counts[0,0], 0) is always 0 at runtime but not provably so at
+# compile time, so XLA can neither fold the chain nor overlap iterations),
+# and the fetched scalar sums the carry so the host read cannot complete
+# before all N iterations have. Timing the scan at two lengths and taking
+# the slope cancels the tunnel round trip, dispatch cost, and async-ack
+# artifacts that pollute per-call timing through this environment's
+# remote-TPU tunnel (r03 preview: 50 overlapping dispatches "measured"
+# 177% MFU — physically impossible; per-blocked-call timing is floored by
+# the ~65ms round trip instead).
+if dev.platform == "tpu":
+    @partial(jax.jit, static_argnames=("n",))
+    def _chained(x0, n):
+        def step(carry, _):
+            counts = support.pair_counts(carry)
+            bump = jnp.minimum(counts[0, 0], 0).astype(carry.dtype)
+            return carry + bump, ()
+        out, _ = jax.lax.scan(step, x0, None, length=n)
+        return jnp.sum(out, dtype=jnp.int32)
+
+    def _timed_chain(n):
+        float(jax.device_get(_chained(x, n)))  # compile + warm
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(jax.device_get(_chained(x, n)))
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    N1, N2 = 16, 1016
+    t_short, t_long = _timed_chain(N1), _timed_chain(N2)
+    slope = (t_long - t_short) / (N2 - N1)
+    # noise guard: a non-positive slope means the two timings were
+    # indistinguishable — fall back to the blocked per-call median
+    matmul_amortized_s = slope if slope > 0 else matmul_s
+    print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call "
+          f"blocked, {matmul_amortized_s * 1e3:.3f}ms/iter from the "
+          f"{N2}-vs-{N1} chained-scan slope",
+          file=sys.stderr, flush=True)
+else:
+    # CPU: per-call cost (~1s) dwarfs dispatch overhead; a short async
+    # pipeline amortizes what little there is without chained compiles
+    N_AMORT = 10
+    t0 = time.perf_counter()
+    rs = [support.pair_counts(x) for _ in range(N_AMORT)]
+    jax.block_until_ready(rs)
+    matmul_amortized_s = (time.perf_counter() - t0) / N_AMORT
+    print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call "
+          f"blocked, {matmul_amortized_s * 1e3:.3f}ms amortized over "
+          f"{N_AMORT}", file=sys.stderr, flush=True)
 
 np.savez(out_npz, rule_ids=result.tensors.rule_ids,
          rule_confs=result.tensors.rule_confs)

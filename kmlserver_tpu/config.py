@@ -36,6 +36,20 @@ def _getenv_bool(name: str, default: bool) -> bool:
     return raw.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _getenv_bitpack_threshold() -> int | str | None:
+    """``KMLS_BITPACK_THRESHOLD_ELEMS``: "auto" (HBM-fit dispatch, the
+    default), "none"/"never" (dense always), or an explicit element count."""
+    raw = os.getenv("KMLS_BITPACK_THRESHOLD_ELEMS")
+    if raw in (None, ""):
+        return "auto"
+    word = raw.strip().lower()
+    if word == "auto":
+        return "auto"
+    if word in ("none", "never"):
+        return None
+    return int(raw)
+
+
 # Columns dropped from the raw CSV before any processing
 # (reference: machine-learning/main.py:42).
 DROP_COLUMNS = ("duration_ms",)
@@ -85,10 +99,20 @@ class MiningConfig:
     # pinned to intra-host devices). "auto" picks hybrid automatically when
     # the multi-host runtime is active (KMLS_COORDINATOR_ADDRESS set).
     mesh_shape: str = "auto"
-    # Use the bit-packed popcount path instead of int8 matmul when the
-    # one-hot matrix would exceed this many elements (single-device AND
-    # sharded: over a mesh this selects the dp-sharded popcount slabs).
-    bitpack_threshold_elems: int = 1 << 28
+    # When to use the bit-packed popcount path instead of the dense int8
+    # MXU matmul (single-device AND sharded: over a mesh this selects the
+    # dp-sharded popcount slabs). "auto" (default) dispatches on estimated
+    # HBM footprint: dense whenever the pruned one-hot + count matrix fit
+    # ``hbm_budget_bytes`` — the MXU matmul beats the VPU popcount kernel
+    # by an order of magnitude whenever it fits, so element count alone is
+    # the wrong dispatch key (r03: 1M×100k pruned to 5k items is 5 GiB
+    # dense — easily resident — yet an element threshold routed it to the
+    # slow kernel). An int forces the old explicit element threshold;
+    # None disables bitpack entirely.
+    bitpack_threshold_elems: int | str | None = "auto"
+    # HBM the mining job may plan against for the auto dispatch. Default
+    # leaves ~4 GiB of a v5e's 16 GiB for XLA workspace/fusion copies.
+    hbm_budget_bytes: int = 12 * (1 << 30)
     # Sharded dense pair-count implementation: "gspmd" (annotate + let XLA
     # partition), "allgather" (explicit shard_map), "ring" (ppermute
     # neighbor exchange; lowest peak memory).
@@ -135,7 +159,8 @@ class MiningConfig:
             confidence_mode=os.getenv("KMLS_CONFIDENCE_MODE", "support"),
             min_confidence=_getenv_float("KMLS_MIN_CONFIDENCE", 0.04),
             mesh_shape=os.getenv("KMLS_MESH_SHAPE", "auto"),
-            bitpack_threshold_elems=_getenv_int("KMLS_BITPACK_THRESHOLD_ELEMS", 1 << 28),
+            bitpack_threshold_elems=_getenv_bitpack_threshold(),
+            hbm_budget_bytes=_getenv_int("KMLS_HBM_BUDGET_BYTES", 12 * (1 << 30)),
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
             prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 4096),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),

@@ -15,9 +15,12 @@ Config wiring:
   to length 4; ≥ 5 is reported as not enumerated rather than silently
   ignored), and in confidence mode merges the multi-antecedent rules those
   itemsets imply (see ops/rules.py merge_confidence_contributions).
-- ``cfg.bitpack_threshold_elems``: above this one-hot element count the
-  bit-packed Pallas popcount path (ops/popcount.py) replaces the dense int8
-  matmul — 32× denser in HBM, exact.
+- ``cfg.bitpack_threshold_elems``: selects when the bit-packed Pallas
+  popcount path (ops/popcount.py) replaces the dense int8 matmul — 32×
+  denser in HBM, exact. ``"auto"`` (default) dispatches on estimated HBM
+  footprint via :func:`bitpack_wanted`: the MXU matmul wins by an order of
+  magnitude whenever the dense operands fit, so bitpack is reserved for
+  shapes that genuinely don't (true config-4 scale).
 - ``cfg.prune_vocab_threshold``: above this vocabulary size, infrequent
   items are pruned before pair counting (exact by the Apriori property) —
   the step that makes 1M-track vocabularies feasible.
@@ -60,11 +63,43 @@ class MiningResult:
     triple_merge_applied: bool | None = None
 
 
+def bitpack_wanted(
+    n_playlists: int,
+    n_tracks: int,
+    threshold: int | str | None,
+    *,
+    hbm_budget_bytes: int = 12 << 30,
+    n_devices: int = 1,
+) -> bool:
+    """The ONE bitpack-vs-dense dispatch decision (single-chip and sharded).
+
+    - ``threshold == "auto"``: bitpack only when the dense formulation's
+      planned HBM — the int8 one-hot (sharded over ``n_devices``) plus the
+      int32 count matrix and an equal-size top-k scratch (replicated) —
+      exceeds ``hbm_budget_bytes`` per device. The MXU matmul beats the VPU
+      popcount kernel by an order of magnitude whenever its operands fit,
+      so footprint (not element count) is the dispatch key.
+    - ``threshold`` an int: the explicit element-count semantic (tests and
+      demos use tiny values to force a path).
+    - ``threshold is None``: never bitpack.
+    """
+    if threshold == "auto":
+        dense_bytes = (
+            n_playlists * n_tracks // max(n_devices, 1)
+            + 8 * n_tracks * n_tracks
+        )
+        return dense_bytes > hbm_budget_bytes
+    if threshold is None:
+        return False
+    return n_playlists * n_tracks > threshold
+
+
 def pair_count_fn(
     baskets: Baskets,
     mesh: "jax.sharding.Mesh | None" = None,
-    bitpack_threshold_elems: int | None = None,
+    bitpack_threshold_elems: int | str | None = None,
     sharded_impl: str = "gspmd",
+    hbm_budget_bytes: int = 12 << 30,
 ) -> tuple[jax.Array, jax.Array | None]:
     """One-hot encode + pair-support count: sharded, bit-packed, or dense.
 
@@ -75,10 +110,9 @@ def pair_count_fn(
     point), so ``None`` is returned.
     """
     if mesh is not None:
-        elems = baskets.n_playlists * baskets.n_tracks
-        if (
-            bitpack_threshold_elems is not None
-            and elems > bitpack_threshold_elems
+        if bitpack_wanted(
+            baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
+            hbm_budget_bytes=hbm_budget_bytes, n_devices=mesh.devices.size,
         ):
             # config-4 scale: bit-packed slabs sharded over dp, Pallas
             # popcount per chip, psum over ICI. The bitpack impl shards the
@@ -97,8 +131,10 @@ def pair_count_fn(
         from ..parallel.support import sharded_pair_counts
 
         return sharded_pair_counts(baskets, mesh, impl=sharded_impl), None
-    elems = baskets.n_playlists * baskets.n_tracks
-    if bitpack_threshold_elems is not None and elems > bitpack_threshold_elems:
+    if bitpack_wanted(
+        baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
+        hbm_budget_bytes=hbm_budget_bytes,
+    ):
         if jax.default_backend() == "tpu":
             # 32x denser operand: Pallas popcount over playlist bitsets
             from ..ops.popcount import popcount_pair_counts
@@ -112,9 +148,10 @@ def pair_count_fn(
         # mode — a massive perf cliff on exactly the large inputs this
         # threshold targets; the dense path is the right fallback there
         print(
-            f"NOTE: one-hot has {elems:.2e} elements but backend is "
-            f"{jax.default_backend()!r}; bit-packed popcount is TPU-only — "
-            f"using the dense int8 path"
+            f"NOTE: one-hot has "
+            f"{baskets.n_playlists * baskets.n_tracks:.2e} elements but "
+            f"backend is {jax.default_backend()!r}; bit-packed popcount is "
+            f"TPU-only — using the dense int8 path"
         )
     x = encode.onehot_matrix(
         jnp.asarray(baskets.playlist_rows),
@@ -344,10 +381,12 @@ def mine(
         # needs the one-hot or count matrix on device: single-device dense
         # mining without an itemset census or triple/quad extensions. The
         # sharded, bit-packed, and census paths keep the staged pipeline.
-        elems = mined_baskets.n_playlists * mined_baskets.n_tracks
         wants_bitpack = (
-            cfg.bitpack_threshold_elems is not None
-            and elems > cfg.bitpack_threshold_elems
+            bitpack_wanted(
+                mined_baskets.n_playlists, mined_baskets.n_tracks,
+                cfg.bitpack_threshold_elems,
+                hbm_budget_bytes=cfg.hbm_budget_bytes,
+            )
             and jax.default_backend() == "tpu"
         )
         # CPU fallback with the native POPCNT kernel: when no TPU is
@@ -407,6 +446,7 @@ def mine(
                     mined_baskets, mesh,
                     bitpack_threshold_elems=cfg.bitpack_threshold_elems,
                     sharded_impl=cfg.sharded_impl,
+                    hbm_budget_bytes=cfg.hbm_budget_bytes,
                 )
                 jax.block_until_ready(counts)
             with timer.phase("rule_emission"):

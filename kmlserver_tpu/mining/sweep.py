@@ -73,7 +73,8 @@ def run_sweep(
         emit = rules_mod.mine_rules_from_counts_np
     else:
         counts, _ = pair_count_fn(
-            mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems
+            mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+            hbm_budget_bytes=cfg.hbm_budget_bytes,
         )
         jax.block_until_ready(counts)
         emit = rules_mod.mine_rules_from_counts

@@ -71,6 +71,19 @@ class TestConfig:
         serving = ServingConfig.from_env(dotenv_path=None)
         assert serving.batch_max_inflight == 2
 
+    def test_bitpack_threshold_env_forms(self, monkeypatch):
+        # default and "auto" -> HBM-fit dispatch; "none" disables bitpack;
+        # an integer keeps the explicit element-count semantic
+        assert MiningConfig.from_env(dotenv_path=None).bitpack_threshold_elems == "auto"
+        monkeypatch.setenv("KMLS_BITPACK_THRESHOLD_ELEMS", "auto")
+        assert MiningConfig.from_env(dotenv_path=None).bitpack_threshold_elems == "auto"
+        monkeypatch.setenv("KMLS_BITPACK_THRESHOLD_ELEMS", "none")
+        assert MiningConfig.from_env(dotenv_path=None).bitpack_threshold_elems is None
+        monkeypatch.setenv("KMLS_BITPACK_THRESHOLD_ELEMS", "123456")
+        assert MiningConfig.from_env(dotenv_path=None).bitpack_threshold_elems == 123456
+        monkeypatch.setenv("KMLS_HBM_BUDGET_BYTES", str(1 << 30))
+        assert MiningConfig.from_env(dotenv_path=None).hbm_budget_bytes == 1 << 30
+
 
 class TestArtifacts:
     def test_pickle_roundtrip(self, tmp_path):

@@ -144,3 +144,23 @@ def test_miner_popcount_dispatch_is_tpu_gated(rng, monkeypatch, capsys):
     d1 = mine(baskets, cfg_dense).tensors.to_rules_dict(baskets.vocab.names)
     d2 = mine(baskets, cfg_packed).tensors.to_rules_dict(baskets.vocab.names)
     assert d1 == d2
+
+
+def test_bitpack_wanted_dispatch():
+    from kmlserver_tpu.mining.miner import bitpack_wanted
+
+    gib = 1 << 30
+    # auto: dense wins whenever the one-hot + count matrices fit the budget.
+    # 1M playlists x 5k pruned items = ~5 GiB dense -> resident on a 12 GiB
+    # budget (the r03 scale shape that an element threshold mis-routed)
+    assert not bitpack_wanted(1_000_000, 5_069, "auto", hbm_budget_bytes=12 * gib)
+    # true config-4 (10M x ~8k frequent): ~76 GiB dense -> bitpack
+    assert bitpack_wanted(10_000_000, 8_128, "auto", hbm_budget_bytes=12 * gib)
+    # sharding the playlist axis divides the one-hot term, not the counts
+    assert not bitpack_wanted(
+        10_000_000, 8_128, "auto", hbm_budget_bytes=12 * gib, n_devices=8
+    )
+    # explicit integer keeps the element-count semantic; None disables
+    assert bitpack_wanted(100, 100, 0)
+    assert not bitpack_wanted(100, 100, 100 * 100)
+    assert not bitpack_wanted(10_000_000, 1_000_000, None)
