@@ -61,6 +61,9 @@ class MiningResult:
     # merge ran, False when it had to be skipped (confidences pairwise-only),
     # None when not applicable
     triple_merge_applied: bool | None = None
+    # which pair-count route ran: "native-cpu", "dense-fused",
+    # "dense-staged", "bitpack", "sharded-bitpack", "sharded-dense-<impl>"
+    count_path: str | None = None
 
 
 def bitpack_wanted(
@@ -403,6 +406,23 @@ def mine(
         )
         counts = x = None
         if use_native_cpu:
+            count_path = "native-cpu"
+        elif use_fused:
+            count_path = "dense-fused"
+        elif mesh is not None:
+            count_path = (
+                "sharded-bitpack"
+                if bitpack_wanted(
+                    mined_baskets.n_playlists, mined_baskets.n_tracks,
+                    cfg.bitpack_threshold_elems,
+                    hbm_budget_bytes=cfg.hbm_budget_bytes,
+                    n_devices=mesh.devices.size,
+                )
+                else f"sharded-dense-{cfg.sharded_impl}"
+            )
+        else:
+            count_path = "bitpack" if wants_bitpack else "dense-staged"
+        if use_native_cpu:
             with timer.phase("native_pair_counts"):
                 counts_np = native_pair_counts(mined_baskets)
             with timer.phase("rule_emission"):
@@ -572,4 +592,5 @@ def mine(
         itemset_census=census,
         phase_timings=dict(timer.phases),
         triple_merge_applied=triple_merge_applied,
+        count_path=count_path,
     )

@@ -114,34 +114,48 @@ def main() -> int:
         f"below dense-pruned); counts {gib(counts_bytes):.3f} GiB"
     )
 
-    # ---- the measured run: full mine() through the bitpack path ----
+    # ---- the measured runs ----
     mesh = None
     if args.mesh != "none":
         from kmlserver_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.mesh)
         log(f"mesh: {dict(mesh.shape)} ({mesh.devices.size} devices)")
-    cfg = MiningConfig(
+
+    def timed_mine(label, cfg, b, warm=False):
+        """One logged mine() call; with ``warm`` a first untimed run
+        absorbs every jit/Mosaic compile (like the bench's mining phase —
+        compilation is environment preparation, not rule generation)."""
+        if warm:
+            mine(b, cfg, mesh=mesh)
+        res = mine(b, cfg, mesh=mesh)
+        log(
+            f"mine[{label}]: {res.duration_s:.2f}s rule generation "
+            f"({rows / res.duration_s:,.0f} membership rows/s; phase "
+            "timings: "
+            + ", ".join(
+                f"{k} {v:.2f}s" for k, v in (res.phase_timings or {}).items()
+            )
+            + ")"
+        )
+        return res
+
+    # 1. the bit-packed path, forced — the config-4 mechanics this demo
+    # exists to prove (at TRUE config-4 shape dense cannot fit; here the
+    # same code runs at a bounded shape). Cold: includes kernel compiles.
+    cfg_bitpack = MiningConfig(
         min_support=args.min_support,
         k_max_consequents=args.k_max,
         bitpack_threshold_elems=1,  # force the bit-packed path
         prune_vocab_threshold=1,  # force the Apriori prune
     )
-    result = mine(baskets, cfg, mesh=mesh)
+    result = timed_mine("bitpack cold", cfg_bitpack, baskets)
     assert result.pruned_vocab == f
     dur = result.duration_s
-    log(
-        f"mine(): {dur:.2f}s rule generation "
-        f"({rows / dur:,.0f} membership rows/s; phase timings: "
-        + ", ".join(
-            f"{k} {v:.2f}s" for k, v in (result.phase_timings or {}).items()
-        )
-        + ")"
-    )
     n_rules = int((np.asarray(result.tensors.rule_ids) >= 0).sum())
     log(f"{n_rules:,} rules over {f:,} frequent items")
 
-    print(json.dumps({
+    out = {
         "playlists": args.playlists,
         "tracks": args.tracks,
         "rows": rows,
@@ -154,7 +168,49 @@ def main() -> int:
         "n_rules": n_rules,
         "mesh": args.mesh,
         "platform": dev.platform,
-    }))
+    }
+
+    # 2. auto dispatch — what the miner actually does at this shape with
+    # default config (HBM-fit dense/bitpack decision, mining/miner.py
+    # bitpack_wanted). Warm: compile excluded, like the bench's headline.
+    cfg_auto = MiningConfig(
+        min_support=args.min_support, k_max_consequents=args.k_max
+    )
+    result_auto = timed_mine("auto warm", cfg_auto, baskets, warm=True)
+    auto_rules = int((np.asarray(result_auto.tensors.rule_ids) >= 0).sum())
+    if auto_rules != n_rules:
+        log(f"WARNING: auto path emitted {auto_rules:,} rules vs "
+            f"{n_rules:,} on the bitpack path")
+    out["auto_mine_s"] = round(result_auto.duration_s, 3)
+    out["auto_path"] = result_auto.count_path
+    out["auto_rows_per_s"] = round(rows / result_auto.duration_s, 1)
+
+    # 3. device-resident (TPU only): membership arrays pre-staged in HBM,
+    # Apriori prune done — isolates on-chip compute + the rule fetch from
+    # the host->device input transfer (through this environment's tunnel
+    # the ~300 MB transfer dominates; a production pod's local PCIe/ICI
+    # link would not). Labeled separately, never the headline.
+    if dev.platform == "tpu":
+        import dataclasses as _dc
+
+        pruned_dev = _dc.replace(
+            pruned,
+            playlist_rows=jax.device_put(pruned.playlist_rows),
+            track_ids=jax.device_put(pruned.track_ids),
+        )
+        jax.block_until_ready(
+            (pruned_dev.playlist_rows, pruned_dev.track_ids)
+        )
+        cfg_res = MiningConfig(
+            min_support=args.min_support,
+            k_max_consequents=args.k_max,
+            prune_vocab_threshold=10**9,  # already pruned
+        )
+        result_res = timed_mine("device-resident warm", cfg_res, pruned_dev, warm=True)
+        out["device_resident_mine_s"] = round(result_res.duration_s, 3)
+        out["device_resident_path"] = result_res.count_path
+
+    print(json.dumps(out))
     return 0
 
 
