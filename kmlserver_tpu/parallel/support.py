@@ -131,6 +131,7 @@ def sharded_bitpack_pair_counts(
     interpret: bool | None = None,
     variant: str | None = None,
     swar: bool | None = None,
+    impl: str | None = None,
 ) -> jax.Array:
     """Pair counts over the mesh with BIT-PACKED operands: the playlist
     (word) axis is sharded over ``dp``, each chip runs the Pallas popcount
@@ -151,6 +152,7 @@ def sharded_bitpack_pair_counts(
             f"sharded_bitpack_pair_counts needs a dp-only (Nx1) mesh, got "
             f"{dict(mesh.shape)}; flatten devices onto dp first"
         )
+    impl = pc.resolve_counts_impl(impl)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     variant, swar = pc.resolve_kernel_opts(variant, swar)
@@ -173,9 +175,14 @@ def sharded_bitpack_pair_counts(
     )
 
     def local(bt_local: jax.Array) -> jax.Array:
-        c = pc.popcount_pair_counts_padded(
-            bt_local, interpret=interpret, variant=variant, swar=swar
-        )
+        if impl == "mxu":
+            # per-shard blocked unpack-matmul (pure XLA — composes under
+            # shard_map on any backend, no interpret mode involved)
+            c = pc.mxu_pair_counts_padded(bt_local)
+        else:
+            c = pc.popcount_pair_counts_padded(
+                bt_local, interpret=interpret, variant=variant, swar=swar
+            )
         return jax.lax.psum(c, AXIS_DP)
 
     counts = jax.jit(

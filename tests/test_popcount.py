@@ -39,9 +39,54 @@ def test_popcount_matches_dense(rng, pv, variant, swar):
         popcount_pair_counts(
             baskets.playlist_rows, baskets.track_ids,
             n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
-            variant=variant, swar=swar,
+            variant=variant, swar=swar, impl="vpu",
         )
     )
+    np.testing.assert_array_equal(got, dense_counts(baskets))
+
+
+@pytest.mark.parametrize("pv", [(40, 17), (700, 300), (129, 257)])
+def test_mxu_impl_matches_dense(rng, pv):
+    """The blocked unpack-matmul impl (production default) is oracle-exact.
+    Pure XLA, so this runs natively (not interpreted) on the CPU backend —
+    the same compiled formulation the TPU executes."""
+    p, v = pv
+    baskets = build_baskets(
+        table_from_baskets(random_baskets(rng, n_playlists=p, n_tracks=v, mean_len=6))
+    )
+    got = np.asarray(
+        popcount_pair_counts(
+            baskets.playlist_rows, baskets.track_ids,
+            n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+            impl="mxu",
+        )
+    )
+    np.testing.assert_array_equal(got, dense_counts(baskets))
+
+
+def test_mxu_impl_is_default_and_env_selectable(rng, monkeypatch):
+    from kmlserver_tpu.ops.popcount import resolve_counts_impl
+
+    assert resolve_counts_impl(None) == "mxu"
+    monkeypatch.setenv("KMLS_BITPACK_IMPL", "vpu")
+    assert resolve_counts_impl(None) == "vpu"
+    with pytest.raises(ValueError, match="impl"):
+        resolve_counts_impl("nope")
+
+
+def test_mxu_impl_sharded(rng):
+    """The dp-sharded bitpack path with the MXU impl: per-shard unpack-
+    matmul + psum over the mesh equals the dense single-device counts."""
+    import jax
+
+    from kmlserver_tpu.parallel.mesh import make_mesh
+    from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
+
+    baskets = build_baskets(
+        table_from_baskets(random_baskets(rng, n_playlists=90, n_tracks=33, mean_len=5))
+    )
+    mesh = make_mesh("4x1", devices=jax.devices()[:4])
+    got = np.asarray(sharded_bitpack_pair_counts(baskets, mesh, impl="mxu"))
     np.testing.assert_array_equal(got, dense_counts(baskets))
 
 
@@ -84,7 +129,9 @@ def test_kernel_opts_env_reach_sharded_path(rng, monkeypatch):
         table_from_baskets(random_baskets(rng, n_playlists=40, n_tracks=17, mean_len=5))
     )
     mesh = make_mesh("4x1", devices=jax.devices()[:4])
-    got = np.asarray(sharded_bitpack_pair_counts(baskets, mesh, interpret=True))
+    got = np.asarray(
+        sharded_bitpack_pair_counts(baskets, mesh, interpret=True, impl="vpu")
+    )
     np.testing.assert_array_equal(got, dense_counts(baskets))
 
 
