@@ -62,9 +62,15 @@ baskets = synthetic_baskets(
     n_playlists=n_playlists, n_tracks=n_tracks, target_rows=target_rows,
     seed=123)
 kw = dict(n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks)
-fn = lambda: pc.popcount_pair_counts(
-    baskets.playlist_rows, baskets.track_ids,
-    interpret=interpret, variant=variant, **kw)
+if variant == "mxu":
+    # the blocked unpack-matmul impl: tiles are XLA's business, only
+    # WORD_CHUNK (slab width) applies — pure XLA, never interpreted
+    fn = lambda: pc.popcount_pair_counts(
+        baskets.playlist_rows, baskets.track_ids, impl="mxu", **kw)
+else:
+    fn = lambda: pc.popcount_pair_counts(
+        baskets.playlist_rows, baskets.track_ids, impl="vpu",
+        interpret=interpret, variant=variant, **kw)
 out = fn()
 out.block_until_ready()  # compile
 if check:
@@ -98,7 +104,11 @@ def main() -> int:
         "--configs", nargs="+", default=list(DEFAULT_CONFIGS),
         help="TIxTJxWORD_CHUNK triples",
     )
-    parser.add_argument("--variants", nargs="+", default=["bcast", "row"])
+    parser.add_argument(
+        "--variants", nargs="+", default=["mxu", "bcast", "row"],
+        help="VPU kernel variants and/or 'mxu' (the unpack-matmul impl; "
+        "only the WORD_CHUNK third of each config applies to it)",
+    )
     parser.add_argument(
         "--allow-interpret", action="store_true",
         help="permit running off-TPU (measures the interpreter, not the chip)",
@@ -108,9 +118,16 @@ def main() -> int:
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = []
+    mxu_chunks_seen: set[int] = set()
     for config in args.configs:
         ti, tj, wk = (int(x) for x in config.split("x"))
         for variant in args.variants:
+            if variant == "mxu":
+                # only WORD_CHUNK matters to the unpack-matmul impl;
+                # don't re-measure it per tile pair
+                if wk in mxu_chunks_seen:
+                    continue
+                mxu_chunks_seen.add(wk)
             env = os.environ.copy()
             env.update(
                 KMLS_POPCOUNT_TILE_I=str(ti),
