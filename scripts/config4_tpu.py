@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""BASELINE.json config 4 on a single TPU chip: 10M playlists × 1M tracks,
+500M membership rows, mined EXACTLY through the bit-packed path.
+
+The lean sibling of ``scripts/scale_demo.py`` for opportunistic runs
+against a flaky remote pool: generation + prune + exactly TWO mine()
+calls (cold, then warm), no auto/device-resident extras — at this shape
+every extra mine re-pays a multi-GB host→device transfer through the
+tunnel. ``CONFIG4_CPU_r03.json`` documents the same shape on one CPU core
+(77.8 s); this script produces the TPU twin.
+
+HBM budget at the default shape (v5e, 16 GiB): bitset
+(8192 × 312832 words) ≈ 9.56 GiB + pruned membership operands ≈ 2×1.4 GiB
++ (F_pad)² int32 counts ≈ 0.26 GiB + an unpacked slab ≈ 0.13 GiB. The
+MXU unpack-matmul impl (KMLS_BITPACK_IMPL=mxu, the default) carries the
+contraction: ≈1.3·10¹⁵ int8 ops ≈ 3.4 s at the chip's 394 TOPS peak.
+
+Prints one JSON line (stdout); narrative on stderr. Exits 3 off-TPU
+unless --allow-cpu (the CPU artifact already exists — rerunning it here
+just burns ~15 min).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--playlists", type=int, default=10_000_000)
+    parser.add_argument("--tracks", type=int, default=1_000_000)
+    parser.add_argument("--rows", type=int, default=500_000_000)
+    parser.add_argument("--min-support", type=float, default=0.0005)
+    parser.add_argument("--k-max", type=int, default=64)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--allow-cpu", action="store_true")
+    parser.add_argument(
+        "--skip-warm", action="store_true",
+        help="stop after the cold mine (half the tunnel transfers)",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}) x{len(jax.devices())}")
+    if dev.platform != "tpu" and not args.allow_cpu:
+        log("not a TPU backend (CONFIG4_CPU_r03.json already covers CPU); "
+            "pass --allow-cpu to run anyway")
+        return 3
+
+    import dataclasses
+
+    import numpy as np
+
+    from kmlserver_tpu.config import MiningConfig
+    from kmlserver_tpu.data.synthetic import synthetic_baskets
+    from kmlserver_tpu.mining.miner import mine, prune_infrequent
+    from kmlserver_tpu.ops import popcount as pc
+    from kmlserver_tpu.ops.support import min_count_for
+
+    t0 = time.perf_counter()
+    baskets = synthetic_baskets(
+        n_playlists=args.playlists, n_tracks=args.tracks,
+        target_rows=args.rows, seed=args.seed,
+    )
+    rows = len(baskets.playlist_rows)
+    gen_s = time.perf_counter() - t0
+    log(f"workload: {rows:,} memberships, {args.playlists:,} playlists, "
+        f"{args.tracks:,} tracks (generated in {gen_s:.1f}s host-side)")
+
+    # prune OUTSIDE the device bracket so the transferred operands are the
+    # pruned ones (~60-70% of rows) — at this shape the tunnel transfer is
+    # the dominant non-compute cost and the unpruned operands are 4 GB
+    min_count = min_count_for(args.min_support, baskets.n_playlists)
+    t0 = time.perf_counter()
+    pruned, _ = prune_infrequent(baskets, min_count)
+    prune_s = time.perf_counter() - t0
+    f = pruned.n_tracks
+    f_pad, w_pad = pc.padded_shape(f, args.playlists)
+    log(f"Apriori prune @ min_support {args.min_support} (min_count "
+        f"{min_count}): {args.tracks:,} -> {f:,} frequent items in "
+        f"{prune_s:.1f}s host-side; {len(pruned.playlist_rows):,} rows kept")
+    log(f"HBM plan: bitset {f_pad}x{w_pad} uint32 = "
+        f"{f_pad * w_pad * 4 / (1 << 30):.2f} GiB; counts "
+        f"{f_pad * f_pad * 4 / (1 << 30):.2f} GiB; operands "
+        f"{2 * len(pruned.playlist_rows) * 4 / (1 << 30):.2f} GiB")
+
+    del baskets  # host RAM: the unpruned copy is no longer needed
+
+    # skip re-pruning inside mine(); force bitpack (dense cannot fit)
+    cfg = MiningConfig(
+        min_support=args.min_support,
+        k_max_consequents=args.k_max,
+        bitpack_threshold_elems=1,
+        prune_vocab_threshold=10**9,
+    )
+
+    def one_mine(label: str):
+        res = mine(pruned, cfg)
+        log(f"mine[{label}]: {res.duration_s:.2f}s rule generation "
+            f"({rows / res.duration_s:,.0f} membership rows/s of the "
+            f"original {rows:,}; path {res.count_path}; phase timings: "
+            + ", ".join(f"{k} {v:.2f}s"
+                        for k, v in (res.phase_timings or {}).items())
+            + ")")
+        return res
+
+    result = one_mine("cold")
+    n_rules = int((np.asarray(result.tensors.rule_ids) >= 0).sum())
+    log(f"{n_rules:,} rules over {f:,} frequent items")
+    out = {
+        "playlists": args.playlists,
+        "tracks": args.tracks,
+        "rows": rows,
+        "min_support": args.min_support,
+        "frequent_items": f,
+        "bitset_gib": round(f_pad * w_pad * 4 / (1 << 30), 3),
+        "gen_s": round(gen_s, 1),
+        "prune_host_s": round(prune_s, 2),
+        "mine_cold_s": round(result.duration_s, 3),
+        "n_rules": n_rules,
+        "count_path": result.count_path,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+    if not args.skip_warm:
+        result_w = one_mine("warm")
+        out["mine_s"] = round(result_w.duration_s, 3)
+        out["rows_per_s"] = round(rows / result_w.duration_s, 1)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
