@@ -1033,6 +1033,9 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             result["scale_cpu_mesh8_rows_per_s"] = scale["rows_per_s"]
             result["scale_cpu_mesh8_frequent_items"] = scale["frequent_items"]
             result["scale_cpu_mesh8_shape"] = "20000x5000"
+            if "auto_mine_s" in scale:
+                result["scale_cpu_mesh8_auto_mine_s"] = scale["auto_mine_s"]
+                result["scale_cpu_mesh8_auto_path"] = scale["auto_path"]
 
     if _remaining() > 180:
         # half-million-playlist mine through the NATIVE fallback (Apriori
@@ -1053,6 +1056,9 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             result["scale_cpu_native_rows_per_s"] = scale_n["rows_per_s"]
             result["scale_cpu_native_frequent_items"] = scale_n["frequent_items"]
             result["scale_cpu_native_shape"] = "500000x50000"
+            if "auto_mine_s" in scale_n:
+                result["scale_cpu_native_auto_mine_s"] = scale_n["auto_mine_s"]
+                result["scale_cpu_native_auto_path"] = scale_n["auto_path"]
 
     if _remaining() > 120:
         _record_serving(result, npz_path, "cpu")
