@@ -61,8 +61,9 @@ class MiningResult:
     # merge ran, False when it had to be skipped (confidences pairwise-only),
     # None when not applicable
     triple_merge_applied: bool | None = None
-    # which pair-count route ran: "native-cpu", "dense-fused",
-    # "dense-staged", "bitpack", "sharded-bitpack", "sharded-dense-<impl>"
+    # which pair-count route ran: "native-cpu", "dense-fused", or (staged
+    # branch, straight from pair_count_fn) "dense", "bitpack-mxu",
+    # "bitpack-vpu", "sharded-bitpack", "sharded-dense-<impl>"
     count_path: str | None = None
 
 
@@ -84,14 +85,22 @@ def bitpack_wanted(
       so footprint (not element count) is the dispatch key.
     - ``threshold`` an int: the explicit element-count semantic (tests and
       demos use tiny values to force a path).
-    - ``threshold is None``: never bitpack.
+    - ``threshold is None`` (or ``"none"``/``"never"``, the env spellings):
+      never bitpack.
     """
-    if threshold == "auto":
-        dense_bytes = (
-            n_playlists * n_tracks // max(n_devices, 1)
-            + 8 * n_tracks * n_tracks
+    if isinstance(threshold, str):
+        if threshold == "auto":
+            dense_bytes = (
+                n_playlists * n_tracks // max(n_devices, 1)
+                + 8 * n_tracks * n_tracks
+            )
+            return dense_bytes > hbm_budget_bytes
+        if threshold in ("none", "never"):
+            return False
+        raise ValueError(
+            f"bitpack threshold must be 'auto', 'none'/'never', None, or an "
+            f"element count, got {threshold!r}"
         )
-        return dense_bytes > hbm_budget_bytes
     if threshold is None:
         return False
     return n_playlists * n_tracks > threshold
@@ -103,26 +112,30 @@ def pair_count_fn(
     bitpack_threshold_elems: int | str | None = None,
     sharded_impl: str = "gspmd",
     hbm_budget_bytes: int = 12 << 30,
-) -> tuple[jax.Array, jax.Array | None]:
+) -> tuple[jax.Array, jax.Array | None, str]:
     """One-hot encode + pair-support count: sharded, bit-packed, or dense.
 
-    Returns ``(counts, x_onehot_or_None)`` — the one-hot matrix is handed
-    back on the dense single-device path so downstream steps (itemset
-    census) reuse it instead of re-encoding; on the sharded and bit-packed
-    paths the full int8 matrix deliberately never exists (that's their
-    point), so ``None`` is returned.
+    Returns ``(counts, x_onehot_or_None, path)`` — the one-hot matrix is
+    handed back on the dense single-device path so downstream steps
+    (itemset census) reuse it instead of re-encoding; on the sharded and
+    bit-packed paths the full int8 matrix deliberately never exists
+    (that's their point), so ``None`` is returned. ``path`` names the
+    route that actually ran (``"dense"``, ``"bitpack-mxu"``,
+    ``"bitpack-vpu"``, ``"sharded-bitpack"``, ``"sharded-dense-<impl>"``)
+    — the ONE source for ``MiningResult.count_path``, so artifacts can
+    never desynchronize from the dispatch.
     """
     if mesh is not None:
         if bitpack_wanted(
             baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
             hbm_budget_bytes=hbm_budget_bytes, n_devices=mesh.devices.size,
         ):
-            # config-4 scale: bit-packed slabs sharded over dp, Pallas
-            # popcount per chip, psum over ICI. The bitpack impl shards the
-            # word axis over dp ONLY — on a dp×tp mesh the tp chips would
-            # each redundantly hold the full per-host slab (per-chip memory
-            # O(V·P/(32·dp)) instead of O(V·P/(32·n_chips))), so flatten
-            # every device onto dp first.
+            # config-4 scale: bit-packed slabs sharded over dp, per-chip
+            # counts from the bitset slab, psum over ICI. The bitpack impl
+            # shards the word axis over dp ONLY — on a dp×tp mesh the tp
+            # chips would each redundantly hold the full per-host slab
+            # (per-chip memory O(V·P/(32·dp)) instead of
+            # O(V·P/(32·n_chips))), so flatten every device onto dp first.
             from ..parallel.mesh import AXIS_TP, make_mesh
             from ..parallel.support import sharded_bitpack_pair_counts
 
@@ -130,39 +143,46 @@ def pair_count_fn(
                 mesh = make_mesh(
                     "auto", devices=list(mesh.devices.flatten())
                 )
-            return sharded_bitpack_pair_counts(baskets, mesh), None
+            return (
+                sharded_bitpack_pair_counts(baskets, mesh), None,
+                "sharded-bitpack",
+            )
         from ..parallel.support import sharded_pair_counts
 
-        return sharded_pair_counts(baskets, mesh, impl=sharded_impl), None
+        return (
+            sharded_pair_counts(baskets, mesh, impl=sharded_impl), None,
+            f"sharded-dense-{sharded_impl}",
+        )
     if bitpack_wanted(
         baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
         hbm_budget_bytes=hbm_budget_bytes,
     ):
-        if jax.default_backend() == "tpu":
-            # 32x denser operand: Pallas popcount over playlist bitsets
-            from ..ops.popcount import popcount_pair_counts
+        from ..ops.popcount import popcount_pair_counts, resolve_counts_impl
 
-            counts = popcount_pair_counts(
-                baskets.playlist_rows, baskets.track_ids,
-                n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
-            )
-            return counts, None
-        # off-TPU the Pallas kernel would run in Python-level interpreter
-        # mode — a massive perf cliff on exactly the large inputs this
-        # threshold targets; the dense path is the right fallback there
-        print(
-            f"NOTE: one-hot has "
-            f"{baskets.n_playlists * baskets.n_tracks:.2e} elements but "
-            f"backend is {jax.default_backend()!r}; bit-packed popcount is "
-            f"TPU-only — using the dense int8 path"
+        # off-TPU the Pallas VPU kernel would run in Python-level
+        # interpreter mode — a massive perf cliff on exactly the large
+        # inputs this path targets — but the MXU unpack-matmul impl is
+        # pure XLA and compiles on every backend, so the bitset path (and
+        # its 32× memory saving) is available everywhere; only the kernel
+        # choice is backend-gated
+        impl = (
+            resolve_counts_impl()
+            if jax.default_backend() == "tpu"
+            else "mxu"
         )
+        counts = popcount_pair_counts(
+            baskets.playlist_rows, baskets.track_ids,
+            n_playlists=baskets.n_playlists, n_tracks=baskets.n_tracks,
+            impl=impl,
+        )
+        return counts, None, f"bitpack-{impl}"
     x = encode.onehot_matrix(
         jnp.asarray(baskets.playlist_rows),
         jnp.asarray(baskets.track_ids),
         n_playlists=baskets.n_playlists,
         n_tracks=baskets.n_tracks,
     )
-    return support.pair_counts(x), x
+    return support.pair_counts(x), x, "dense"
 
 
 def native_cpu_eligible(cfg: MiningConfig, mesh=None) -> bool:
@@ -384,13 +404,10 @@ def mine(
         # needs the one-hot or count matrix on device: single-device dense
         # mining without an itemset census or triple/quad extensions. The
         # sharded, bit-packed, and census paths keep the staged pipeline.
-        wants_bitpack = (
-            bitpack_wanted(
-                mined_baskets.n_playlists, mined_baskets.n_tracks,
-                cfg.bitpack_threshold_elems,
-                hbm_budget_bytes=cfg.hbm_budget_bytes,
-            )
-            and jax.default_backend() == "tpu"
+        wants_bitpack = bitpack_wanted(
+            mined_baskets.n_playlists, mined_baskets.n_tracks,
+            cfg.bitpack_threshold_elems,
+            hbm_budget_bytes=cfg.hbm_budget_bytes,
         )
         # CPU fallback with the native POPCNT kernel: when no TPU is
         # reachable, XLA:CPU's int8 matmul dominates the bracket (~75%);
@@ -409,19 +426,8 @@ def mine(
             count_path = "native-cpu"
         elif use_fused:
             count_path = "dense-fused"
-        elif mesh is not None:
-            count_path = (
-                "sharded-bitpack"
-                if bitpack_wanted(
-                    mined_baskets.n_playlists, mined_baskets.n_tracks,
-                    cfg.bitpack_threshold_elems,
-                    hbm_budget_bytes=cfg.hbm_budget_bytes,
-                    n_devices=mesh.devices.size,
-                )
-                else f"sharded-dense-{cfg.sharded_impl}"
-            )
         else:
-            count_path = "bitpack" if wants_bitpack else "dense-staged"
+            count_path = None  # the staged branch reports what actually ran
         if use_native_cpu:
             with timer.phase("native_pair_counts"):
                 counts_np = native_pair_counts(mined_baskets)
@@ -462,7 +468,7 @@ def mine(
                 )
         else:
             with timer.phase("pair_counts"):
-                counts, x = pair_count_fn(
+                counts, x, count_path = pair_count_fn(
                     mined_baskets, mesh,
                     bitpack_threshold_elems=cfg.bitpack_threshold_elems,
                     sharded_impl=cfg.sharded_impl,

@@ -72,7 +72,7 @@ def run_sweep(
         counts = native_pair_counts(mined_baskets)
         emit = rules_mod.mine_rules_from_counts_np
     else:
-        counts, _ = pair_count_fn(
+        counts, _, _ = pair_count_fn(
             mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems,
             hbm_budget_bytes=cfg.hbm_budget_bytes,
         )

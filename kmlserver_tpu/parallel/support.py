@@ -153,9 +153,13 @@ def sharded_bitpack_pair_counts(
             f"{dict(mesh.shape)}; flatten devices onto dp first"
         )
     impl = pc.resolve_counts_impl(impl)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    variant, swar = pc.resolve_kernel_opts(variant, swar)
+    if impl == "vpu":
+        # kernel opts are the VPU kernel's business only — resolving them
+        # on the mxu branch would let an irrelevant KMLS_POPCOUNT_* value
+        # crash a path that never reads it
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        variant, swar = pc.resolve_kernel_opts(variant, swar)
     dp = mesh.shape[AXIS_DP]
     v = baskets.n_tracks
     v_pad = round_up(max(v, pc.V_TILE), pc.V_TILE)

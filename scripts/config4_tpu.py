@@ -56,15 +56,15 @@ def main() -> int:
             log("not a TPU backend (CONFIG4_CPU_r03.json already covers "
                 "CPU); pass --allow-cpu to run anyway")
             return 3
-        # off-TPU the bitpack force is ignored (miner gates Pallas/mxu
-        # dispatch on the TPU backend) and the only safe carrier is the
-        # native POPCNT counter — without it mine() would fall through to
-        # the dense path and allocate a ~76 GiB one-hot at default shape
+        # off-TPU the only carrier that finishes in minutes is the native
+        # POPCNT counter; without it the miner would take the bitset-mxu
+        # route, which is memory-safe but ~10¹⁵ int8 ops on XLA:CPU
+        # (hours) — refuse rather than wedge the session
         from kmlserver_tpu.ops import cpu_popcount
 
         if not cpu_popcount.available():
-            log("native pair-count library unavailable; refusing the dense "
-                "fallback at this shape")
+            log("native pair-count library unavailable; the XLA:CPU bitset "
+                "route would take hours at this shape — refusing")
             return 3
 
     import numpy as np

@@ -81,8 +81,9 @@ def test_miner_selects_sharded_bitpack(baskets):
     from kmlserver_tpu.mining.miner import pair_count_fn
 
     m = mesh_mod.make_mesh("8x1")
-    counts, x = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
+    counts, x, path = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
     assert x is None
+    assert path == "sharded-bitpack"
     np.testing.assert_array_equal(
         np.asarray(counts), single_device_counts(baskets)
     )
@@ -96,8 +97,9 @@ def test_miner_flattens_mesh_for_bitpack(baskets):
     from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
 
     m = mesh_mod.make_mesh("4x2")
-    counts, x = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
+    counts, x, path = pair_count_fn(baskets, m, bitpack_threshold_elems=1)
     assert x is None
+    assert path == "sharded-bitpack"
     np.testing.assert_array_equal(
         np.asarray(counts), single_device_counts(baskets)
     )
