@@ -104,6 +104,8 @@ def run_mining_job(
         from ..utils.profiling import format_phases
 
         print(format_phases(result.phase_timings).capitalize())
+    if result.count_path:
+        print(f"Pair-count path: {result.count_path}")
     if result.itemset_census is not None:
         census = ", ".join(
             f"len {k}: {'not enumerated' if v < 0 else v}"
