@@ -22,6 +22,13 @@ def main() -> int:
 
     distributed = maybe_initialize()
     cfg = MiningConfig.from_env()
+    # persistent XLA compilation cache (PVC-backed via KMLS_JAX_CACHE_DIR):
+    # the pseudo-CronJob re-runs this container every ~20 min and would
+    # otherwise re-pay every jit compile each run. AFTER from_env so the
+    # knob honors .env like every other KMLS_ variable; before any jit.
+    from ..utils.jaxcache import enable_compilation_cache
+
+    enable_compilation_cache()
     mesh = None
     if cfg.mesh_shape in ("", "1x1"):
         pass  # explicit single-device

@@ -30,6 +30,13 @@ def main() -> int:
     )
     logging.getLogger("kmlserver_tpu").setLevel(logging.DEBUG)
     cfg = ServingConfig.from_env()
+    # persistent XLA compilation cache (PVC-backed via KMLS_JAX_CACHE_DIR):
+    # per-shape warmup on every rollout/reload hits the cache instead of
+    # recompiling the same serving-bucket kernels. AFTER from_env so the
+    # knob honors .env like every other KMLS_ variable; before any jit.
+    from ..utils.jaxcache import enable_compilation_cache
+
+    enable_compilation_cache()
     app = RecommendApp(cfg)
     app.engine.start_polling()
     server = serve(app)

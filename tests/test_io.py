@@ -71,6 +71,25 @@ class TestConfig:
         serving = ServingConfig.from_env(dotenv_path=None)
         assert serving.batch_max_inflight == 2
 
+    def test_compilation_cache_env(self, monkeypatch, tmp_path):
+        import jax
+
+        from kmlserver_tpu.utils.jaxcache import enable_compilation_cache
+
+        monkeypatch.delenv("KMLS_JAX_CACHE_DIR", raising=False)
+        assert enable_compilation_cache() is None
+        cache = tmp_path / "jax-cache"
+        monkeypatch.setenv("KMLS_JAX_CACHE_DIR", str(cache))
+        try:
+            assert enable_compilation_cache() == str(cache)
+            assert cache.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+
     def test_bitpack_threshold_env_forms(self, monkeypatch):
         # default and "auto" -> HBM-fit dispatch; "none" disables bitpack;
         # an integer keeps the explicit element-count semantic
