@@ -354,6 +354,14 @@ def make_handler(app: RecommendApp):
             for key, value in headers.items():
                 self.send_header(key, value)
             self.send_header("Content-Length", str(len(payload)))
+            # during a SIGTERM drain (server.draining set by serving.server)
+            # tell keep-alive clients to re-connect elsewhere — k8s endpoint
+            # removal only diverts NEW connections, established flows would
+            # otherwise keep sending to the terminating pod until cut off
+            drain = getattr(self.server, "draining", None)
+            if drain is not None and drain.is_set():
+                self.send_header("Connection", "close")
+                self.close_connection = True
             self.end_headers()
             self.wfile.write(payload)
 

@@ -11,7 +11,10 @@ timestamped format intent as the reference's logging setup
 from __future__ import annotations
 
 import logging
+import signal
 import sys
+import threading
+import time
 
 from ..config import ServingConfig
 from .app import RecommendApp, serve
@@ -41,15 +44,42 @@ def main() -> int:
     app.engine.start_polling()
     server = serve(app)
     host, port = server.server_address[:2]
-    logging.getLogger("kmlserver_tpu.serving").info(
-        "serving on %s:%d (version %s)", host, port, cfg.version
-    )
+    log = logging.getLogger("kmlserver_tpu.serving")
+    log.info("serving on %s:%d (version %s)", host, port, cfg.version)
+
+    # graceful drain on SIGTERM: a k8s rollout sends SIGTERM and waits
+    # terminationGracePeriodSeconds before SIGKILL. The reference's uvicorn
+    # drains in-flight requests on SIGTERM; the stdlib default would kill
+    # them mid-response. Sequence: (1) the handler starts answering with
+    # "Connection: close" so keep-alive clients migrate off the pod (k8s
+    # endpoint removal only stops NEW connections — established flows keep
+    # routing here); (2) shutdown() stops the accept loop and returns from
+    # serve_forever (it must run OFF the serving thread or it deadlocks);
+    # (3) server_close() immediately closes the LISTENING socket so racing
+    # connects get an instant refusal (not a backlog-then-RST after the
+    # settle); (4) a short bounded settle lets in-flight responses
+    # (ms-scale) finish — handler threads are daemonic and idle keep-alive
+    # connections can block forever, so joining them is not an option.
+    draining = threading.Event()
+    server.draining = draining  # handlers read this (app.make_handler)
+
+    def _drain(signum, frame):
+        log.info("SIGTERM: draining in-flight requests, then exiting")
+        draining.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+    except ValueError:
+        pass  # not the main thread (embedded use); k8s path is main-thread
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        server.server_close()
+        server.server_close()  # listening socket closed BEFORE the settle
+        if draining.is_set():
+            time.sleep(2.0)  # bounded settle for in-flight responses
     return 0
 
 
