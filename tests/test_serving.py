@@ -386,6 +386,72 @@ class TestAppRouting:
         assert app.handle("GET", "/readyz", None)[0] == 503
         assert app.handle("GET", "/healthz", None)[0] == 200
 
+    def test_sigterm_drain(self, mined_pvc):
+        """k8s rollout semantics: on SIGTERM the server must (a) answer
+        established keep-alive connections WITH Connection: close so
+        clients migrate off the pod, (b) close the listener so racing
+        connects are refused, (c) exit 0 after a bounded settle."""
+        import http.client
+        import os
+        import re
+        import signal
+        import socket
+        import subprocess
+        import sys
+
+        cfg, _, _ = mined_pvc
+        env = dict(
+            os.environ, BASE_DIR=cfg.base_dir, KMLS_PORT="0",
+            POLLING_WAIT_IN_MINUTES="5",
+        )
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "kmlserver_tpu.serving.server"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        try:
+            port = None
+            for line in srv.stdout:  # type: ignore[union-attr]
+                m = re.search(r"serving on \S+?:(\d+)", line)
+                if m:
+                    port = int(m.group(1))
+                    break
+            assert port
+            threading.Thread(
+                target=lambda: [None for _ in srv.stdout], daemon=True
+            ).start()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    probe = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=3
+                    )
+                    probe.request("GET", "/readyz")
+                    if probe.getresponse().status == 200:
+                        break
+                except OSError:
+                    time.sleep(0.5)
+            # keep-alive connection established BEFORE the signal
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            conn.request("GET", "/healthz")
+            r1 = conn.getresponse()
+            r1.read()
+            assert (r1.getheader("Connection") or "").lower() != "close"
+            srv.send_signal(signal.SIGTERM)
+            time.sleep(0.3)
+            conn.request("GET", "/healthz")
+            r2 = conn.getresponse()
+            r2.read()
+            assert r2.status == 200
+            assert (r2.getheader("Connection") or "").lower() == "close"
+            time.sleep(1.0)  # past the shutdown poll, inside the settle
+            with pytest.raises(OSError):
+                socket.create_connection(("127.0.0.1", port), timeout=2)
+            assert srv.wait(timeout=30) == 0
+        finally:
+            if srv.poll() is None:
+                srv.kill()
+
     def test_metrics(self, app):
         self._post(app, {"songs": ["whatever"]})
         status, _, payload = app.handle("GET", "/metrics", None)
