@@ -437,7 +437,7 @@ try:
     mxu_keys["mxu_words_per_s"] = word_ops / (mxu_keys["mxu_ms"] / 1e3)
 except Exception as exc:
     print(f"bitpack[mxu] failed: {type(exc).__name__}: "
-          f"{str(exc).splitlines()[0][:300]}", file=sys.stderr, flush=True)
+          f"{(str(exc).splitlines() or [repr(exc)])[0][:300]}", file=sys.stderr, flush=True)
 
 # the Pallas VPU kernel: try each (variant, popcount-impl) config until one
 # compiles AND matches the dense counts exactly; report which. (Mosaic
@@ -458,7 +458,7 @@ for variant, swar in (("bcast", False), ("row", False),
         break
     except Exception as exc:
         print(f"popcount[{label}] failed: {type(exc).__name__}: "
-              f"{str(exc).splitlines()[0][:300]}", file=sys.stderr, flush=True)
+              f"{(str(exc).splitlines() or [repr(exc)])[0][:300]}", file=sys.stderr, flush=True)
 if chosen is None and not mxu_keys:
     print("all bit-packed counting impls failed to compile/run on this backend",
           file=sys.stderr, flush=True)
@@ -679,7 +679,18 @@ def _run_phase(
         t_out.join(timeout=10)
         stderr_text = "\n".join(stderr_lines)
         if timed_out:
-            return None  # a hang already burned budget once; don't repeat
+            # no retry (a hang already burned budget once) — but salvage
+            # the last checkpoint JSON the phase printed before the kill
+            # (scale_demo checkpoints after every completed section)
+            stdout = "".join(stdout_parts)
+            for line in reversed(stdout.strip().splitlines()):
+                try:
+                    salvaged = json.loads(line)
+                except ValueError:
+                    continue
+                log(f"{name} phase timed out but a checkpoint was salvaged")
+                return salvaged
+            return None
         if proc.returncode == 0:
             stdout = "".join(stdout_parts)
             try:

@@ -74,6 +74,7 @@ def bitpack_wanted(
     *,
     hbm_budget_bytes: int = 12 << 30,
     n_devices: int = 1,
+    n_rows: int = 0,
 ) -> bool:
     """The ONE bitpack-vs-dense dispatch decision (single-chip and sharded).
 
@@ -90,9 +91,14 @@ def bitpack_wanted(
     """
     if isinstance(threshold, str):
         if threshold == "auto":
+            # one-hot (sharded) + count/top-k matrices (replicated) + the
+            # int32 membership operands that coexist with the one-hot
+            # during the encode scatter — data-proportional terms only;
+            # the budget's headroom covers XLA workspace, not operands
             dense_bytes = (
                 n_playlists * n_tracks // max(n_devices, 1)
                 + 8 * n_tracks * n_tracks
+                + 8 * n_rows // max(n_devices, 1)
             )
             return dense_bytes > hbm_budget_bytes
         if threshold in ("none", "never"):
@@ -129,6 +135,7 @@ def pair_count_fn(
         if bitpack_wanted(
             baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
             hbm_budget_bytes=hbm_budget_bytes, n_devices=mesh.devices.size,
+            n_rows=len(baskets.playlist_rows),
         ):
             # config-4 scale: bit-packed slabs sharded over dp, per-chip
             # counts from the bitset slab, psum over ICI. The bitpack impl
@@ -136,6 +143,7 @@ def pair_count_fn(
             # chips would each redundantly hold the full per-host slab
             # (per-chip memory O(V·P/(32·dp)) instead of
             # O(V·P/(32·n_chips))), so flatten every device onto dp first.
+            from ..ops.popcount import resolve_counts_impl
             from ..parallel.mesh import AXIS_TP, make_mesh
             from ..parallel.support import sharded_bitpack_pair_counts
 
@@ -143,8 +151,17 @@ def pair_count_fn(
                 mesh = make_mesh(
                     "auto", devices=list(mesh.devices.flatten())
                 )
+            # same backend gating as the single-device branch below: the
+            # env-selected impl applies on TPU; off-TPU pin the pure-XLA
+            # mxu impl so a TPU-targeted KMLS_BITPACK_IMPL=vpu can never
+            # put a CPU mesh run into interpreted-Pallas territory
+            impl = (
+                resolve_counts_impl()
+                if jax.default_backend() == "tpu"
+                else "mxu"
+            )
             return (
-                sharded_bitpack_pair_counts(baskets, mesh), None,
+                sharded_bitpack_pair_counts(baskets, mesh, impl=impl), None,
                 "sharded-bitpack",
             )
         from ..parallel.support import sharded_pair_counts
@@ -155,7 +172,7 @@ def pair_count_fn(
         )
     if bitpack_wanted(
         baskets.n_playlists, baskets.n_tracks, bitpack_threshold_elems,
-        hbm_budget_bytes=hbm_budget_bytes,
+        hbm_budget_bytes=hbm_budget_bytes, n_rows=len(baskets.playlist_rows),
     ):
         from ..ops.popcount import popcount_pair_counts, resolve_counts_impl
 
@@ -408,7 +425,35 @@ def mine(
             mined_baskets.n_playlists, mined_baskets.n_tracks,
             cfg.bitpack_threshold_elems,
             hbm_budget_bytes=cfg.hbm_budget_bytes,
+            n_rows=len(mined_baskets.playlist_rows),
         )
+        # exactness guard: the itemset census and the confidence-mode
+        # triple/quad merge need the dense one-hot (x) — the bit-packed
+        # route never materializes it and would silently downgrade those
+        # to pairwise-only. When the dense formulation FITS the budget,
+        # prefer it over a forced (explicit-threshold) bitpack; when it
+        # doesn't fit, bitpack proceeds and the loud pairwise-only
+        # warning below stands (dense was never an option).
+        staged_threshold = cfg.bitpack_threshold_elems
+        if (
+            wants_bitpack
+            and mesh is None
+            and cfg.max_itemset_len >= 3
+            and not bitpack_wanted(
+                mined_baskets.n_playlists, mined_baskets.n_tracks, "auto",
+                hbm_budget_bytes=cfg.hbm_budget_bytes,
+                n_rows=len(mined_baskets.playlist_rows),
+            )
+        ):
+            print(
+                "NOTE: max_itemset_len >= 3 needs the dense one-hot for "
+                "the census/triple merge and it fits the HBM budget — "
+                "overriding the bitpack threshold with the dense path"
+            )
+            wants_bitpack = False
+            # the override must reach pair_count_fn too, or the staged
+            # branch would re-derive bitpack from the raw cfg threshold
+            staged_threshold = None
         # CPU fallback with the native POPCNT kernel: when no TPU is
         # reachable, XLA:CPU's int8 matmul dominates the bracket (~75%);
         # the native bit-packed counter is the same exact XᵀX ~40x faster
@@ -470,7 +515,7 @@ def mine(
             with timer.phase("pair_counts"):
                 counts, x, count_path = pair_count_fn(
                     mined_baskets, mesh,
-                    bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+                    bitpack_threshold_elems=staged_threshold,
                     sharded_impl=cfg.sharded_impl,
                     hbm_budget_bytes=cfg.hbm_budget_bytes,
                 )

@@ -169,6 +169,10 @@ def main() -> int:
         "mesh": args.mesh,
         "platform": dev.platform,
     }
+    # checkpoint after EVERY section: the consumer (bench.py) parses the
+    # LAST stdout line, so if a later run blows the phase timeout the
+    # richest checkpoint that finished still carries the headline keys
+    print(json.dumps(out), flush=True)
 
     # 2. auto dispatch — what the miner actually does at this shape with
     # default config (HBM-fit dense/bitpack decision, mining/miner.py
@@ -184,6 +188,7 @@ def main() -> int:
     out["auto_mine_s"] = round(result_auto.duration_s, 3)
     out["auto_path"] = result_auto.count_path
     out["auto_rows_per_s"] = round(rows / result_auto.duration_s, 1)
+    print(json.dumps(out), flush=True)  # checkpoint (see above)
 
     # 3. device-resident (TPU only): membership arrays pre-staged in HBM,
     # Apriori prune done — isolates on-chip compute + the rule fetch from

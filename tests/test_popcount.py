@@ -194,6 +194,25 @@ def test_miner_bitpack_dispatch_off_tpu(rng, monkeypatch):
     assert d1 == d2
 
 
+def test_census_overrides_forced_bitpack_when_dense_fits(rng, capsys):
+    """max_itemset_len >= 3 needs the dense one-hot (census/triple merge);
+    a forced bitpack threshold must be overridden when dense fits the
+    budget — and the override must reach pair_count_fn (the staged branch
+    re-derives dispatch from the threshold it is given)."""
+    baskets = build_baskets(
+        table_from_baskets(random_baskets(rng, n_playlists=50, n_tracks=20, mean_len=5))
+    )
+    cfg = MiningConfig(
+        min_support=0.1, k_max_consequents=16, max_itemset_len=3,
+        bitpack_threshold_elems=0, native_cpu_pair_counts=False,
+    )
+    result = mine(baskets, cfg)
+    assert "overriding the bitpack threshold" in capsys.readouterr().out
+    assert result.count_path == "dense"
+    assert result.itemset_census is not None
+    assert result.itemset_census.get(3, -1) >= 0  # enumerated, not skipped
+
+
 def test_bitpack_wanted_dispatch():
     from kmlserver_tpu.mining.miner import bitpack_wanted
 

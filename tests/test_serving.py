@@ -287,19 +287,17 @@ class TestEngine:
             results[i] = batcher.recommend([f"s{i}"])
 
         threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
-        t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        elapsed = time.perf_counter() - t0
         # pairing survives the self-sized batches
         assert len(results) == n
         for i, (got, _) in results.items():
             assert got == [f"s{i}"]
-        # un-self-sized floor: 300 requests at 8/batch would need >= 750 ms
-        # of serialized dispatch blocks; self-sizing must beat that clearly
-        assert elapsed < 0.5, f"batcher serialized: {elapsed:.3f}s"
+        # growth is the load-bearing assertion (wall-clock bounds flake on
+        # loaded CI hosts): batches must grow well past the un-self-sized
+        # floor while dispatches block
         assert max(batch_sizes) > 32, f"batches never grew: {batch_sizes}"
 
     def test_recommend_many_async_matches_sync(self, mined_pvc):
