@@ -134,8 +134,9 @@ def sharded_bitpack_pair_counts(
     impl: str | None = None,
 ) -> jax.Array:
     """Pair counts over the mesh with BIT-PACKED operands: the playlist
-    (word) axis is sharded over ``dp``, each chip runs the Pallas popcount
-    kernel on its slab, partial counts ``psum`` over ICI.
+    (word) axis is sharded over ``dp``, each chip counts its slab (MXU
+    unpack-matmul or the Pallas VPU kernel, ``impl``), partial counts
+    ``psum`` over ICI.
 
     Per-chip memory is O(V · P/(32·dp)) — 32× below the sharded dense
     int8 path — which is what makes BASELINE.json config 4 (10M baskets,

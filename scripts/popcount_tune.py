@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""On-hardware tile sweep for the Pallas popcount kernel.
+"""On-hardware sweep for the bit-packed counting impls (Pallas VPU
+kernel tiles + the MXU unpack-matmul).
 
 The kernel's tiles are env-tunable (``KMLS_POPCOUNT_TILE_I/TILE_J/
 WORD_CHUNK``, ops/popcount.py) precisely so they can be tuned on real

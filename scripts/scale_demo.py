@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Config-4 scale mechanics: large-vocabulary mining through the
-Apriori-prune → bit-packed Pallas popcount path, with explicit HBM math.
+Apriori-prune → bit-packed counting path, with explicit HBM math.
 
 BASELINE.json config 4 is synthetic 10M playlists × 1M tracks on v5e-4 —
 far beyond the dense one-hot path (the (P, V) int8 matrix alone would be
@@ -12,9 +12,10 @@ shape, is exactly the one the miner takes automatically
    itemset (exact), collapsing V to the frequent vocabulary F.
 2. Bit-pack the playlist axis: (F, ceil(P/32)) uint32 bitsets — 32× below
    int8 — built on device by one scatter (ops/popcount.py bitpack_by_track).
-3. Pair counts via the Pallas popcount kernel (single chip), or dp-sharded
-   bitset slabs + psum over ICI (parallel/support.py
-   sharded_bitpack_pair_counts) on a mesh.
+3. Pair counts from the bitset (single chip: ops/popcount.py — MXU
+   unpack-matmul by default, Pallas VPU kernel via KMLS_BITPACK_IMPL=vpu;
+   on a mesh: dp-sharded bitset slabs + psum over ICI,
+   parallel/support.py sharded_bitpack_pair_counts).
 4. Rule emission on the (F, F) count matrix.
 
 Prints one JSON line with the measured numbers and the HBM accounting;
