@@ -90,6 +90,23 @@ class TestConfig:
                 "jax_persistent_cache_min_compile_time_secs", 1.0
             )
 
+    def test_compilation_cache_failure_is_soft(self, monkeypatch, tmp_path):
+        # a mis-mounted cache path must never take down the job/API
+        import jax
+
+        from kmlserver_tpu.utils.jaxcache import enable_compilation_cache
+
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file, not a directory")
+        monkeypatch.setenv("KMLS_JAX_CACHE_DIR", str(blocker / "cache"))
+        try:
+            assert enable_compilation_cache() is None  # logged, not raised
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+
     def test_bitpack_threshold_env_forms(self, monkeypatch):
         # default and "auto" -> HBM-fit dispatch; "none" disables bitpack;
         # an integer keeps the explicit element-count semantic
