@@ -23,8 +23,13 @@ just burns ~15 min).
 from __future__ import annotations
 
 import argparse
-import json
+import os
 import sys
+
+# runnable as `python scripts/<name>.py` from anywhere: the repo root
+# (not scripts/) is what must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
 import time
 
 

@@ -24,10 +24,14 @@ Usage (ds2 shape by default):
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import subprocess
 import sys
+
+# runnable as `python scripts/<name>.py` from anywhere: the repo root
+# (not scripts/) is what must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
+import subprocess
 
 DEFAULT_CONFIGS = (
     "32x128x512",   # the shipped default

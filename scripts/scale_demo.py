@@ -27,8 +27,13 @@ shapes small with --playlists/--tracks/--rows.
 from __future__ import annotations
 
 import argparse
-import json
+import os
 import sys
+
+# runnable as `python scripts/<name>.py` from anywhere: the repo root
+# (not scripts/) is what must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
 import time
 
 
