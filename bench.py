@@ -365,6 +365,7 @@ print(json.dumps({
     "n_tracks": baskets.n_tracks,
     "device_kind": dev.device_kind,
     "platform": dev.platform,
+    "count_path": result.count_path,
 }))
 """
 
@@ -1258,6 +1259,8 @@ def main() -> int:
         "platform": platform,
     }
     line.update(_mfu_keys(mining))
+    if mining.get("count_path"):
+        line["mining_count_path"] = mining["count_path"]
     if cpu_mining is not None and cpu_mining is not mining:
         # the TPU suite took over the headline; keep the CPU evidence too,
         # under unambiguous keys. Through this environment's tunnel the
