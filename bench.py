@@ -36,12 +36,32 @@ Phases (tpu suite): mining (headline, + an isolated MXU matmul timing with
 closed-form op counts → MFU), popcount (compiled Pallas kernel, counts
 asserted equal on-device, words/s emitted), scale (1M×100k config-4
 mechanics), serving (batch-32 p50), replay (full stack at 1k QPS, with
-server-side /metrics percentiles recorded next to the client-observed ones).
+server-side /metrics percentiles recorded next to the client-observed
+ones).
 Phases (cpu suite): mining, popcount stand-in (interpret mode, small
 shape), scale stand-in (20k×5k on an 8-virtual-device mesh), serving,
 replay — all keys labeled ``*_cpu*``.
 
-Prints ONE JSON line:
+THE ARTIFACT IS UNLOSEABLE (VERDICT r3 next-round #1). The driver records
+the LAST parseable JSON line on this process's stdout (r01/r02 artifacts
+confirm: `parsed` = the final JSON line; r03's `parsed: null` happened
+because the single end-of-run print never executed before the driver's
+kill). Three mechanisms guarantee a parsed artifact from the moment the
+headline mining number exists:
+
+1. checkpoints — a complete, self-contained artifact line is printed after
+   EVERY completed phase (marked ``"checkpoint": true``); later lines
+   strictly supersede earlier ones, and only JSON lines ever go to stdout
+   (all narrative goes to stderr);
+2. SIGTERM/SIGINT/atexit handlers flush the best-so-far line (and kill
+   live phase subprocesses) before exiting, so a driver kill at ANY time
+   after the first mining result still yields a parsed artifact;
+3. the soft deadline defaults to 1200 s — below the driver's observed
+   ~1500 s kill — and TPU-pool probe timeouts decay to 60 s after the
+   first hang (a pool that hung once will hang again; r03 burned ~24 min
+   in six serial 240 s probes).
+
+Final line (checkpoint flag absent):
     {"metric": ..., "value": <median mining seconds>, "unit": "s",
      "vs_baseline": <baseline_s / value>, "platform": "tpu"|"cpu",
      "probe_history": [...], ...}
@@ -66,8 +86,9 @@ MIN_SUPPORT = 0.05
 REPEATS = 5
 
 # soft wall-clock budget: optional phases are skipped once exceeded so the
-# required JSON line is never lost to a driver-side timeout
-DEADLINE_S = float(os.environ.get("KMLS_BENCH_DEADLINE_S", "2400"))
+# required JSON line is never lost to a driver-side timeout. 1200 s sits
+# well under the driver's observed ~1500 s kill (BENCH_r03.json, rc 124).
+DEADLINE_S = float(os.environ.get("KMLS_BENCH_DEADLINE_S", "1200"))
 _T0 = time.monotonic()
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
@@ -156,9 +177,15 @@ class TpuProber:
     def __init__(self, probe_timeout_s: float | None = None,
                  interval_s: float | None = None):
         self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
-            else float(os.environ.get("KMLS_BENCH_PROBE_TIMEOUT_S", "240"))
+            else float(os.environ.get("KMLS_BENCH_PROBE_TIMEOUT_S", "120"))
         self.interval_s = interval_s if interval_s is not None \
             else float(os.environ.get("KMLS_BENCH_PROBE_INTERVAL_S", "180"))
+        # after the FIRST hang, later probes shrink to this fuse: a pool
+        # that hung once will hang again, and 60 s suffices to re-detect —
+        # r03 burned ~24 min of a ~25 min window on six 240 s probes
+        self.decay_timeout_s = float(
+            os.environ.get("KMLS_BENCH_PROBE_TIMEOUT_DECAY_S", "60")
+        )
         self.history: list[dict] = []  # {"t_s", "outcome", "dur_s"}
         self.acquired = threading.Event()
         self._stop = threading.Event()
@@ -170,27 +197,37 @@ class TpuProber:
         t_start = _elapsed()
         outcome = "error"
         detail = ""
+        # _tracked_popen (not subprocess.run): the probe child is the
+        # process most likely to be alive at driver-kill time, and the
+        # crash handlers must be able to kill it — a hung `import jax`
+        # orphan would keep its pool connection open indefinitely
+        proc = _tracked_popen(
+            [sys.executable, "-c", _PROBE],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, **_cache_env()},
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, "-c", _PROBE],
-                capture_output=True, text=True, timeout=self.probe_timeout_s,
-                env={**os.environ, **_cache_env()},
+            stdout_text, stderr_text = proc.communicate(
+                timeout=self.probe_timeout_s
             )
-            if proc.returncode == 0 and "PROBE" in proc.stdout:
-                kind = proc.stdout.strip().split("PROBE", 1)[1].strip()
+            if proc.returncode == 0 and "PROBE" in stdout_text:
+                kind = stdout_text.strip().split("PROBE", 1)[1].strip()
                 detail = kind
                 platform = kind.split()[0] if kind else "unknown"
                 outcome = "cpu_only" if platform == "cpu" else "tpu"
             else:
-                detail = "\n".join(proc.stderr.strip().splitlines()[-3:])
+                detail = "\n".join(stderr_text.strip().splitlines()[-3:])
                 outcome = (
                     "transient_error"
-                    if _classify(proc.stderr, False) == "transient"
+                    if _classify(stderr_text, False) == "transient"
                     else "error"
                 )
         except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
             outcome = "hang"
             detail = f"probe exceeded {self.probe_timeout_s:.0f}s (pool unreachable)"
+            self.probe_timeout_s = min(self.probe_timeout_s, self.decay_timeout_s)
         entry = {
             "t_s": round(t_start, 1),
             "outcome": outcome,
@@ -238,6 +275,126 @@ class TpuProber:
     def history_snapshot(self) -> list[dict]:
         with self._lock:
             return list(self.history)
+
+
+# live phase subprocesses, killed by the crash handlers so a driver TERM
+# doesn't leave an orphan holding the TPU chip. Reaped entries are pruned
+# opportunistically at the next spawn.
+_LIVE_PROCS: "set[subprocess.Popen]" = set()
+
+
+def _tracked_popen(*args, **kwargs) -> subprocess.Popen:
+    for p in [p for p in _LIVE_PROCS if p.poll() is not None]:
+        _LIVE_PROCS.discard(p)
+    proc = subprocess.Popen(*args, **kwargs)
+    _LIVE_PROCS.add(proc)
+    return proc
+
+
+class ArtifactEmitter:
+    """Crash-proof artifact emission (VERDICT r3 next-round #1).
+
+    Holds the headline mining result + every optional phase's keys
+    (``extras``) and prints a COMPLETE artifact line on every
+    :meth:`checkpoint` — the driver parses the last JSON line on stdout,
+    so each print strictly supersedes the previous one. The leading
+    newline on checkpoint prints guarantees a fresh line even if a signal
+    interrupted a partial write. Thread-safe (the SIGTERM handler and the
+    main thread both emit); RLock because the handler can fire while the
+    main thread is mid-checkpoint.
+    """
+
+    def __init__(self, prober: TpuProber | None = None):
+        self._lock = threading.RLock()
+        self.prober = prober
+        self.platform: str | None = None
+        self.mining: dict | None = None
+        self.cpu_mining: dict | None = None
+        self.extras: dict = {}
+        self._finalized = False
+        self._last_printed: str | None = None
+
+    def set_headline(self, platform: str, mining: dict) -> None:
+        with self._lock:
+            self.platform = platform
+            self.mining = mining
+        self.checkpoint()
+
+    def set_cpu_comparison(self, cpu_mining: dict | None) -> None:
+        with self._lock:
+            self.cpu_mining = cpu_mining
+        self.checkpoint()
+
+    def compose(self, *, checkpoint: bool, note: str | None = None) -> dict | None:
+        with self._lock:
+            if self.mining is None:
+                return None  # nothing judgeable yet — never print a dud line
+            line = _headline_keys(self.platform, self.mining, self.cpu_mining)
+            line.update(self.extras)
+            if self.prober is not None:
+                line["probe_history"] = self.prober.history_snapshot()
+            if checkpoint:
+                line["checkpoint"] = True
+            if note:
+                line["aborted"] = note
+            return line
+
+    def checkpoint(self, note: str | None = None) -> None:
+        """Print the best-so-far artifact line (no-op before the headline
+        exists or after finalize)."""
+        with self._lock:
+            if self._finalized:
+                return
+            line = self.compose(checkpoint=True, note=note)
+            if line is None:
+                return
+            s = json.dumps(line)
+            if s == self._last_printed:
+                return
+            sys.stdout.write("\n" + s + "\n")
+            sys.stdout.flush()
+            self._last_printed = s
+
+    def finalize(self) -> bool:
+        """Print the final line (checkpoint flag absent). → False when no
+        headline was ever captured."""
+        with self._lock:
+            line = self.compose(checkpoint=False)
+            if line is None:
+                return False
+            sys.stdout.write("\n" + json.dumps(line) + "\n")
+            sys.stdout.flush()
+            self._finalized = True
+            return True
+
+
+def _install_crash_handlers(emitter: ArtifactEmitter) -> None:
+    """SIGTERM/SIGINT/atexit → flush the best-so-far line, kill live phase
+    subprocesses, exit. This is the mechanism that makes a driver kill at
+    ANY time after the first mining result still yield a parsed artifact."""
+    import atexit
+    import signal
+
+    def _flush(signum=None, frame=None):
+        emitter.checkpoint(
+            note=f"signal {signum} at t={_elapsed():.0f}s" if signum else None
+        )
+        for p in list(_LIVE_PROCS):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        if signum is not None:
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
+
+    atexit.register(_flush)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _flush)
+        except (ValueError, OSError):
+            pass  # non-main thread / exotic platform: atexit still covers
 
 
 _MINING_BENCH = r"""
@@ -339,9 +496,14 @@ if dev.platform == "tpu":
     # noise guard: a non-positive slope means the two timings were
     # indistinguishable — fall back to the blocked per-call median
     matmul_amortized_s = slope if slope > 0 else matmul_s
+    # the slope's raw inputs travel with the artifact so the MFU number is
+    # auditable (VERDICT r3 next-round #2)
+    chain_keys = {"chain_n1": N1, "chain_n2": N2,
+                  "chain_t_short_s": t_short, "chain_t_long_s": t_long}
     print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call "
           f"blocked, {matmul_amortized_s * 1e3:.3f}ms/iter from the "
-          f"{N2}-vs-{N1} chained-scan slope",
+          f"{N2}-vs-{N1} chained-scan slope "
+          f"(t({N1})={t_short:.4f}s, t({N2})={t_long:.4f}s)",
           file=sys.stderr, flush=True)
 else:
     # CPU: per-call cost (~1s) dwarfs dispatch overhead; a short async
@@ -351,6 +513,7 @@ else:
     rs = [support.pair_counts(x) for _ in range(N_AMORT)]
     jax.block_until_ready(rs)
     matmul_amortized_s = (time.perf_counter() - t0) / N_AMORT
+    chain_keys = {}
     print(f"isolated pair-count matmul: {matmul_s * 1e3:.3f}ms/call "
           f"blocked, {matmul_amortized_s * 1e3:.3f}ms amortized over "
           f"{N_AMORT}", file=sys.stderr, flush=True)
@@ -366,6 +529,7 @@ print(json.dumps({
     "device_kind": dev.device_kind,
     "platform": dev.platform,
     "count_path": result.count_path,
+    **chain_keys,
 }))
 """
 
@@ -624,7 +788,7 @@ def _run_phase(
     if extra_env:
         env.update(extra_env)
     for attempt in range(1, attempts + 1):
-        proc = subprocess.Popen(
+        proc = _tracked_popen(
             [sys.executable, "-c", code, *argv],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -833,7 +997,7 @@ def replay_phase(platform: str) -> dict | None:
                 "KMLS_BATCH_WINDOW_MS": "20",
                 "KMLS_BATCH_MAX_INFLIGHT": "8",
             })
-        server = subprocess.Popen(
+        server = _tracked_popen(
             [sys.executable, "-m", "kmlserver_tpu.serving.server"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=srv_env, cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -865,7 +1029,17 @@ def replay_phase(platform: str) -> dict | None:
                 for line in srv_lines[-10:]:
                     log(f"[replay-server] {line}")
                 return None
-            log(f"[replay] server ready at {url}; replaying {n_req} requests at {qps:.0f} QPS")
+            # median-of-N with an explicit warmup (VERDICT r3 weak #6: two
+            # same-host runs spread 6.3 vs 10.5 ms p50 — one number is
+            # luck; the mining phase already medians, now the replay does)
+            load1 = os.getloadavg()[0] if hasattr(os, "getloadavg") else -1.0
+            n_warm = int(os.environ.get("KMLS_BENCH_REPLAY_WARMUP", "1000"))
+            n_runs = int(os.environ.get("KMLS_BENCH_REPLAY_RUNS", "3"))
+            log(
+                f"[replay] server ready at {url}; host load1 {load1:.2f}; "
+                f"warmup {n_warm} requests, then {n_runs}x{n_req} at "
+                f"{qps:.0f} QPS"
+            )
             pickles = os.path.join(base, "pickles", "recommendations.pickle")
             client_env = None
             if platform == "tpu":
@@ -874,15 +1048,48 @@ def replay_phase(platform: str) -> dict | None:
                 # never caps what the batched server can absorb
                 client_env = {"KMLS_BENCH_REPLAY_WORKERS": "768",
                               "KMLS_BENCH_REPLAY_QUEUE": "4096"}
-            report = _run_phase(
-                "replay-client", _REPLAY_CLIENT,
-                [url, str(qps), str(n_req), pickles],
-                platform="cpu", timeout=600, extra_env=client_env,
-            )
-            if report is not None:
-                server_pcts = _scrape_server_percentiles(url)
-                if server_pcts:
-                    report["server_percentiles"] = server_pcts
+            if n_warm > 0:
+                _run_phase(
+                    "replay-warmup", _REPLAY_CLIENT,
+                    [url, str(qps), str(n_warm), pickles],
+                    platform="cpu", timeout=300, extra_env=client_env,
+                )
+            runs: list[dict] = []
+            for i in range(n_runs):
+                if runs and _remaining() < 120:
+                    log(
+                        f"[replay] deadline headroom gone after run {i}; "
+                        f"reporting the median of {len(runs)}"
+                    )
+                    break
+                r = _run_phase(
+                    "replay-client", _REPLAY_CLIENT,
+                    [url, str(qps), str(n_req), pickles],
+                    platform="cpu", timeout=600, extra_env=client_env,
+                )
+                if r is not None:
+                    log(
+                        f"[replay] run {i}: p50 {r['p50_ms']:.2f}ms, "
+                        f"{r['achieved_qps']:.0f} QPS, {r['n_errors']} errors"
+                    )
+                    runs.append(r)
+            if not runs:
+                return None
+            run_summaries = [  # chronological, travels with the artifact
+                {"p50_ms": round(r["p50_ms"], 3),
+                 "achieved_qps": round(r["achieved_qps"], 1),
+                 "n_errors": r["n_errors"]}
+                for r in runs
+            ]
+            report = sorted(runs, key=lambda r: r["p50_ms"])[len(runs) // 2]
+            report["runs"] = run_summaries
+            report["host_load1"] = round(load1, 2)
+            report["warmup_requests"] = n_warm
+            server_pcts = _scrape_server_percentiles(url)
+            if server_pcts:
+                # NOTE: the server's /metrics reservoir spans warmup + all
+                # runs; it is the steady-state server-side view
+                report["server_percentiles"] = server_pcts
             return report
         finally:
             server.terminate()
@@ -913,13 +1120,65 @@ def _mfu_keys(mining: dict, prefix: str = "mining") -> dict:
         )
     out[f"{prefix}_matmul_gops"] = round(ops / 1e9, 2)
     out[f"{prefix}_matmul_gops_per_s"] = round(achieved / 1e9, 1)
+    for key in ("chain_n1", "chain_n2", "chain_t_short_s", "chain_t_long_s"):
+        if key in mining:
+            out[f"{prefix}_{key}"] = (
+                round(mining[key], 6) if isinstance(mining[key], float)
+                else mining[key]
+            )
     kind = mining.get("device_kind", "").lower().replace(" ", "")
     for marker, peak in _INT8_PEAK_OPS.items():
         if marker in kind and mining.get("platform") == "tpu":
-            out[f"{prefix}_mfu_pct"] = round(100.0 * achieved / peak, 2)
+            mfu = 100.0 * achieved / peak
+            if mfu <= 100.0:
+                out[f"{prefix}_mfu_pct"] = round(mfu, 2)
+            else:
+                # physically impossible — the timing understates device
+                # time (r03 shipped 177% from overlapped dispatches through
+                # the tunnel). Flag at emission, never as a headline MFU.
+                out[f"{prefix}_mfu_pct_suspect"] = round(mfu, 2)
+                out[f"{prefix}_mfu_suspect_reason"] = (
+                    ">100% MFU is physically impossible: the matmul timing "
+                    "understates device time (overlapped dispatch/ack "
+                    "artifacts); see the *_chain_* keys for the raw "
+                    "slope inputs"
+                )
             out[f"{prefix}_mfu_peak_tops"] = round(peak / 1e12, 1)
             break
     return out
+
+
+def _headline_keys(
+    platform: str, mining: dict, cpu_mining: dict | None = None
+) -> dict:
+    """The artifact's headline block: metric/value/vs_baseline + MFU
+    accounting + (when the TPU took the headline over a CPU run) the CPU
+    comparison keys. Pure — the ONE assembly used by every checkpoint and
+    the final line, so partial and final artifacts can never disagree."""
+    median_s = mining["median_s"]
+    line = {
+        "metric": "fpgrowth_ds2_rule_generation_time",
+        "value": round(median_s, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_RULE_GEN_S / median_s, 1),
+        "platform": platform,
+    }
+    line.update(_mfu_keys(mining))
+    if mining.get("count_path"):
+        line["mining_count_path"] = mining["count_path"]
+    if cpu_mining is not None and cpu_mining is not mining:
+        # the TPU suite took the headline; keep the CPU evidence too,
+        # under unambiguous keys. Through this environment's tunnel the
+        # TPU bracket pays host<->device round trips, so the native CPU
+        # path can be FASTER — surface the best measured number explicitly
+        # rather than burying it.
+        line["mining_cpu_s"] = round(cpu_mining["median_s"], 4)
+        line.update(_mfu_keys(cpu_mining, prefix="mining_cpu"))
+        best_s = min(median_s, cpu_mining["median_s"])
+        line["best_mining_s"] = round(best_s, 4)
+        line["best_mining_platform"] = "tpu" if best_s == median_s else "cpu"
+        line["vs_baseline_best"] = round(BASELINE_RULE_GEN_S / best_s, 1)
+    return line
 
 
 def run_mining(
@@ -942,12 +1201,15 @@ def run_mining(
     return mining
 
 
-def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
+def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
     """The on-chip phases. → the TPU mining result (or None if mining
-    failed); optional phases fill `result` as deadline headroom allows."""
+    failed); optional phases fill the emitter's extras as deadline headroom
+    allows, checkpointing the artifact line after each."""
+    result = em.extras
     mining = run_mining("tpu", npz_path)
     if mining is None:
         return None
+    em.set_headline("tpu", mining)
 
     if _remaining() > 240:
         popcount = _run_phase(
@@ -978,6 +1240,7 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
                              ("mxu_words_per_s", "bitpack_mxu_words_per_s")):
                 if src in popcount:
                     result[dst] = round(popcount[src], 3)
+        em.checkpoint()
 
     if _remaining() > 300:
         # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
@@ -1004,12 +1267,15 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
             ):
                 if src in scale:
                     result[dst] = scale[src]
+        em.checkpoint()
 
     if _remaining() > 120:
         _record_serving(result, npz_path, "tpu")
+        em.checkpoint()
 
     if _remaining() > 240:
         _record_replay(result, "tpu")
+        em.checkpoint()
 
     if _remaining() > 300:
         # supplementary CPU replay: through this environment's remote-TPU
@@ -1022,16 +1288,29 @@ def run_tpu_suite(result: dict, npz_path: str) -> dict | None:
         _record_replay(cpu_replay, "cpu")
         for key, val in cpu_replay.items():
             result[f"cpu_{key}"] = val
+        em.checkpoint()
     return mining
 
 
-def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
+def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
     """Everything that doesn't need the chip, including CPU-labeled
     stand-ins for the config-4 popcount/scale evidence (VERDICT r2 #4:
     never ship a round with zero config-4 evidence)."""
+    result = em.extras
     mining = run_mining("cpu", npz_path)
     if mining is None:
         return None
+    em.set_headline("cpu", mining)
+
+    # serving + replay FIRST: config 5 is a judged BASELINE target; the
+    # scale/popcount stand-ins are supporting evidence and run after
+    if _remaining() > 120:
+        _record_serving(result, npz_path, "cpu")
+        em.checkpoint()
+
+    if _remaining() > 240:
+        _record_replay(result, "cpu")
+        em.checkpoint()
 
     if _remaining() > 180:
         # interpret-mode Pallas popcount at a small shape: proves the
@@ -1053,6 +1332,7 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
                 result["bitpack_mxu_cpu_compiled_ms"] = round(
                     popcount["mxu_ms"], 1
                 )
+        em.checkpoint()
 
     if _remaining() > 240:
         # config-4 mechanics on an 8-virtual-device dp mesh (sharded
@@ -1072,6 +1352,7 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             if "auto_mine_s" in scale:
                 result["scale_cpu_mesh8_auto_mine_s"] = scale["auto_mine_s"]
                 result["scale_cpu_mesh8_auto_path"] = scale["auto_path"]
+        em.checkpoint()
 
     if _remaining() > 180:
         # half-million-playlist mine through the NATIVE fallback (Apriori
@@ -1095,12 +1376,7 @@ def run_cpu_suite(result: dict, npz_path: str) -> dict | None:
             if "auto_mine_s" in scale_n:
                 result["scale_cpu_native_auto_mine_s"] = scale_n["auto_mine_s"]
                 result["scale_cpu_native_auto_path"] = scale_n["auto_path"]
-
-    if _remaining() > 120:
-        _record_serving(result, npz_path, "cpu")
-
-    if _remaining() > 240:
-        _record_replay(result, "cpu")
+        em.checkpoint()
     return mining
 
 
@@ -1150,6 +1426,13 @@ def _record_replay(result: dict, platform: str) -> None:
         replay_p99_ms=round(replay["p99_ms"], 3),
         replay_errors=replay["n_errors"],
     )
+    # median-of-N provenance: every run's summary + host conditions, so a
+    # single replay number is auditable instead of luck-dependent
+    for src, dst in (("runs", "replay_runs"),
+                     ("host_load1", "replay_host_load1"),
+                     ("warmup_requests", "replay_warmup_requests")):
+        if src in replay:
+            result[dst] = replay[src]
     server_pcts = replay.get("server_percentiles")
     if server_pcts:
         gap = replay["p50_ms"] - server_pcts.get("p50_ms", 0.0)
@@ -1164,6 +1447,9 @@ def _record_replay(result: dict, platform: str) -> None:
 
 def main() -> int:
     prober = TpuProber()
+    em = ArtifactEmitter(prober)
+    _install_crash_handlers(em)
+    result = em.extras
     if os.environ.get("KMLS_BENCH_CPU") == "1":  # debugging escape hatch
         log("KMLS_BENCH_CPU=1: skipping TPU, benching on CPU")
         prober.history.append({"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0})
@@ -1172,29 +1458,26 @@ def main() -> int:
         log("probing TPU backend (bounded)...")
         first = prober.probe_once()
 
-    platform = "tpu" if first == "tpu" else "cpu"
-    result: dict = {}
-    mining = cpu_mining = None
+    mining = None
     with tempfile.NamedTemporaryFile(suffix=".npz") as f:
-        if platform == "tpu":
-            mining = run_tpu_suite(result, f.name)
+        if first == "tpu":
+            mining = run_tpu_suite(em, f.name)
             if mining is None:
                 log(
                     "mining failed on TPU after retries — falling back to "
                     "CPU so the headline number is still captured"
                 )
-                platform = "cpu"
-                mining = cpu_mining = run_cpu_suite(result, f.name)
+                mining = run_cpu_suite(em, f.name)
             elif _remaining() > 180:
                 # cheap CPU comparison point (native POPCNT path) so every
                 # TPU artifact also carries the no-accelerator number —
                 # optional, so its timeout respects the deadline (the
                 # already-measured TPU headline must not be lost to a
                 # harness kill past DEADLINE_S)
-                cpu_mining = run_mining(
+                em.set_cpu_comparison(run_mining(
                     "cpu", f.name, attempts=1,
                     timeout=min(600, max(_remaining() - 30, 60)),
-                )
+                ))
         else:
             # CPU evidence first, re-probing the pool in the background the
             # whole time; if the pool comes back, the TPU suite runs too.
@@ -1202,7 +1485,7 @@ def main() -> int:
             # has no TPU platform — only hangs/errors are worth re-probing.)
             if first not in ("forced_cpu", "cpu_only"):
                 prober.start_background()
-            mining = cpu_mining = run_cpu_suite(result, f.name)
+            mining = run_cpu_suite(em, f.name)
 
             # keep waiting for the pool for as long as a minimal TPU mining
             # run still fits AND the prober is still probing (once it stops,
@@ -1226,9 +1509,14 @@ def main() -> int:
                 for key in list(result):
                     if key.startswith(("serving_", "replay_")):
                         result["cpu_" + key] = result.pop(key)
-                tpu_mining = run_tpu_suite(result, f.name)
+                # register the comparison BEFORE the suite: compose() keeps
+                # it suppressed while the CPU result still IS the headline
+                # (`is not mining` guard) and surfaces it the instant the
+                # TPU headline takes over — so a driver kill mid-suite
+                # can't lose the already-measured CPU evidence
+                em.set_cpu_comparison(mining)
+                tpu_mining = run_tpu_suite(em, f.name)
                 if tpu_mining is not None:
-                    platform = "tpu"
                     mining = tpu_mining
                 else:
                     # TPU mining failed → the line stays platform=cpu; put
@@ -1238,6 +1526,7 @@ def main() -> int:
                     for key in list(result):
                         if key.startswith(("cpu_serving_", "cpu_replay_")):
                             result[key[len("cpu_"):]] = result.pop(key)
+                    em.checkpoint()
             elif first != "forced_cpu":
                 log(
                     f"TPU never became reachable within the "
@@ -1250,35 +1539,7 @@ def main() -> int:
         log("FATAL: mining bench failed on every path; no number to report")
         return 1
 
-    median_s = mining["median_s"]
-    line = {
-        "metric": "fpgrowth_ds2_rule_generation_time",
-        "value": round(median_s, 4),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_RULE_GEN_S / median_s, 1),
-        "platform": platform,
-    }
-    line.update(_mfu_keys(mining))
-    if mining.get("count_path"):
-        line["mining_count_path"] = mining["count_path"]
-    if cpu_mining is not None and cpu_mining is not mining:
-        # the TPU suite took over the headline; keep the CPU evidence too,
-        # under unambiguous keys. Through this environment's tunnel the
-        # TPU bracket pays ~2 host<->device round trips, so the native CPU
-        # path can be FASTER — surface the best measured number explicitly
-        # rather than burying it.
-        line["mining_cpu_s"] = round(cpu_mining["median_s"], 4)
-        line.update(_mfu_keys(cpu_mining, prefix="mining_cpu"))
-        best_s = min(median_s, cpu_mining["median_s"])
-        line["best_mining_s"] = round(best_s, 4)
-        line["best_mining_platform"] = (
-            "tpu" if best_s == median_s else "cpu"
-        )
-        line["vs_baseline_best"] = round(BASELINE_RULE_GEN_S / best_s, 1)
-    line.update(result)
-    line["probe_history"] = prober.history_snapshot()
-    print(json.dumps(line))
-    return 0
+    return 0 if em.finalize() else 1
 
 
 if __name__ == "__main__":

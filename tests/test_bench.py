@@ -3,6 +3,7 @@ whose bugs would silently corrupt the judged JSON line (the bench itself is
 exercised end to end by the driver; these pin the derivations)."""
 
 import importlib.util
+import json
 import sys
 from pathlib import Path
 
@@ -142,3 +143,145 @@ class TestProbeHistory:
         assert snap == [{"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0}]
         snap.append("mutation")  # snapshot is a copy
         assert len(prober.history_snapshot()) == 1
+
+    def test_probe_timeout_decays_after_first_hang(self, monkeypatch):
+        # r03 burned ~24 min on six serial 240s probes against a pool that
+        # had already hung once; the decay caps every later probe at 60s
+        prober = bench.TpuProber(probe_timeout_s=1.0, interval_s=1.0)
+        prober.decay_timeout_s = 0.5
+        monkeypatch.setattr(bench, "_PROBE", "import time; time.sleep(30)")
+        assert prober.probe_once() == "hang"
+        assert prober.probe_timeout_s == 0.5
+
+
+class TestMfuClamp:
+    MINING_TPU = dict(TestMfuKeys.MINING_TPU)
+
+    def test_impossible_mfu_flagged_suspect_not_headline(self):
+        # r03 shipped mining_mfu_pct: 177.13 — physically impossible; now
+        # >100% lands under *_suspect with a reason, never as the MFU key
+        mining = dict(self.MINING_TPU, matmul_amortized_s=1e-9)
+        out = bench._mfu_keys(mining)
+        assert "mining_mfu_pct" not in out
+        assert out["mining_mfu_pct_suspect"] > 100.0
+        assert "physically impossible" in out["mining_mfu_suspect_reason"]
+        assert out["mining_mfu_peak_tops"] == 394.0
+
+    def test_plausible_mfu_unchanged(self):
+        out = bench._mfu_keys(dict(self.MINING_TPU, matmul_amortized_s=0.0001))
+        assert "mining_mfu_pct_suspect" not in out
+        assert 0 < out["mining_mfu_pct"] <= 100
+
+    def test_chain_slope_inputs_travel_with_the_artifact(self):
+        mining = dict(
+            self.MINING_TPU, chain_n1=16, chain_n2=1016,
+            chain_t_short_s=0.1234567891, chain_t_long_s=0.5,
+        )
+        out = bench._mfu_keys(mining)
+        assert out["mining_chain_n1"] == 16
+        assert out["mining_chain_n2"] == 1016
+        assert out["mining_chain_t_short_s"] == 0.123457  # rounded, auditable
+        assert out["mining_chain_t_long_s"] == 0.5
+
+
+class TestArtifactEmitter:
+    def test_silent_before_headline(self, capsys):
+        em = bench.ArtifactEmitter()
+        em.checkpoint()
+        assert capsys.readouterr().out == ""
+        assert em.finalize() is False  # never prints a dud line
+
+    def test_checkpoints_supersede_and_dedup(self, capsys):
+        em = bench.ArtifactEmitter()
+        em.set_headline("cpu", {"median_s": 2.0})  # prints checkpoint 1
+        em.extras["popcount_ds2_ms"] = 1.5
+        em.checkpoint()  # prints checkpoint 2
+        em.checkpoint()  # identical → deduped
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.strip()
+        ]
+        assert len(lines) == 2
+        assert all(ln["checkpoint"] is True for ln in lines)
+        assert lines[0]["value"] == 2.0
+        assert lines[0]["vs_baseline"] == round(20.31 / 2.0, 1)
+        assert lines[-1]["popcount_ds2_ms"] == 1.5
+
+    def test_finalize_drops_checkpoint_flag(self, capsys):
+        prober = bench.TpuProber(probe_timeout_s=1.0, interval_s=1.0)
+        prober.history.append({"t_s": 0.0, "outcome": "forced_cpu", "dur_s": 0.0})
+        em = bench.ArtifactEmitter(prober)
+        em.set_headline("tpu", {"median_s": 0.5})
+        assert em.finalize() is True
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.strip()
+        ]
+        final = lines[-1]
+        assert "checkpoint" not in final
+        assert final["platform"] == "tpu"
+        assert final["probe_history"][0]["outcome"] == "forced_cpu"
+        em.checkpoint()  # after finalize: silent
+        assert capsys.readouterr().out == ""
+
+    def test_cpu_comparison_keys(self, capsys):
+        em = bench.ArtifactEmitter()
+        em.set_headline("tpu", {"median_s": 0.8})
+        em.set_cpu_comparison({"median_s": 0.1})
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.strip()
+        ]
+        final = lines[-1]
+        assert final["mining_cpu_s"] == 0.1
+        assert final["best_mining_platform"] == "cpu"
+        assert final["vs_baseline_best"] == round(20.31 / 0.1, 1)
+
+
+class TestSigtermFlush:
+    def test_sigterm_mid_run_still_yields_parsed_artifact(self, tmp_path):
+        """The r03 failure mode, pinned: a driver kill AFTER the headline
+        exists but BEFORE the final print must still leave a parseable
+        artifact as the last stdout JSON line."""
+        import json as json_mod
+        import signal
+        import subprocess
+        import sys as sys_mod
+        import time as time_mod
+
+        bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+        code = f"""
+import importlib.util, sys, time
+spec = importlib.util.spec_from_file_location("kmls_bench", {str(bench_path)!r})
+bench = importlib.util.module_from_spec(spec)
+sys.modules["kmls_bench"] = bench
+spec.loader.exec_module(bench)
+em = bench.ArtifactEmitter()
+bench._install_crash_handlers(em)
+em.set_headline("cpu", {{"median_s": 1.5}})
+print("READY", file=sys.stderr, flush=True)
+time.sleep(60)  # simulates the stuck probe-wait the driver killed in r03
+"""
+        proc = subprocess.Popen(
+            [sys_mod.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            # wait for the headline checkpoint before killing
+            line = proc.stderr.readline()
+            assert "READY" in line
+            proc.send_signal(signal.SIGTERM)
+            stdout, _ = proc.communicate(timeout=30)
+        finally:
+            proc.kill()
+        json_lines = [
+            json_mod.loads(ln) for ln in stdout.splitlines() if ln.strip()
+        ]
+        assert json_lines, "no JSON on stdout after SIGTERM"
+        last = json_lines[-1]
+        assert last["value"] == 1.5
+        assert last["metric"] == "fpgrowth_ds2_rule_generation_time"
+        assert last["aborted"].startswith("signal ")
