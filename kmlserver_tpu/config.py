@@ -120,7 +120,12 @@ class MiningConfig:
     # Above this vocabulary size, prune infrequent items (exact, by the
     # Apriori property) before pair counting — the path that makes the
     # 1M-track configs feasible (a dense 1M x 1M count matrix is 4 TB).
-    prune_vocab_threshold: int = 4096
+    # Low by default: pruning is exact and pays at EVERY scale — it shrinks
+    # the matmul, the emission, and (the TPU bracket's floor through a
+    # tunneled link) the rule-tensor fetch, e.g. ds2's 2171 rows -> its 429
+    # frequent items. The threshold only spares tiny vocabularies the
+    # (trivial) host bincount.
+    prune_vocab_threshold: int = 512
     # Write the tensor-native artifact (rules npz) alongside the pickles.
     write_tensor_artifact: bool = True
     # On a CPU backend (no TPU reachable), count pair supports with the
@@ -162,7 +167,7 @@ class MiningConfig:
             bitpack_threshold_elems=_getenv_bitpack_threshold(),
             hbm_budget_bytes=_getenv_int("KMLS_HBM_BUDGET_BYTES", 12 * (1 << 30)),
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
-            prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 4096),
+            prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 512),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
             native_cpu_pair_counts=_getenv_bool("KMLS_NATIVE_PAIR_COUNTS", True),
         )

@@ -108,6 +108,34 @@ def bitpack_wanted(
                 + 8 * n_rows // max(n_devices, 1)
             )
             if dense_bytes > hbm_budget_bytes:
+                # the bitpack route is the fallback, not a guarantee: check
+                # ITS footprint too — bitset slab (word axis sharded over
+                # dp) + int32 counts with top-k scratch + one unpacked
+                # int8 slab (the mxu impl's per-scan-step intermediate) +
+                # membership operands — and warn loudly when NEITHER
+                # formulation fits, so an impending allocator failure is
+                # diagnosable before the opaque OOM (ADVICE r3)
+                from ..ops import popcount as pc
+
+                v_pad, w_pad = pc.padded_shape(n_tracks, n_playlists)
+                bitpack_bytes = (
+                    v_pad * w_pad * 4 // max(n_devices, 1)
+                    + 8 * v_pad * v_pad
+                    + v_pad * pc.WORD_CHUNK * 32
+                    + 8 * n_rows // max(n_devices, 1)
+                )
+                if bitpack_bytes > hbm_budget_bytes:
+                    print(
+                        "WARNING: neither the dense one-hot "
+                        f"(~{dense_bytes / (1 << 30):.1f} GiB) nor the "
+                        f"bit-packed path (~{bitpack_bytes / (1 << 30):.1f} "
+                        "GiB: bitset + counts + unpack slab) fits the "
+                        f"{hbm_budget_bytes / (1 << 30):.1f} GiB HBM budget "
+                        f"per device (x{max(n_devices, 1)}); proceeding "
+                        "bit-packed but expect an allocator failure — "
+                        "shard over more devices or raise min_support to "
+                        "shrink the frequent vocabulary"
+                    )
                 return True
             return (
                 backend is not None
@@ -431,6 +459,51 @@ def mine(
                 )
                 mined_baskets, _ = prune_infrequent(baskets, min_count)
                 pruned_vocab = mined_baskets.n_tracks
+            if mined_baskets.n_tracks == 0:
+                if baskets.n_tracks <= 4096:
+                    # nothing frequent, small vocab: fall back to the
+                    # unpruned vocabulary (emission finds no rules either
+                    # way) so no downstream shape is zero-sized
+                    mined_baskets = baskets
+                    pruned_vocab = None
+                else:
+                    # nothing frequent, LARGE vocab: restoring the full
+                    # vocabulary would re-create the infeasible shapes
+                    # pruning exists to avoid (a 1M-track dense count
+                    # matrix is 4 TB) just to discover an empty result —
+                    # emit it host-side for free instead
+                    k = cfg.k_max_consequents
+                    tensors = rules.RuleTensors(
+                        rule_ids=np.full((0, k), -1, np.int32),
+                        rule_counts=np.zeros((0, k), np.int32),
+                        rule_confs=np.zeros((0, k), np.float32),
+                        item_counts=np.zeros(0, np.int32),
+                        n_playlists=baskets.n_playlists,
+                        min_support=cfg.min_support,
+                        min_count=min_count,
+                        mode=cfg.confidence_mode,
+                        min_confidence=cfg.min_confidence,
+                        n_frequent_items=0,
+                        n_songs_missing=n_total,
+                        overflow_rows=0,
+                        row_valid_counts=np.zeros(0, np.int32),
+                    )
+                    census = (
+                        {length: 0 for length in
+                         range(1, cfg.max_itemset_len + 1)}
+                        if cfg.max_itemset_len >= 3 else None
+                    )
+                    return MiningResult(
+                        tensors=tensors,
+                        vocab_names=[],
+                        n_playlists=baskets.n_playlists,
+                        n_tracks=n_total,
+                        duration_s=time.perf_counter() - t0,
+                        pruned_vocab=0,
+                        itemset_census=census,
+                        phase_timings=dict(timer.phases),
+                        count_path="pruned-empty",
+                    )
         # the fused single-jit path (encode→matmul→emission, one compiled
         # program + one batched fetch) applies whenever no downstream step
         # needs the one-hot or count matrix on device: single-device dense
@@ -516,6 +589,20 @@ def mine(
                         n_tracks=mined_baskets.n_tracks,
                         k_max=cfg.k_max_consequents,
                     )
+                )
+                # the fused program compacts its outputs to int16 when the
+                # static shapes allow (ops/rules.py); upcast back to the
+                # int32 RuleTensors contract and log what actually crossed
+                # the link — the fetch is the TPU bracket's floor through
+                # a tunneled backend (VERDICT r3 next-round #4)
+                fetch_bytes = sum(a.nbytes for a in emitted)
+                print(
+                    f"Fused fetch: {fetch_bytes / 1e6:.3f} MB device->host "
+                    f"({mined_baskets.n_tracks}x{cfg.k_max_consequents} "
+                    f"rule tensors, {emitted[0].dtype}/{emitted[1].dtype})"
+                )
+                emitted = tuple(
+                    np.asarray(a, dtype=np.int32) for a in emitted
                 )
                 tensors = rules.assemble_rule_tensors(
                     *emitted,

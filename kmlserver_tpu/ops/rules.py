@@ -309,7 +309,18 @@ def fused_dense_rule_tensors(
     rule_ids, rule_counts, row_valid = emit_rule_tensors(
         counts, min_count, k_max=k_max
     )
-    return rule_ids, rule_counts, row_valid, jnp.diagonal(counts)
+    # compact the device→host transfer (VERDICT r3 next-round #4): ids and
+    # row sizes fit int16 whenever V ≤ 32767, counts whenever P ≤ 32767 —
+    # both static at trace time — halving the fetch through a tunneled
+    # backend. The host upcasts back to the int32 RuleTensors contract.
+    id_dt = jnp.int16 if n_tracks <= 32767 else jnp.int32
+    ct_dt = jnp.int16 if n_playlists <= 32767 else jnp.int32
+    return (
+        rule_ids.astype(id_dt),
+        rule_counts.astype(ct_dt),
+        row_valid.astype(id_dt),
+        jnp.diagonal(counts).astype(ct_dt),
+    )
 
 
 def emit_rule_tensors_np(

@@ -192,6 +192,30 @@ class TestRuleEmission:
                 assert other.tensors.overflow_rows == staged.tensors.overflow_rows
                 assert other.tensors.n_songs_missing == staged.tensors.n_songs_missing
 
+    def test_fused_fetch_is_compacted_to_int16(self, rng):
+        """When V and P fit int16 (static at trace time), the fused program
+        halves its device→host fetch by returning int16 tensors; the values
+        must survive the round trip exactly (upcast is the miner's job)."""
+        baskets = random_baskets(rng, n_playlists=40, n_tracks=12, mean_len=4)
+        b = build_baskets(table_from_baskets(baskets))
+        pr, ti = jnp.asarray(b.playlist_rows), jnp.asarray(b.track_ids)
+        out = rules.fused_dense_rule_tensors(
+            pr, ti, jnp.int32(2),
+            n_playlists=b.n_playlists, n_tracks=b.n_tracks, k_max=8,
+        )
+        assert all(a.dtype == jnp.int16 for a in out)
+        x = jnp.asarray(onehot_np(baskets, b.vocab))
+        counts = support.pair_counts(x)
+        exp_ids, exp_counts, exp_valid = (
+            np.asarray(a)
+            for a in rules.emit_rule_tensors(counts, jnp.int32(2), k_max=8)
+        )
+        got = [np.asarray(a, dtype=np.int32) for a in out]
+        np.testing.assert_array_equal(got[0], exp_ids)
+        np.testing.assert_array_equal(got[1], exp_counts)
+        np.testing.assert_array_equal(got[2], exp_valid)
+        np.testing.assert_array_equal(got[3], np.asarray(jnp.diagonal(counts)))
+
     def _assert_emitter_matches_jit(self, rng, emit_fn, label):
         """Tie-heavy matrices are the adversarial case for the composite-key
         trick: equal counts must rank by ascending index, like lax.top_k."""

@@ -66,6 +66,61 @@ class TestPrunedMining:
             == 50_000 - result.tensors.n_frequent_items
         )
 
+    def test_default_prune_matches_unpruned_above_threshold(self, rng):
+        """The DEFAULT config now prunes any vocabulary above ~512 items
+        (the fetch-floor shrink): output must stay identical to a run with
+        pruning disabled."""
+        baskets = synthetic_baskets(
+            n_playlists=300, n_tracks=700, target_rows=6000, seed=11
+        )
+        cfg = MiningConfig(min_support=0.02, k_max_consequents=16)
+        pruned = mine(baskets, cfg)
+        assert pruned.pruned_vocab is not None  # default threshold kicked in
+        plain = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.02, k_max_consequents=16,
+                prune_vocab_threshold=10**9,
+            ),
+        )
+        assert plain.pruned_vocab is None
+        assert (
+            pruned.tensors.to_rules_dict(pruned.vocab_names)
+            == plain.tensors.to_rules_dict(plain.vocab_names)
+        )
+        assert pruned.tensors.n_songs_missing == plain.tensors.n_songs_missing
+        assert pruned.tensors.n_frequent_items == plain.tensors.n_frequent_items
+
+    def test_prune_with_nothing_frequent_falls_back(self, rng):
+        """min_support so high nothing survives: the miner must not create
+        zero-sized device shapes — it falls back to the unpruned vocabulary
+        and emits the (empty) result."""
+        baskets = synthetic_baskets(
+            n_playlists=200, n_tracks=600, target_rows=3000, seed=13
+        )
+        result = mine(
+            baskets, MiningConfig(min_support=0.99, k_max_consequents=16)
+        )
+        assert result.pruned_vocab is None
+        assert result.tensors.to_rules_dict(result.vocab_names) == {}
+        assert result.tensors.n_frequent_items == 0
+
+    def test_prune_with_nothing_frequent_large_vocab_emits_empty(self):
+        """Large vocabulary, nothing frequent: the miner must NOT restore
+        the full (infeasible) vocabulary just to discover emptiness — it
+        emits the empty result host-side for free."""
+        baskets = synthetic_baskets(
+            n_playlists=500, n_tracks=50_000, target_rows=10_000, seed=17
+        )
+        result = mine(
+            baskets, MiningConfig(min_support=0.99, k_max_consequents=16)
+        )
+        assert result.count_path == "pruned-empty"
+        assert result.pruned_vocab == 0
+        assert result.tensors.to_rules_dict(result.vocab_names) == {}
+        assert result.tensors.n_songs_missing == 50_000
+        assert result.n_tracks == 50_000
+
     def test_prune_keeps_playlist_denominator(self, rng):
         baskets = build_baskets(
             table_from_baskets(
