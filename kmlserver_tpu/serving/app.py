@@ -84,7 +84,24 @@ class RecommendApp:
                 window_ms=cfg.batch_window_ms,
                 max_inflight=cfg.batch_max_inflight,
             )
-        with open(_TEMPLATE_PATH, "r", encoding="utf-8") as fh:
+        # template/static roots honor APP_PATH_FROM_ROOT like the reference
+        # (rest_api/app/main.py:44-48 resolves its template/static dirs from
+        # it; the static mount is :138): when that path carries
+        # templates/static directories they take precedence — a deployment
+        # can re-skin the client without rebuilding the image — else the
+        # package's bundled copies serve.
+        pkg_dir = os.path.dirname(__file__)
+        root = cfg.app_path_from_root or ""
+        template_path = _TEMPLATE_PATH
+        self.static_dir = os.path.abspath(os.path.join(pkg_dir, "static"))
+        if root:  # empty root must not probe CWD-relative paths
+            custom_template = os.path.join(root, "templates", "client.html")
+            if os.path.isfile(custom_template):
+                template_path = custom_template
+            custom_static = os.path.join(root, "static")
+            if os.path.isdir(custom_static):
+                self.static_dir = os.path.abspath(custom_static)
+        with open(template_path, "r", encoding="utf-8") as fh:
             self._template = fh.read()
 
     # ---------- routing ----------
@@ -116,7 +133,36 @@ class RecommendApp:
                     self.engine.reload_counter, self.engine.finished_loading
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
+            if path.startswith("/static/"):
+                return self._get_static(path[len("/static/"):])
         return _json_response(404, {"detail": "Not Found"})
+
+    _STATIC_TYPES = {
+        ".css": "text/css; charset=utf-8",
+        ".js": "text/javascript; charset=utf-8",
+        ".html": "text/html; charset=utf-8",
+        ".json": "application/json",
+        ".svg": "image/svg+xml",
+        ".png": "image/png",
+        ".ico": "image/x-icon",
+    }
+
+    def _get_static(self, rel: str) -> Response:
+        """Static assets under the resolved static root — the reference's
+        ``/static`` mount (rest_api/app/main.py:138). Paths are confined to
+        the root (no traversal)."""
+        full = os.path.normpath(os.path.join(self.static_dir, rel))
+        if not full.startswith(self.static_dir + os.sep):
+            return _json_response(404, {"detail": "Not Found"})
+        try:
+            with open(full, "rb") as fh:
+                data = fh.read()
+        except (OSError, IsADirectoryError):
+            return _json_response(404, {"detail": "Not Found"})
+        ctype = self._STATIC_TYPES.get(
+            os.path.splitext(full)[1].lower(), "application/octet-stream"
+        )
+        return 200, {"Content-Type": ctype}, data
 
     # ---------- endpoints ----------
 
@@ -338,32 +384,46 @@ def make_handler(app: RecommendApp):
         disable_nagle_algorithm = True
 
         def _dispatch(self, method: str) -> None:
-            body = None
-            if method == "POST":
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
+            # in-flight accounting for the SIGTERM drain: the settle in
+            # serving.server exits as soon as this hits zero (idle
+            # keep-alive connections sit BETWEEN requests and are rightly
+            # not counted — the drain must not wait on them)
+            track = hasattr(self.server, "active_lock")
+            if track:
+                with self.server.active_lock:
+                    self.server.active_requests += 1
             try:
-                status, headers, payload = app.handle(method, self.path, body)
-            except Exception:
-                logger.exception("unhandled error for %s %s", method, self.path)
-                app.metrics.record_error()
-                status, headers, payload = 500, {"Content-Type": "application/json"}, (
-                    b'{"detail": "Internal Server Error"}'
-                )
-            self.send_response(status)
-            for key, value in headers.items():
-                self.send_header(key, value)
-            self.send_header("Content-Length", str(len(payload)))
-            # during a SIGTERM drain (server.draining set by serving.server)
-            # tell keep-alive clients to re-connect elsewhere — k8s endpoint
-            # removal only diverts NEW connections, established flows would
-            # otherwise keep sending to the terminating pod until cut off
-            drain = getattr(self.server, "draining", None)
-            if drain is not None and drain.is_set():
-                self.send_header("Connection", "close")
-                self.close_connection = True
-            self.end_headers()
-            self.wfile.write(payload)
+                body = None
+                if method == "POST":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                try:
+                    status, headers, payload = app.handle(method, self.path, body)
+                except Exception:
+                    logger.exception("unhandled error for %s %s", method, self.path)
+                    app.metrics.record_error()
+                    status, headers, payload = 500, {"Content-Type": "application/json"}, (
+                        b'{"detail": "Internal Server Error"}'
+                    )
+                self.send_response(status)
+                for key, value in headers.items():
+                    self.send_header(key, value)
+                self.send_header("Content-Length", str(len(payload)))
+                # during a SIGTERM drain (server.draining set by
+                # serving.server) tell keep-alive clients to re-connect
+                # elsewhere — k8s endpoint removal only diverts NEW
+                # connections, established flows would otherwise keep
+                # sending to the terminating pod until cut off
+                drain = getattr(self.server, "draining", None)
+                if drain is not None and drain.is_set():
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(payload)
+            finally:
+                if track:
+                    with self.server.active_lock:
+                        self.server.active_requests -= 1
 
         def do_GET(self) -> None:  # noqa: N802 (stdlib API)
             self._dispatch("GET")
@@ -381,6 +441,14 @@ class _Server(ThreadingHTTPServer):
     # stdlib default listen backlog is 5 — QPS-scale bursts get connection-
     # refused before a handler thread ever sees them
     request_queue_size = 256
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # in-flight request count, read by the SIGTERM drain settle
+        import threading
+
+        self.active_requests = 0
+        self.active_lock = threading.Lock()
 
 
 def serve(app: RecommendApp, port: int | None = None) -> ThreadingHTTPServer:

@@ -57,9 +57,12 @@ def main() -> int:
     # serve_forever (it must run OFF the serving thread or it deadlocks);
     # (3) server_close() immediately closes the LISTENING socket so racing
     # connects get an instant refusal (not a backlog-then-RST after the
-    # settle); (4) a short bounded settle lets in-flight responses
-    # (ms-scale) finish — handler threads are daemonic and idle keep-alive
-    # connections can block forever, so joining them is not an option.
+    # settle); (4) a bounded settle lets in-flight responses finish —
+    # handler threads are daemonic and idle keep-alive connections can
+    # block forever, so joining them is not an option; instead the settle
+    # polls the server's in-flight counter and exits the moment it reaches
+    # zero, bounded by KMLS_DRAIN_SETTLE_S (set it to match the pod's
+    # terminationGracePeriodSeconds minus a safety margin).
     draining = threading.Event()
     server.draining = draining  # handlers read this (app.make_handler)
 
@@ -79,7 +82,28 @@ def main() -> int:
     finally:
         server.server_close()  # listening socket closed BEFORE the settle
         if draining.is_set():
-            time.sleep(2.0)  # bounded settle for in-flight responses
+            import os
+
+            settle_s = float(os.getenv("KMLS_DRAIN_SETTLE_S") or 2.0)
+            t_settle = time.monotonic()
+            deadline = t_settle + settle_s
+            # floor before the zero-exit: a connection accepted just before
+            # shutdown has a handler thread that may not have reached the
+            # counter increment yet — an instant first-poll zero would kill
+            # it mid-parse (the floor covers accept→dispatch scheduling)
+            floor = t_settle + min(0.5, settle_s)
+            while time.monotonic() < deadline:
+                with server.active_lock:
+                    if server.active_requests == 0 and time.monotonic() >= floor:
+                        break
+                time.sleep(0.05)
+            else:
+                log.warning(
+                    "drain settle expired after %.1fs with %d requests "
+                    "still in flight (raise KMLS_DRAIN_SETTLE_S to match "
+                    "terminationGracePeriodSeconds)",
+                    settle_s, server.active_requests,
+                )
     return 0
 
 

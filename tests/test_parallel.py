@@ -64,14 +64,19 @@ def test_unknown_impl_raises(baskets):
 
 
 @pytest.mark.parametrize("shape", ["8x1", "4x1", "2x1"])
-def test_sharded_bitpack_matches_single_device(baskets, shape):
-    """dp-sharded Pallas popcount slabs (interpret mode on CPU) must agree
-    exactly with the dense single-device kernel."""
+@pytest.mark.parametrize("impl", ["mxu", "vpu"])
+def test_sharded_bitpack_matches_single_device(baskets, shape, impl):
+    """BOTH dp-sharded bit-packed impls — the MXU unpack-matmul (the
+    production default; interpret is ignored, it is pure XLA) and the
+    Pallas VPU kernel (interpret mode on CPU) — must agree exactly with
+    the dense single-device kernel on every mesh shape."""
     from kmlserver_tpu.parallel.support import sharded_bitpack_pair_counts
 
     devices = jax.devices()[: int(shape.split("x")[0])]
     m = mesh_mod.make_mesh(shape, devices=devices)
-    got = np.asarray(sharded_bitpack_pair_counts(baskets, m, interpret=True))
+    got = np.asarray(
+        sharded_bitpack_pair_counts(baskets, m, impl=impl, interpret=True)
+    )
     np.testing.assert_array_equal(got, single_device_counts(baskets))
 
 

@@ -450,6 +450,46 @@ class TestAppRouting:
             if srv.poll() is None:
                 srv.kill()
 
+    def test_static_mount_serves_client_stylesheet(self, app):
+        """Parity with the reference's static mount
+        (rest_api/app/main.py:138): /static serves the bundled assets and
+        the client page references them."""
+        status, headers, payload = app.handle("GET", "/static/style.css", None)
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/css")
+        assert b"color-scheme" in payload
+        status, _, html = app.handle("GET", "/", None)
+        assert status == 200 and b"/static/style.css" in html
+
+    def test_static_rejects_traversal_and_missing(self, app):
+        assert app.handle(
+            "GET", "/static/../templates/client.html", None
+        )[0] == 404
+        assert app.handle("GET", "/static/nope.css", None)[0] == 404
+        assert app.handle("GET", "/static/", None)[0] == 404
+
+    def test_app_path_from_root_overrides_template_and_static(self, tmp_path):
+        """APP_PATH_FROM_ROOT is live config, not a dead knob (the
+        reference resolves its template/static dirs from it,
+        rest_api/app/main.py:44-48): a deployment-provided directory
+        re-skins the client without rebuilding the image."""
+        (tmp_path / "templates").mkdir()
+        (tmp_path / "static").mkdir()
+        (tmp_path / "templates" / "client.html").write_text(
+            "<html><body>CUSTOM {{version}}</body></html>"
+        )
+        (tmp_path / "static" / "brand.css").write_text("body{}")
+        app = RecommendApp(
+            ServingConfig(
+                base_dir=str(tmp_path), app_path_from_root=str(tmp_path)
+            )
+        )
+        status, _, html = app.handle("GET", "/", None)
+        assert status == 200 and b"CUSTOM" in html
+        assert app.handle("GET", "/static/brand.css", None)[0] == 200
+        # the bundled stylesheet is NOT visible through the override root
+        assert app.handle("GET", "/static/style.css", None)[0] == 404
+
     def test_metrics(self, app):
         self._post(app, {"songs": ["whatever"]})
         status, _, payload = app.handle("GET", "/metrics", None)
