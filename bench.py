@@ -32,12 +32,15 @@ run on the chip. Every probe (timestamp, outcome, duration) is recorded in
 the JSON line as ``probe_history``, so a CPU-only artifact PROVES the pool
 was down for the whole window rather than just at t=0.
 
-Phases (tpu suite): mining (headline, + an isolated MXU matmul timing with
-closed-form op counts → MFU), popcount (compiled Pallas kernel, counts
+Phases (tpu suite), in priority order for a short pool window: mining
+(headline, + an isolated MXU matmul timing with closed-form op counts →
+MFU via the chained-scan slope), serving (batch-32 p50), replay (full
+stack at 1k QPS, median of N runs, server-side /metrics percentiles next
+to the client-observed ones), popcount (compiled Pallas kernel, counts
 asserted equal on-device, words/s emitted), scale (1M×100k config-4
-mechanics), serving (batch-32 p50), replay (full stack at 1k QPS, with
-server-side /metrics percentiles recorded next to the client-observed
-ones).
+mechanics), config4-devicegen (TRUE 10M×1M shape, workload born in HBM
+as a Bernoulli-Zipf bitset), sweep (the reference's 68-point support
+grid, count-once).
 Phases (cpu suite): mining, popcount stand-in (interpret mode, small
 shape), scale stand-in (20k×5k on an 8-virtual-device mesh), serving,
 replay — all keys labeled ``*_cpu*``.
@@ -730,6 +733,48 @@ sys.argv = ["scale_demo"] + sys.argv[1:]
 runpy.run_path("scripts/scale_demo.py", run_name="__main__")
 """
 
+# BASELINE config 4 (10M×1M) with the workload born in HBM as a
+# Bernoulli-Zipf bitset (scripts/config4_tpu.py --device-gen): no host
+# generation, no bulk transfer — viable inside a short pool window
+_CONFIG4_BENCH = r"""
+import runpy, sys
+sys.argv = ["config4_tpu"] + sys.argv[1:]
+runpy.run_path("scripts/config4_tpu.py", run_name="__main__")
+"""
+
+# the reference's 68-point support sweep (machine-learning/main.py:450-473
+# grid) through the count-once harness, on-device
+_SWEEP_BENCH = r"""
+import json, os, sys, tempfile, time
+import numpy as np
+import jax
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.sweep import run_sweep
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+with tempfile.TemporaryDirectory() as base:
+    csv = os.path.join(base, "2023_spotify_ds2.csv")
+    write_tracks_csv(csv, synthetic_table(**DS2_SHAPE, seed=123))
+    cfg = MiningConfig(base_dir=base, datasets_dir=base)
+    supports = np.arange(0.03, 0.2, 0.0025)  # the reference grid
+    t0 = time.perf_counter()
+    records = run_sweep(cfg, supports, dataset=csv)
+    total_s = time.perf_counter() - t0
+emission_s = sum(r["duration_s"] for r in records)
+print(json.dumps({
+    "points": len(records),
+    "total_s": round(total_s, 3),
+    "emission_total_s": round(emission_s, 3),
+    "setup_plus_count_s": round(total_s - emission_s, 3),
+    "missing_at_min_support": records[0]["missing_songs"],
+    "missing_at_max_support": records[-1]["missing_songs"],
+    "platform": dev.platform,
+}))
+"""
+
 _CSV_SETUP = r"""
 import sys
 from kmlserver_tpu.data.csv import write_tracks_csv
@@ -1211,6 +1256,17 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         return None
     em.set_headline("tpu", mining)
 
+    # serving + replay directly after the headline: config 5 is a judged
+    # BASELINE target and the pool window may be short — the supporting
+    # phases (popcount/scale/config4/sweep) run after
+    if _remaining() > 120:
+        _record_serving(result, npz_path, "tpu")
+        em.checkpoint()
+
+    if _remaining() > 300:
+        _record_replay(result, "tpu")
+        em.checkpoint()
+
     if _remaining() > 240:
         popcount = _run_phase(
             "popcount", _POPCOUNT_BENCH,
@@ -1269,12 +1325,42 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
                     result[dst] = scale[src]
         em.checkpoint()
 
-    if _remaining() > 120:
-        _record_serving(result, npz_path, "tpu")
+    if _remaining() > 300:
+        # TRUE config-4 shape (10M playlists × 1M tracks) on the single
+        # chip, workload generated in HBM (Bernoulli-Zipf bitset — zero
+        # host generation or transfer); compare CONFIG4_CPU_r03.json's
+        # 77.8 s one-core bracket
+        config4 = _run_phase(
+            "config4-devicegen", _CONFIG4_BENCH, ["--device-gen"],
+            platform="tpu", timeout=min(900, _remaining()),
+        )
+        if config4 is not None:
+            for src, dst in (
+                ("mine_s", "config4_mine_s"),
+                ("mine_cold_s", "config4_mine_cold_s"),
+                ("gen_device_s", "config4_gen_device_s"),
+                ("rows_per_s", "config4_rows_per_s"),
+                ("frequent_items", "config4_frequent_items"),
+                ("n_rules", "config4_n_rules"),
+                ("bitset_gib", "config4_bitset_gib"),
+                ("workload_model", "config4_workload_model"),
+                ("rows_measured", "config4_rows_measured"),
+            ):
+                if src in config4:
+                    result[dst] = config4[src]
         em.checkpoint()
 
-    if _remaining() > 240:
-        _record_replay(result, "tpu")
+    if _remaining() > 180:
+        # the reference's full 68-point support sweep, count-once, on-chip
+        sweep = _run_phase(
+            "sweep", _SWEEP_BENCH, [], platform="tpu",
+            timeout=min(600, _remaining()),
+        )
+        if sweep is not None:
+            result["sweep_points"] = sweep["points"]
+            result["sweep_total_s"] = sweep["total_s"]
+            result["sweep_emission_total_s"] = sweep["emission_total_s"]
+            result["sweep_setup_plus_count_s"] = sweep["setup_plus_count_s"]
         em.checkpoint()
 
     if _remaining() > 300:

@@ -2,22 +2,31 @@
 """BASELINE.json config 4 on a single TPU chip: 10M playlists × 1M tracks,
 500M membership rows, mined EXACTLY through the bit-packed path.
 
-The lean sibling of ``scripts/scale_demo.py`` for opportunistic runs
-against a flaky remote pool: generation + prune + exactly TWO mine()
-calls (cold, then warm), no auto/device-resident extras — at this shape
-every extra mine re-pays a multi-GB host→device transfer through the
-tunnel. ``CONFIG4_CPU_r03.json`` documents the same shape on one CPU core
-(77.8 s); this script produces the TPU twin.
+Two modes:
 
-HBM budget at the default shape (v5e, 16 GiB): bitset
-(8192 × 312832 words) ≈ 9.56 GiB + pruned membership operands ≈ 2×1.4 GiB
-+ (F_pad)² int32 counts ≈ 0.26 GiB + an unpacked slab ≈ 0.13 GiB. The
-MXU unpack-matmul impl (KMLS_BITPACK_IMPL=mxu, the default) carries the
-contraction: ≈1.3·10¹⁵ int8 ops ≈ 3.4 s at the chip's 394 TOPS peak.
+- default (host generation): the lean sibling of ``scripts/scale_demo.py``
+  — host generation (~645 s at this shape) + prune + exactly TWO mine()
+  calls (cold, then warm); every extra mine re-pays a multi-GB
+  host→device transfer through the tunnel. HBM at the default shape
+  (v5e, 16 GiB): bitset (8192 × 312832 words) ≈ 9.56 GiB + pruned
+  membership operands ≈ 2×1.4 GiB + (F_pad)² int32 counts ≈ 0.26 GiB +
+  an unpacked slab ≈ 0.13 GiB.
+- ``--device-gen``: the workload is born IN HBM as a Bernoulli-Zipf
+  bitset (data/device_synthetic.py) — no host generation, no prune step
+  (the Apriori cut is analytic), no bulk transfer; generation takes
+  seconds on device, so the whole config fits an opportunistic pool
+  window. HBM: bitset ≈ 9.56 GiB + ~2.6 GiB transient uniforms during
+  generation + counts/slab as above.
+
+Either way the MXU unpack-matmul impl carries the contraction:
+≈1.3·10¹⁵ int8 ops ≈ 3.4 s at the chip's 394 TOPS peak.
+``CONFIG4_CPU_r03.json`` documents the same shape on one CPU core
+(77.8 s); this script produces the TPU twin.
 
 Prints one JSON line (stdout); narrative on stderr. Exits 3 off-TPU
 unless --allow-cpu (the CPU artifact already exists — rerunning it here
-just burns ~15 min).
+just burns ~15 min), and refuses shapes whose XLA:CPU contraction would
+take hours even then.
 """
 
 from __future__ import annotations
@@ -50,6 +59,13 @@ def main() -> int:
         "--skip-warm", action="store_true",
         help="stop after the cold mine (half the tunnel transfers)",
     )
+    parser.add_argument(
+        "--device-gen", action="store_true",
+        help="generate the workload ON DEVICE as a Bernoulli-Zipf bitset "
+        "(data/device_synthetic.py): no host generation (645 s at this "
+        "shape), no host->device bulk transfer — the config-4 mechanics "
+        "timed with zero tunnel involvement",
+    )
     args = parser.parse_args()
 
     import jax
@@ -61,16 +77,19 @@ def main() -> int:
             log("not a TPU backend (CONFIG4_CPU_r03.json already covers "
                 "CPU); pass --allow-cpu to run anyway")
             return 3
-        # off-TPU the only carrier that finishes in minutes is the native
-        # POPCNT counter; without it the miner would take the bitset-mxu
-        # route, which is memory-safe but ~10¹⁵ int8 ops on XLA:CPU
-        # (hours) — refuse rather than wedge the session
-        from kmlserver_tpu.ops import cpu_popcount
+        # off-TPU the host-gen path's only carrier that finishes in
+        # minutes is the native POPCNT counter; without it the miner would
+        # take the bitset-mxu route, which is memory-safe but ~10¹⁵ int8
+        # ops on XLA:CPU (hours) — refuse rather than wedge the session.
+        # (--device-gen never uses the native library; its own shape-based
+        # guard lives in run_device_gen.)
+        if not args.device_gen:
+            from kmlserver_tpu.ops import cpu_popcount
 
-        if not cpu_popcount.available():
-            log("native pair-count library unavailable; the XLA:CPU bitset "
-                "route would take hours at this shape — refusing")
-            return 3
+            if not cpu_popcount.available():
+                log("native pair-count library unavailable; the XLA:CPU "
+                    "bitset route would take hours at this shape — refusing")
+                return 3
 
     import numpy as np
 
@@ -79,6 +98,9 @@ def main() -> int:
     from kmlserver_tpu.mining.miner import mine, prune_infrequent
     from kmlserver_tpu.ops import popcount as pc
     from kmlserver_tpu.ops.support import min_count_for
+
+    if args.device_gen:
+        return run_device_gen(args, dev)
 
     t0 = time.perf_counter()
     baskets = synthetic_baskets(
@@ -158,6 +180,110 @@ def main() -> int:
         out["prune_plus_mine_s"] = round(prune_s + result_w.duration_s, 3)
 
     print(json.dumps(out))
+    return 0
+
+
+def run_device_gen(args, dev) -> int:
+    """Config 4 with the workload born in HBM: Bernoulli-Zipf bitset
+    generation on device (exact-by-construction set semantics, analytic
+    Apriori candidate cut — data/device_synthetic.py), then the production
+    counting + emission paths. The mine bracket (counts + emission) is the
+    apples-to-apples twin of CONFIG4_CPU's count+emit phases; generation
+    is timed separately like the host path's excluded 645 s."""
+    import numpy as np
+
+    from kmlserver_tpu.data.device_synthetic import (
+        candidate_frequent_count, device_synthetic_bitset, zipf_bit_probs,
+    )
+    from kmlserver_tpu.ops import popcount as pc
+    from kmlserver_tpu.ops import rules as rules_mod
+    from kmlserver_tpu.ops.support import min_count_for
+
+    min_count = min_count_for(args.min_support, args.playlists)
+    if dev.platform != "tpu":
+        # shape guard: the unpack-matmul is ~2·P·F² int8 ops; past ~10¹²
+        # XLA:CPU needs many minutes and the default shape needs hours —
+        # refuse instead of wedging (small smoke shapes pass)
+        f_est = candidate_frequent_count(
+            zipf_bit_probs(args.tracks, args.playlists, args.rows),
+            args.playlists, min_count,
+        )
+        est_ops = 2.0 * args.playlists * f_est * f_est
+        if est_ops > 1e12:
+            log(f"--device-gen on a CPU backend at this shape needs "
+                f"~{est_ops:.1e} int8 ops on XLA:CPU (hours) — refusing; "
+                "use a smaller --playlists/--tracks/--rows for smoke runs")
+            return 3
+    t0 = time.perf_counter()
+    bitset, f_cand, info = device_synthetic_bitset(
+        args.playlists, args.tracks, args.rows, min_count, seed=args.seed
+    )
+    bitset.block_until_ready()
+    gen_cold_s = time.perf_counter() - t0
+    log(
+        f"device-gen bitset: {info['v_pad']}x{info['w_pad']} uint32 "
+        f"({info['bitset_bytes'] / (1 << 30):.2f} GiB), {f_cand:,} "
+        f"candidate-frequent tracks of {args.tracks:,} "
+        f"(analytic cut at {info['margin_sigmas']:.0f} sigma), expected "
+        f"{info['expected_rows_total']:,.0f} memberships model-wide — "
+        f"generated in {gen_cold_s:.2f}s on device (cold)"
+    )
+
+    def mine_bracket():
+        t = time.perf_counter()
+        counts = pc.mxu_pair_counts_padded(bitset)
+        counts.block_until_ready()
+        count_s = time.perf_counter() - t
+        t = time.perf_counter()
+        mined = rules_mod.mine_rules_from_counts(
+            counts, n_playlists=args.playlists,
+            min_support=args.min_support, k_max=args.k_max,
+            n_total_songs=args.tracks,
+        )
+        emit_s = time.perf_counter() - t
+        return counts, mined, count_s, emit_s
+
+    counts, mined, count_s, emit_s = mine_bracket()
+    n_rules = int((np.asarray(mined.rule_ids) >= 0).sum())
+    measured_rows = int(mined.item_counts.astype(np.int64).sum())
+    log(
+        f"mine[cold]: counts {count_s:.2f}s + emission {emit_s:.2f}s; "
+        f"{mined.n_frequent_items:,} empirically frequent items, "
+        f"{n_rules:,} rules; {measured_rows:,} candidate memberships "
+        "measured on device"
+    )
+    out = {
+        "playlists": args.playlists,
+        "tracks": args.tracks,
+        "rows": round(info["expected_rows_total"]),
+        "rows_basis": "expected-model-rows (bernoulli-zipf); "
+        "candidate memberships measured on device in rows_measured",
+        "rows_measured": measured_rows,
+        "min_support": args.min_support,
+        "workload_model": info["model"],
+        "candidate_tracks": f_cand,
+        "frequent_items": mined.n_frequent_items,
+        "bitset_gib": round(info["bitset_bytes"] / (1 << 30), 3),
+        "gen_device_s": round(gen_cold_s, 3),
+        "mine_cold_s": round(count_s + emit_s, 3),
+        "count_cold_s": round(count_s, 3),
+        "emit_cold_s": round(emit_s, 3),
+        "n_rules": n_rules,
+        "count_path": "bitpack-mxu-devicegen",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+    print(json.dumps(out), flush=True)  # checkpoint before the warm pass
+
+    if not args.skip_warm:
+        del counts
+        _, _, count_w, emit_w = mine_bracket()
+        out["mine_s"] = round(count_w + emit_w, 3)
+        out["count_s"] = round(count_w, 3)
+        out["emit_s"] = round(emit_w, 3)
+        out["rows_per_s"] = round(info["expected_rows_total"] / (count_w + emit_w), 1)
+        log(f"mine[warm]: counts {count_w:.2f}s + emission {emit_w:.2f}s")
+        print(json.dumps(out))
     return 0
 
 

@@ -1,0 +1,106 @@
+"""Device-resident Bernoulli-Zipf workload generation (config-4 data born
+in HBM as a bitset — data/device_synthetic.py). Small shapes on the CPU
+backend; the same jitted code runs at 10M×1M on the chip."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmlserver_tpu.data.device_synthetic import (
+    bitset_from_probs, candidate_frequent_count, device_synthetic_bitset,
+    zipf_bit_probs,
+)
+from kmlserver_tpu.ops import popcount as pc
+from kmlserver_tpu.ops import rules
+from kmlserver_tpu.ops.encode import unpack_bits
+
+from .oracle import reference_fast_rules
+
+
+def _unpack_memberships(bitset: np.ndarray, f: int, n_playlists: int) -> np.ndarray:
+    """(f, n_playlists) 0/1 membership matrix from the packed rows.
+    int32: unpack_bits returns int8 and a numpy int8 matmul overflows."""
+    return (
+        np.asarray(unpack_bits(jnp.asarray(bitset)))[:f, :n_playlists]
+        .astype(np.int32)
+    )
+
+
+class TestBitsetGeneration:
+    P, V, ROWS = 800, 96, 6000
+
+    def _generate(self, min_count=1, seed=5):
+        return device_synthetic_bitset(
+            self.P, self.V, self.ROWS, min_count, seed=seed
+        )
+
+    def test_deterministic_and_pad_clean(self):
+        b1, f, info = self._generate()
+        b2, _, _ = self._generate()
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+        # pad ROWS (beyond the candidate set) must be all-zero
+        assert not np.asarray(b1)[f:].any()
+        # pad BITS (beyond n_playlists) must be all-zero — phantom
+        # playlists would silently inflate every count
+        x = np.asarray(unpack_bits(jnp.asarray(b1)))
+        assert not x[:, self.P:].any()
+        assert x[:, : self.P].any()
+
+    def test_empirical_counts_track_expectation(self):
+        bitset, f, info = self._generate()
+        q = zipf_bit_probs(self.V, self.P, self.ROWS)
+        counts = _unpack_memberships(np.asarray(bitset), f, self.P).sum(axis=1)
+        expect = self.P * q[:f]
+        sigma = np.sqrt(np.maximum(expect * (1 - q[:f]), 1.0))
+        assert (np.abs(counts - expect) < 6 * sigma).all()
+        # and the analytic total-rows accounting is consistent
+        assert info["expected_rows_candidates"] == pytest.approx(
+            expect.sum()
+        )
+
+    def test_candidate_cut_superset_of_empirically_frequent(self):
+        """Apriori-exactness: generate the FULL vocabulary, then check that
+        every empirically-frequent track lies inside the analytic
+        candidate prefix the production run would have generated."""
+        min_count = 40
+        bitset, f_all, _ = self._generate(min_count=1, seed=9)
+        assert f_all == self.V  # everything generated at min_count=1
+        counts = _unpack_memberships(np.asarray(bitset), f_all, self.P).sum(axis=1)
+        q = zipf_bit_probs(self.V, self.P, self.ROWS)
+        f_cut = candidate_frequent_count(q, self.P, min_count)
+        frequent = np.flatnonzero(counts >= min_count)
+        assert frequent.size == 0 or frequent.max() < f_cut
+
+    def test_counts_and_rules_exact_vs_oracle(self):
+        """End to end: device-generated bitset → MXU unpack-matmul counts →
+        rule emission must equal the brute-force reference rules computed
+        from the SAME memberships, unpacked on host."""
+        min_support = 0.03
+        min_count = int(np.ceil(min_support * self.P))
+        bitset, f, _ = self._generate(min_count=min_count, seed=7)
+        counts = pc.mxu_pair_counts_padded(jnp.asarray(bitset))
+        x = _unpack_memberships(np.asarray(bitset), f, self.P)
+        # exact counting on this operand
+        np.testing.assert_array_equal(
+            np.asarray(counts)[:f, :f], (x @ x.T).astype(np.int32)
+        )
+        names = [f"t{i:04d}" for i in range(np.asarray(counts).shape[0])]
+        mined = rules.mine_rules_from_counts(
+            counts, n_playlists=self.P, min_support=min_support,
+            k_max=128, n_total_songs=self.V,  # > V: no row truncation here
+        )
+        got = mined.to_rules_dict(names)
+        baskets = [
+            [names[t] for t in np.flatnonzero(x[:, p])]
+            for p in range(self.P)
+        ]
+        assert got == reference_fast_rules(baskets, min_support)
+        assert mined.n_songs_missing == self.V - mined.n_frequent_items
+
+    def test_row_block_must_divide(self):
+        with pytest.raises(ValueError, match="multiple of row_block"):
+            bitset_from_probs(
+                jnp.zeros(128, jnp.float32), 0,
+                n_playlists=64, v_pad=128, w_pad=pc.padded_shape(8, 64)[1],
+                row_block=48,
+            )
