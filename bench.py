@@ -943,6 +943,18 @@ def _run_phase(
                 else f"compute failed on {platform}"
             )
         )
+        # salvage like the timeout path: a phase that checkpointed partial
+        # JSON before crashing (config4's cold line, scale_demo's section
+        # lines) still contributes — the unloseable-artifact rule applies
+        # to phase results too, not only the top-level line
+        stdout = "".join(stdout_parts)
+        for line in reversed(stdout.strip().splitlines()):
+            try:
+                salvaged = json.loads(line)
+            except ValueError:
+                continue
+            log(f"{name} phase failed but a checkpoint was salvaged")
+            return salvaged
         return None
     return None
 
@@ -1339,6 +1351,8 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
                 ("mine_s", "config4_mine_s"),
                 ("mine_cold_s", "config4_mine_cold_s"),
                 ("gen_device_s", "config4_gen_device_s"),
+                ("rows", "config4_rows"),
+                ("rows_basis", "config4_rows_basis"),
                 ("rows_per_s", "config4_rows_per_s"),
                 ("frequent_items", "config4_frequent_items"),
                 ("n_rules", "config4_n_rules"),

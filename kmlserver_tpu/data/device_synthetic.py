@@ -71,8 +71,15 @@ def candidate_frequent_count(
 ) -> int:
     """How many (Zipf-descending) tracks clear the analytic candidate cut
     ``P·q_t ≥ min_count − margin·sqrt(min_count)``. Every track outside is
-    empirically infrequent with probability ≥ 1 − exp(−margin²/2)."""
-    cut = max(min_count - margin_sigmas * np.sqrt(max(min_count, 1)), 1.0)
+    empirically infrequent with probability ≥ 1 − exp(−margin²/2).
+
+    The σ bound only separates when ``min_count > margin² (+1)``; below
+    that the margin swallows the threshold and ANY track with q > 0 could
+    be empirically frequent — then every such track is a candidate
+    (smoke shapes only; production min_counts are in the thousands)."""
+    cut = min_count - margin_sigmas * np.sqrt(max(min_count, 1))
+    if cut <= 1.0:
+        return int((q > 0).sum())
     return int(np.searchsorted(-(q * n_playlists), -cut, side="right"))
 
 

@@ -134,6 +134,21 @@ class TestRunPhaseWatchdog:
         )
         assert out == {"ok": 2}
 
+    def test_nonzero_exit_salvages_last_json_checkpoint(self):
+        """A phase that checkpoints partial JSON then crashes (config4's
+        cold line before a warm-pass tunnel drop) must still contribute
+        its checkpoint — salvage is not timeout-only."""
+        code = (
+            "import sys\n"
+            "print('{\"partial\": 1}')\n"
+            "print('not json trailing output')\n"
+            "sys.exit(1)\n"
+        )
+        out = bench._run_phase(
+            "salvage-test", code, [], platform="cpu", timeout=30, attempts=1
+        )
+        assert out == {"partial": 1}
+
 
 class TestProbeHistory:
     def test_forced_cpu_history_shape(self):

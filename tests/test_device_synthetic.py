@@ -61,15 +61,26 @@ class TestBitsetGeneration:
     def test_candidate_cut_superset_of_empirically_frequent(self):
         """Apriori-exactness: generate the FULL vocabulary, then check that
         every empirically-frequent track lies inside the analytic
-        candidate prefix the production run would have generated."""
-        min_count = 40
+        candidate prefix the production run would have generated. The
+        min_count is chosen so the σ cut actually separates (cut > 1 and
+        f_cut < V) — otherwise the assertion is vacuous."""
+        min_count = 120  # > margin² = 64, so the σ bound is in force
         bitset, f_all, _ = self._generate(min_count=1, seed=9)
         assert f_all == self.V  # everything generated at min_count=1
         counts = _unpack_memberships(np.asarray(bitset), f_all, self.P).sum(axis=1)
         q = zipf_bit_probs(self.V, self.P, self.ROWS)
         f_cut = candidate_frequent_count(q, self.P, min_count)
+        assert 0 < f_cut < self.V, f"cut not separating (f_cut={f_cut})"
         frequent = np.flatnonzero(counts >= min_count)
-        assert frequent.size == 0 or frequent.max() < f_cut
+        assert frequent.size > 0  # and some tracks really are frequent
+        assert frequent.max() < f_cut
+
+    def test_candidate_cut_includes_everything_at_tiny_min_count(self):
+        """Below min_count ≈ margin² the σ bound cannot separate: every
+        track with q > 0 must be a candidate, or the exactness contract
+        is silently void at smoke shapes."""
+        q = zipf_bit_probs(self.V, self.P, self.ROWS)
+        assert candidate_frequent_count(q, self.P, 40) == self.V
 
     def test_counts_and_rules_exact_vs_oracle(self):
         """End to end: device-generated bitset → MXU unpack-matmul counts →
