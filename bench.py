@@ -816,6 +816,26 @@ print(report.to_json())
 STARTUP_GRACE_S = float(os.environ.get("KMLS_BENCH_STARTUP_GRACE_S", "240"))
 
 
+def _salvage_checkpoint(
+    stdout_parts: list[str], name: str, reason: str
+) -> dict | None:
+    """Last parseable JSON DICT on a phase's stdout (phases checkpoint
+    complete dicts; a bare scalar — e.g. a line truncated by a kill — must
+    not be returned, callers assume dict). The ONE copy of this parse for
+    the success, timeout, and crash paths."""
+    stdout = "".join(stdout_parts)
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            salvaged = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(salvaged, dict):
+            if reason:
+                log(f"{name} phase {reason} but a checkpoint was salvaged")
+            return salvaged
+    return None
+
+
 def _run_phase(
     name: str,
     code: str,
@@ -911,22 +931,12 @@ def _run_phase(
             # no retry (a hang already burned budget once) — but salvage
             # the last checkpoint JSON the phase printed before the kill
             # (scale_demo checkpoints after every completed section)
-            stdout = "".join(stdout_parts)
-            for line in reversed(stdout.strip().splitlines()):
-                try:
-                    salvaged = json.loads(line)
-                except ValueError:
-                    continue
-                log(f"{name} phase timed out but a checkpoint was salvaged")
-                return salvaged
-            return None
+            return _salvage_checkpoint(stdout_parts, name, "timed out")
         if proc.returncode == 0:
-            stdout = "".join(stdout_parts)
-            try:
-                return json.loads(stdout.strip().splitlines()[-1])
-            except (IndexError, ValueError) as exc:
-                log(f"{name} phase produced unparseable output: {exc}")
-                return None
+            result = _salvage_checkpoint(stdout_parts, name, "")
+            if result is None:
+                log(f"{name} phase produced no parseable result dict")
+            return result
         kind = _classify(stderr_text, timed_out=False)
         if kind == "transient" and attempt < attempts:
             log(
@@ -947,15 +957,7 @@ def _run_phase(
         # JSON before crashing (config4's cold line, scale_demo's section
         # lines) still contributes — the unloseable-artifact rule applies
         # to phase results too, not only the top-level line
-        stdout = "".join(stdout_parts)
-        for line in reversed(stdout.strip().splitlines()):
-            try:
-                salvaged = json.loads(line)
-            except ValueError:
-                continue
-            log(f"{name} phase failed but a checkpoint was salvaged")
-            return salvaged
-        return None
+        return _salvage_checkpoint(stdout_parts, name, "failed")
     return None
 
 

@@ -104,24 +104,33 @@ def expand_rules_dict(
     (triple-antecedent merge: per-rule denominators), the stored float64
     confidences are used verbatim instead of re-deriving from counts."""
     min_count = min_count_for(min_support, n_playlists)
+    # infrequent items are not keys (reference main.py:284 loop); all the
+    # vectorized work below touches ONLY the frequent rows — with pruning
+    # disabled at large V the full (V, K_max) float64 temporary would be
+    # gigabytes for rows that are never expanded
+    freq_rows = np.flatnonzero(item_counts >= min_count)
+    if rule_confs64 is not None:
+        conf_rows = rule_confs64[freq_rows]
+    elif mode == "support":
+        # IEEE-identical to the reference's per-entry int(c)/P float
+        # division (int32 counts are exactly representable in float64),
+        # vectorized — the expansion is inside the timed mining bracket
+        conf_rows = rule_counts[freq_rows] / float(n_playlists)
+    else:
+        conf_rows = rule_counts[freq_rows] / np.maximum(
+            item_counts[freq_rows], 1
+        )[:, None].astype(np.float64)
+    ids_rows = rule_ids[freq_rows]
+    valid_rows = ids_rows >= 0
     out: dict[str, dict[str, float]] = {}
-    for i, name in enumerate(vocab_names):
-        denom_i = int(item_counts[i])
-        if denom_i < min_count:
-            continue  # infrequent item: not a key (reference main.py:284 loop)
-        ids, counts = rule_ids[i], rule_counts[i]
-        valid = ids >= 0
-        if rule_confs64 is not None:
-            out[name] = {
-                vocab_names[int(j)]: float(c)
-                for j, c in zip(ids[valid], rule_confs64[i][valid])
-            }
-            continue
-        denom = n_playlists if mode == "support" else denom_i
-        out[name] = {
-            vocab_names[int(j)]: int(c) / denom
-            for j, c in zip(ids[valid], counts[valid])
-        }
+    for k, i in enumerate(freq_rows.tolist()):
+        v = valid_rows[k]
+        out[vocab_names[i]] = dict(
+            zip(
+                (vocab_names[j] for j in ids_rows[k][v].tolist()),
+                conf_rows[k][v].tolist(),
+            )
+        )
     return out
 
 

@@ -149,6 +149,21 @@ class TestRunPhaseWatchdog:
         )
         assert out == {"partial": 1}
 
+    def test_salvage_skips_non_dict_json_lines(self):
+        """A bare scalar is valid JSON but not a checkpoint (e.g. a line
+        truncated by a kill): salvage must skip past it to the last DICT
+        — returning a scalar would TypeError in every consumer."""
+        code = (
+            "import sys\n"
+            "print('{\"partial\": 2}')\n"
+            "print('42')\n"  # valid JSON, not a checkpoint
+            "sys.exit(1)\n"
+        )
+        out = bench._run_phase(
+            "salvage-test", code, [], platform="cpu", timeout=30, attempts=1
+        )
+        assert out == {"partial": 2}
+
 
 class TestProbeHistory:
     def test_forced_cpu_history_shape(self):
