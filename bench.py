@@ -300,11 +300,14 @@ class ArtifactEmitter:
     Holds the headline mining result + every optional phase's keys
     (``extras``) and prints a COMPLETE artifact line on every
     :meth:`checkpoint` — the driver parses the last JSON line on stdout,
-    so each print strictly supersedes the previous one. The leading
-    newline on checkpoint prints guarantees a fresh line even if a signal
-    interrupted a partial write. Thread-safe (the SIGTERM handler and the
-    main thread both emit); RLock because the handler can fire while the
-    main thread is mid-checkpoint.
+    so each print strictly supersedes the previous one. Signal-handler
+    emissions (``note`` set) are prefixed with a newline so they land on
+    a fresh line even if the signal interrupted the main thread
+    mid-write; normal checkpoints don't need it (the emitter is the only
+    stdout writer in this process), keeping the captured stream valid
+    line-per-record JSONL. Thread-safe (the SIGTERM handler and the main
+    thread both emit); RLock because the handler can fire while the main
+    thread is mid-checkpoint.
     """
 
     def __init__(self, prober: TpuProber | None = None):
@@ -354,7 +357,7 @@ class ArtifactEmitter:
             s = json.dumps(line)
             if s == self._last_printed:
                 return
-            sys.stdout.write("\n" + s + "\n")
+            sys.stdout.write(("\n" if note else "") + s + "\n")
             sys.stdout.flush()
             self._last_printed = s
 
@@ -365,7 +368,7 @@ class ArtifactEmitter:
             line = self.compose(checkpoint=False)
             if line is None:
                 return False
-            sys.stdout.write("\n" + json.dumps(line) + "\n")
+            sys.stdout.write(json.dumps(line) + "\n")
             sys.stdout.flush()
             self._finalized = True
             return True
@@ -824,15 +827,25 @@ def _salvage_checkpoint(
     not be returned, callers assume dict). The ONE copy of this parse for
     the success, timeout, and crash paths."""
     stdout = "".join(stdout_parts)
+    skipped = 0
     for line in reversed(stdout.strip().splitlines()):
         try:
             salvaged = json.loads(line)
         except ValueError:
+            skipped += 1
             continue
         if isinstance(salvaged, dict):
             if reason:
                 log(f"{name} phase {reason} but a checkpoint was salvaged")
+            elif skipped:
+                # clean exit but the LAST line wasn't the result: say so —
+                # an earlier checkpoint may be missing later keys
+                log(
+                    f"{name} phase: result taken {skipped} line(s) above "
+                    "an unparseable stdout tail"
+                )
             return salvaged
+        skipped += 1
     return None
 
 
