@@ -83,6 +83,48 @@ def candidate_frequent_count(
     return int(np.searchsorted(-(q * n_playlists), -cut, side="right"))
 
 
+def _scan_bernoulli_words(
+    keys: jax.Array,  # (n_blocks, key)
+    q_blocks: jax.Array,  # (n_blocks, row_block)
+    valid: jax.Array,  # (w_width, 32) uint32 — 1 where the bit position is real
+    *,
+    row_block: int,
+    w_width: int,
+) -> jax.Array:
+    """The ONE generator core (single-device and per-shard): scan over row
+    blocks, each drawing Bernoulli bits and packing 32/word. The scan
+    bounds the transient uniform buffer to ``row_block × w_width × 32``
+    floats while the packed output accumulates at 1/32 of that.
+    → ``(n_blocks·row_block, w_width) uint32``."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def step(carry, args):
+        key, qb = args  # (row_block,)
+        u = jax.random.uniform(key, (row_block, w_width, 32))
+        bits = (u < qb[:, None, None]).astype(jnp.uint32) * valid[None]
+        words = jnp.sum(  # distinct powers of two: the sum IS the OR
+            bits << shifts, axis=-1, dtype=jnp.uint32
+        )
+        return carry, words
+
+    _, blocks = jax.lax.scan(step, None, (keys, q_blocks))
+    return blocks.reshape(-1, w_width)
+
+
+def _position_mask(
+    word_offset, w_width: int, n_playlists: int
+) -> jax.Array:
+    """(w_width, 32) uint32: 1 where global bit position
+    ``(word_offset + w)·32 + b`` is a real playlist — word padding beyond
+    ``n_playlists`` must stay zero or it counts as phantom playlists."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    positions = (
+        (word_offset + jnp.arange(w_width, dtype=jnp.uint32))[:, None] * 32
+        + shifts[None, :]
+    )
+    return (positions < n_playlists).astype(jnp.uint32)
+
+
 @partial(jax.jit, static_argnames=("n_playlists", "v_pad", "w_pad", "row_block"))
 def bitset_from_probs(
     q_padded: jax.Array,  # (v_pad,) float32; 0 for pad rows
@@ -95,35 +137,77 @@ def bitset_from_probs(
 ) -> jax.Array:
     """Generate the ``(v_pad, w_pad)`` uint32 bitset: bit p of word
     ``[t, p // 32]`` ~ Bernoulli(q_padded[t]) for p < n_playlists, all
-    independent; bit positions beyond ``n_playlists`` (word padding) stay
-    zero — they would otherwise count as phantom playlists. A scan over
-    row blocks bounds the transient uniform buffer to
-    ``row_block × w_pad × 32`` floats while the packed output accumulates
-    at 1/32 of that."""
+    independent; bit positions beyond ``n_playlists`` stay zero."""
     if v_pad % row_block:
         raise ValueError(f"v_pad {v_pad} must be a multiple of row_block {row_block}")
     n_blocks = v_pad // row_block
     keys = jax.random.split(jax.random.PRNGKey(seed), n_blocks)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    # (w_pad, 32) uint32 mask: bit position w·32+b is a real playlist
-    positions = (
-        jnp.arange(w_pad, dtype=jnp.uint32)[:, None] * 32 + shifts[None, :]
+    return _scan_bernoulli_words(
+        keys,
+        q_padded.reshape(n_blocks, row_block),
+        _position_mask(jnp.uint32(0), w_pad, n_playlists),
+        row_block=row_block,
+        w_width=w_pad,
     )
-    valid = (positions < n_playlists).astype(jnp.uint32)
 
-    def step(carry, args):
-        key, qb = args  # (row_block,)
-        u = jax.random.uniform(key, (row_block, w_pad, 32))
-        bits = (u < qb[:, None, None]).astype(jnp.uint32) * valid[None]
-        words = jnp.sum(  # distinct powers of two: the sum IS the OR
-            bits << shifts, axis=-1, dtype=jnp.uint32
+
+def sharded_bitset_from_probs(
+    q_padded: jax.Array,  # (v_pad,) float32; 0 for pad rows
+    seed: int,
+    mesh,
+    *,
+    n_playlists: int,
+    v_pad: int,
+    w_pad: int,
+    row_block: int = 32,
+) -> jax.Array:
+    """Multi-chip twin of :func:`bitset_from_probs`: the bitset is born
+    ALREADY word-axis-dp-sharded — each chip generates only its own
+    ``w_pad/dp`` slab (PRNG keys folded by shard index, bit positions
+    masked against the slab's global offset), so no chip ever holds or
+    communicates another's slab. Feed the result to
+    ``parallel.support.counts_from_sharded_bitset`` for psum'd counts —
+    BASELINE config 4 on a v5e-4 with zero host involvement."""
+    import jax.sharding as jsh
+
+    from ..parallel.mesh import AXIS_DP, AXIS_TP
+
+    if mesh.shape.get(AXIS_TP, 1) > 1:
+        raise ValueError(
+            f"sharded_bitset_from_probs needs a dp-only (Nx1) mesh, got "
+            f"{dict(mesh.shape)}"
         )
-        return carry, words
+    dp = mesh.shape[AXIS_DP]
+    if w_pad % dp:
+        raise ValueError(f"w_pad {w_pad} must divide over dp={dp}")
+    w_local = w_pad // dp
+    if v_pad % row_block:
+        raise ValueError(
+            f"v_pad {v_pad} must be a multiple of row_block {row_block}"
+        )
+    n_blocks = v_pad // row_block
 
-    _, blocks = jax.lax.scan(
-        step, None, (keys, q_padded.reshape(n_blocks, row_block))
-    )
-    return blocks.reshape(v_pad, w_pad)
+    def shard_gen(q_full: jax.Array) -> jax.Array:
+        shard = jax.lax.axis_index(AXIS_DP)
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), shard)
+        return _scan_bernoulli_words(
+            jax.random.split(base, n_blocks),
+            q_full.reshape(n_blocks, row_block),
+            # mask against THIS slab's global word offset
+            _position_mask(
+                (shard * w_local).astype(jnp.uint32), w_local, n_playlists
+            ),
+            row_block=row_block,
+            w_width=w_local,
+        )
+
+    spec = jsh.PartitionSpec
+    return jax.jit(
+        jax.shard_map(
+            shard_gen, mesh=mesh, in_specs=spec(),
+            out_specs=spec(None, AXIS_DP),
+        )
+    )(q_padded)
 
 
 def device_synthetic_bitset(
@@ -136,11 +220,14 @@ def device_synthetic_bitset(
     seed: int = 0,
     row_block: int = 32,
     margin_sigmas: float = CANDIDATE_MARGIN_SIGMAS,
+    mesh=None,
 ) -> tuple[jax.Array, int, dict]:
     """Full device-side workload: → ``(bitset (v_pad, w_pad) uint32,
     n_candidates, info)``. ``info`` carries the analytic accounting
     (expected total rows over the FULL vocabulary incl. never-generated
-    infrequent tracks, the candidate cut, HBM bytes)."""
+    infrequent tracks, the candidate cut, HBM bytes). With ``mesh`` (a
+    dp-only Nx1 mesh) the bitset is born word-axis-sharded, each chip
+    generating only its slab."""
     from ..ops import popcount as pc
 
     q = zipf_bit_probs(n_tracks, n_playlists, target_rows, zipf_exponent)
@@ -153,10 +240,19 @@ def device_synthetic_bitset(
     v_pad, w_pad = pc.padded_shape(f, n_playlists)
     q_padded = np.zeros(v_pad, dtype=np.float32)
     q_padded[:f] = q[:f]
-    bitset = bitset_from_probs(
-        jnp.asarray(q_padded), seed, n_playlists=n_playlists,
-        v_pad=v_pad, w_pad=w_pad, row_block=row_block,
-    )
+    if mesh is not None:
+        from ..parallel.mesh import AXIS_DP, round_up
+
+        w_pad = round_up(w_pad, mesh.shape[AXIS_DP] * pc.WORD_CHUNK)
+        bitset = sharded_bitset_from_probs(
+            jnp.asarray(q_padded), seed, mesh, n_playlists=n_playlists,
+            v_pad=v_pad, w_pad=w_pad, row_block=row_block,
+        )
+    else:
+        bitset = bitset_from_probs(
+            jnp.asarray(q_padded), seed, n_playlists=n_playlists,
+            v_pad=v_pad, w_pad=w_pad, row_block=row_block,
+        )
     info = {
         "model": "bernoulli-zipf",
         "expected_rows_total": float(n_playlists * q.sum()),

@@ -27,6 +27,7 @@ pad P to a multiple of dp and V to a multiple of tp with zero rows/columns
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -153,14 +154,8 @@ def sharded_bitpack_pair_counts(
             f"sharded_bitpack_pair_counts needs a dp-only (Nx1) mesh, got "
             f"{dict(mesh.shape)}; flatten devices onto dp first"
         )
-    impl = pc.resolve_counts_impl(impl)
-    if impl == "vpu":
-        # kernel opts are the VPU kernel's business only — resolving them
-        # on the mxu branch would let an irrelevant KMLS_POPCOUNT_* value
-        # crash a path that never reads it
-        if interpret is None:
-            interpret = jax.default_backend() != "tpu"
-        variant, swar = pc.resolve_kernel_opts(variant, swar)
+    # impl/kernel-opt resolution happens in counts_from_sharded_bitset
+    # (the ONE copy of that gating)
     dp = mesh.shape[AXIS_DP]
     v = baskets.n_tracks
     v_pad = round_up(max(v, pc.V_TILE), pc.V_TILE)
@@ -179,6 +174,48 @@ def sharded_bitpack_pair_counts(
         jnp.asarray(baskets.playlist_rows), jnp.asarray(baskets.track_ids)
     )
 
+    return counts_from_sharded_bitset(
+        bt, mesh, impl=impl, interpret=interpret, variant=variant, swar=swar
+    )[:v, :v]
+
+
+def counts_from_sharded_bitset(
+    bt: jax.Array,
+    mesh: Mesh,
+    impl: str | None = None,
+    interpret: bool | None = None,
+    variant: str | None = None,
+    swar: bool | None = None,
+) -> jax.Array:
+    """Pair counts from an ALREADY word-axis-dp-sharded padded bitset
+    ``(v_pad, w_pad) uint32``: each chip counts its slab, partials
+    ``psum`` over ICI. The compute core of
+    :func:`sharded_bitpack_pair_counts`, exposed for callers whose bitset
+    never existed as membership pairs (device-side workload generation,
+    data/device_synthetic.py). Returns the full padded ``(v_pad, v_pad)``
+    counts (replicated)."""
+    from ..ops import popcount as pc
+
+    if mesh.shape.get(AXIS_TP, 1) > 1:
+        raise ValueError(
+            f"counts_from_sharded_bitset needs a dp-only (Nx1) mesh, got "
+            f"{dict(mesh.shape)}; flatten devices onto dp first"
+        )
+    impl = pc.resolve_counts_impl(impl)
+    if impl == "vpu":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        variant, swar = pc.resolve_kernel_opts(variant, swar)
+    return _sharded_counts_fn(mesh, impl, interpret, variant, swar)(bt)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_counts_fn(mesh, impl, interpret, variant, swar):
+    """Cached jitted program per (mesh, impl, kernel opts): rebuilding the
+    jit(shard_map(...)) closure per call would retrace + recompile every
+    invocation — a warm pass would silently pay full compile time."""
+    from ..ops import popcount as pc
+
     def local(bt_local: jax.Array) -> jax.Array:
         if impl == "mxu":
             # per-shard blocked unpack-matmul (pure XLA — composes under
@@ -190,7 +227,7 @@ def sharded_bitpack_pair_counts(
             )
         return jax.lax.psum(c, AXIS_DP)
 
-    counts = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local, mesh=mesh, in_specs=P(None, AXIS_DP),
             out_specs=P(None, None),
@@ -198,8 +235,7 @@ def sharded_bitpack_pair_counts(
             # psum makes the output mesh-invariant, checked by the tests
             check_vma=False,
         )
-    )(bt)
-    return counts[:v, :v]
+    )
 
 
 def sharded_pair_counts(

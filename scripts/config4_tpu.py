@@ -66,6 +66,12 @@ def main() -> int:
         "shape), no host->device bulk transfer — the config-4 mechanics "
         "timed with zero tunnel involvement",
     )
+    parser.add_argument(
+        "--mesh", default="none",
+        help="device-gen only: 'none' = single chip; 'auto' or 'DPx1' = "
+        "each chip births its own word slab, counts psum over ICI "
+        "(the v5e-4 path)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -214,9 +220,17 @@ def run_device_gen(args, dev) -> int:
                 f"~{est_ops:.1e} int8 ops on XLA:CPU (hours) — refusing; "
                 "use a smaller --playlists/--tracks/--rows for smoke runs")
             return 3
+    mesh = None
+    if args.mesh != "none":
+        from kmlserver_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh)
+        log(f"mesh: {dict(mesh.shape)} ({mesh.devices.size} devices) — "
+            "each chip births its own word slab")
     t0 = time.perf_counter()
     bitset, f_cand, info = device_synthetic_bitset(
-        args.playlists, args.tracks, args.rows, min_count, seed=args.seed
+        args.playlists, args.tracks, args.rows, min_count, seed=args.seed,
+        mesh=mesh,
     )
     bitset.block_until_ready()
     gen_cold_s = time.perf_counter() - t0
@@ -229,9 +243,22 @@ def run_device_gen(args, dev) -> int:
         f"generated in {gen_cold_s:.2f}s on device (cold)"
     )
 
+    # the sharded path resolves its counting impl from KMLS_BITPACK_IMPL;
+    # resolve it HERE too so the emitted count_path label cannot lie about
+    # which kernel the timings belong to (the single-chip branch is
+    # hardcoded mxu)
+    counts_impl = pc.resolve_counts_impl() if mesh is not None else "mxu"
+
     def mine_bracket():
         t = time.perf_counter()
-        counts = pc.mxu_pair_counts_padded(bitset)
+        if mesh is not None:
+            from kmlserver_tpu.parallel.support import (
+                counts_from_sharded_bitset,
+            )
+
+            counts = counts_from_sharded_bitset(bitset, mesh, impl=counts_impl)
+        else:
+            counts = pc.mxu_pair_counts_padded(bitset)
         counts.block_until_ready()
         count_s = time.perf_counter() - t
         t = time.perf_counter()
@@ -269,7 +296,11 @@ def run_device_gen(args, dev) -> int:
         "count_cold_s": round(count_s, 3),
         "emit_cold_s": round(emit_s, 3),
         "n_rules": n_rules,
-        "count_path": "bitpack-mxu-devicegen",
+        "count_path": (
+            f"bitpack-{counts_impl}-devicegen"
+            + ("-sharded" if mesh is not None else "")
+        ),
+        "mesh": args.mesh,
         "platform": dev.platform,
         "device_kind": dev.device_kind,
     }

@@ -108,6 +108,53 @@ class TestBitsetGeneration:
         assert got == reference_fast_rules(baskets, min_support)
         assert mined.n_songs_missing == self.V - mined.n_frequent_items
 
+    def test_sharded_generation_counts_exact(self):
+        """Config 4 on a mesh with zero host involvement: each chip
+        generates its own word slab; psum'd counts must equal brute-force
+        counts of the generated memberships, and pad bits/rows stay
+        clean across every slab boundary."""
+        import jax
+
+        from kmlserver_tpu.parallel.mesh import make_mesh
+        from kmlserver_tpu.parallel.support import counts_from_sharded_bitset
+
+        mesh = make_mesh("8x1", devices=jax.devices()[:8])
+        min_count = int(np.ceil(0.03 * self.P))
+        bitset, f, info = device_synthetic_bitset(
+            self.P, self.V, self.ROWS, min_count, seed=3, mesh=mesh,
+        )
+        v_pad, w_pad = bitset.shape
+        assert w_pad % 8 == 0
+        x_full = np.asarray(unpack_bits(jnp.asarray(bitset))).astype(np.int32)
+        assert not x_full[:, self.P:].any()  # pad bits clean in every slab
+        assert not x_full[f:].any()  # pad rows clean
+        counts = counts_from_sharded_bitset(bitset, mesh)
+        x = x_full[:f, : self.P]
+        np.testing.assert_array_equal(
+            np.asarray(counts)[:f, :f], x @ x.T
+        )
+        # distribution sanity on the sharded generator too
+        q = zipf_bit_probs(self.V, self.P, self.ROWS)
+        got = x.sum(axis=1)
+        expect = self.P * q[:f]
+        sigma = np.sqrt(np.maximum(expect * (1 - q[:f]), 1.0))
+        assert (np.abs(got - expect) < 6 * sigma).all()
+
+    def test_sharded_generation_rejects_tp_mesh(self):
+        import jax
+
+        from kmlserver_tpu.parallel.mesh import make_mesh
+        from kmlserver_tpu.data.device_synthetic import (
+            sharded_bitset_from_probs,
+        )
+
+        mesh = make_mesh("4x2", devices=jax.devices()[:8])
+        with pytest.raises(ValueError, match="dp-only"):
+            sharded_bitset_from_probs(
+                jnp.zeros(128, jnp.float32), 0, mesh,
+                n_playlists=64, v_pad=128, w_pad=4096,
+            )
+
     def test_row_block_must_divide(self):
         with pytest.raises(ValueError, match="multiple of row_block"):
             bitset_from_probs(
