@@ -3,6 +3,7 @@ and the HTTP surface (routing unit tests + a real socket round-trip),
 exercising the real mining-job → PVC → API handoff."""
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -149,8 +150,6 @@ class TestEngine:
     def test_legacy_pickle_only_load(self, mined_pvc):
         """A PVC written by the REFERENCE job has no npz — pickle path must
         serve identically."""
-        import os
-
         cfg, _, _ = mined_pvc
         npz = artifacts.tensor_artifact_path(
             f"{cfg.base_dir}/pickles/{cfg.recommendations_file}"
@@ -299,6 +298,57 @@ class TestEngine:
         # loaded CI hosts): batches must grow well past the un-self-sized
         # floor while dispatches block
         assert max(batch_sizes) > 32, f"batches never grew: {batch_sizes}"
+
+    def test_serving_from_pruned_vocab_artifact(self, tmp_path):
+        """Vocabularies above the default prune threshold now produce
+        artifacts whose rule tensors cover only the frequent items; the
+        engine must serve rules for frequent seeds and fall back
+        statically for seeds that pruning removed (which were never rule
+        KEYS in the reference either — infrequent items aren't keys)."""
+        from kmlserver_tpu.data.csv import write_tracks_csv
+        from kmlserver_tpu.data.synthetic import synthetic_table
+        from kmlserver_tpu.mining.pipeline import run_mining_job
+
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        write_tracks_csv(
+            str(ds_dir / "2023_spotify_ds1.csv"),
+            synthetic_table(
+                n_playlists=300, n_tracks=700, target_rows=6000, seed=21
+            ),
+        )
+        mining_cfg = MiningConfig(
+            base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+            min_support=0.02, k_max_consequents=16,
+            top_tracks_save_percentile=0.2,
+        )
+        run_mining_job(mining_cfg)
+        rules_dict = artifacts.load_pickle(
+            os.path.join(
+                mining_cfg.pickles_dir, mining_cfg.recommendations_file
+            )
+        )
+        assert 0 < len(rules_dict) < 700  # pruned: only frequent keys
+        engine = RecommendEngine(ServingConfig(base_dir=str(tmp_path)))
+        assert engine.load()
+        seed = next(s for s, row in rules_dict.items() if row)
+        recs, source = engine.recommend([seed])
+        assert source == "rules"
+        # tie-robust (the serve kernel guarantees the CONFIDENCE multiset
+        # of the top-k, not id-level tie order — ops/serve.py docstring):
+        # every rec must be a rule of the seed, and the selected
+        # confidences must equal the top-10 confidences exactly
+        assert set(recs) <= set(rules_dict[seed])
+        got_confs = sorted((rules_dict[seed][r] for r in recs), reverse=True)
+        want_confs = sorted(rules_dict[seed].values(), reverse=True)[:10]
+        assert got_confs == want_confs
+        # a pruned-away (infrequent) track name: static fallback
+        pruned_seed = next(
+            f"Track {i:07d}" for i in range(699, -1, -1)
+            if f"Track {i:07d}" not in rules_dict
+        )
+        _, source = engine.recommend([pruned_seed])
+        assert source == "fallback"
 
     def test_pipelining_hides_result_latency_at_1k_qps(self):
         """Config-5 de-risk: with ~65 ms of RESULT latency per device call
@@ -449,7 +499,6 @@ class TestAppRouting:
         clients migrate off the pod, (b) close the listener so racing
         connects are refused, (c) exit 0 after a bounded settle."""
         import http.client
-        import os
         import re
         import signal
         import socket
