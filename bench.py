@@ -37,10 +37,10 @@ Phases (tpu suite), in priority order for a short pool window: mining
 MFU via the chained-scan slope), serving (batch-32 p50), replay (full
 stack at 1k QPS, median of N runs, server-side /metrics percentiles next
 to the client-observed ones), popcount (compiled Pallas kernel, counts
-asserted equal on-device, words/s emitted), scale (1M×100k config-4
-mechanics), config4-devicegen (TRUE 10M×1M shape, workload born in HBM
-as a Bernoulli-Zipf bitset), sweep (the reference's 68-point support
-grid, count-once).
+asserted equal on-device, words/s emitted), config4-devicegen (TRUE
+10M×1M shape, workload born in HBM as a Bernoulli-Zipf bitset), scale
+(1M×100k config-4 mechanics through the real host-data pipeline), sweep
+(the reference's 68-point support grid, count-once).
 Phases (cpu suite): mining, popcount stand-in (interpret mode, small
 shape), scale stand-in (20k×5k on an 8-virtual-device mesh), serving,
 replay — all keys labeled ``*_cpu*``.
@@ -1326,33 +1326,6 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         em.checkpoint()
 
     if _remaining() > 300:
-        # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
-        # through Apriori prune + the bit-packed popcount path (SCALE.md
-        # documents the model; this captures the numbers)
-        scale = _run_phase(
-            "scale", _SCALE_BENCH,
-            ["--playlists", "1000000", "--tracks", "100000",
-             "--rows", "50000000", "--min-support", "0.001"],
-            platform="tpu", timeout=min(900, _remaining()),
-        )
-        if scale is not None:
-            result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
-            result["scale_rows_per_s"] = scale["rows_per_s"]
-            result["scale_frequent_items"] = scale["frequent_items"]
-            # auto dispatch (warm) + device-resident timings: the HBM-fit
-            # dense path and the tunnel-free on-chip bracket, labeled
-            for src, dst in (
-                ("auto_mine_s", "scale_auto_mine_s"),
-                ("auto_path", "scale_auto_path"),
-                ("auto_rows_per_s", "scale_auto_rows_per_s"),
-                ("device_resident_mine_s", "scale_device_resident_mine_s"),
-                ("device_resident_path", "scale_device_resident_path"),
-            ):
-                if src in scale:
-                    result[dst] = scale[src]
-        em.checkpoint()
-
-    if _remaining() > 300:
         # TRUE config-4 shape (10M playlists × 1M tracks) on the single
         # chip, workload generated in HBM (Bernoulli-Zipf bitset — zero
         # host generation or transfer); compare CONFIG4_CPU_r03.json's
@@ -1377,6 +1350,33 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
             ):
                 if src in config4:
                     result[dst] = config4[src]
+        em.checkpoint()
+
+    if _remaining() > 300:
+        # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
+        # through Apriori prune + the bit-packed popcount path (SCALE.md
+        # documents the model; this captures the numbers)
+        scale = _run_phase(
+            "scale", _SCALE_BENCH,
+            ["--playlists", "1000000", "--tracks", "100000",
+             "--rows", "50000000", "--min-support", "0.001"],
+            platform="tpu", timeout=min(900, _remaining()),
+        )
+        if scale is not None:
+            result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
+            result["scale_rows_per_s"] = scale["rows_per_s"]
+            result["scale_frequent_items"] = scale["frequent_items"]
+            # auto dispatch (warm) + device-resident timings: the HBM-fit
+            # dense path and the tunnel-free on-chip bracket, labeled
+            for src, dst in (
+                ("auto_mine_s", "scale_auto_mine_s"),
+                ("auto_path", "scale_auto_path"),
+                ("auto_rows_per_s", "scale_auto_rows_per_s"),
+                ("device_resident_mine_s", "scale_device_resident_mine_s"),
+                ("device_resident_path", "scale_device_resident_path"),
+            ):
+                if src in scale:
+                    result[dst] = scale[src]
         em.checkpoint()
 
     if _remaining() > 180:
