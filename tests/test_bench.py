@@ -271,6 +271,117 @@ class TestArtifactEmitter:
         assert final["vs_baseline_best"] == round(20.31 / 0.1, 1)
 
 
+class TestTpuSuiteWiring:
+    """run_tpu_suite executes only on real hardware — unattended, at round
+    end. This pins its key-mapping/checkpoint wiring against canned phase
+    results so a src-key typo or a non-dict phase result can't surface for
+    the first time on the driver."""
+
+    CANNED = {
+        "mining": {
+            "median_s": 0.5, "matmul_s": 0.001, "matmul_amortized_s": 0.0005,
+            "n_playlists": 2246, "n_tracks": 2171,
+            "device_kind": "TPU v5e", "platform": "tpu",
+            "count_path": "dense-fused",
+            "chain_n1": 16, "chain_n2": 1016,
+            "chain_t_short_s": 0.1, "chain_t_long_s": 0.6,
+        },
+        "popcount": {
+            "kernel": "bcast", "popcount_ms": 150.0, "dense_ms": 80.0,
+            "words_per_s": 2e10, "popcount_amortized_ms": 120.0,
+            "dense_amortized_ms": 7.0, "mxu_ms": 30.0,
+            "mxu_amortized_ms": 11.0, "mxu_words_per_s": 2e11,
+            "exact": True, "mode": "compiled", "v_pad": 2176, "w_pad": 512,
+            "word_ops": 1, "shape": "2246x2171",
+        },
+        "config4-devicegen": {
+            "mine_s": 9.5, "mine_cold_s": 30.0, "gen_device_s": 4.0,
+            "rows": 500_000_000, "rows_basis": "expected-model-rows",
+            "rows_per_s": 5e7, "frequent_items": 8000, "n_rules": 90000,
+            "bitset_gib": 9.5, "workload_model": "bernoulli-zipf",
+            "rows_measured": 450_000_000,
+        },
+        "scale": {
+            "mine_s": 20.0, "rows_per_s": 2.5e6, "frequent_items": 5069,
+            "auto_mine_s": 12.0, "auto_path": "dense-fused",
+            "auto_rows_per_s": 4e6, "device_resident_mine_s": 3.0,
+            "device_resident_path": "bitpack-mxu",
+        },
+        "sweep": {
+            "points": 68, "total_s": 12.0, "emission_total_s": 9.0,
+            "setup_plus_count_s": 3.0,
+        },
+        "serving": {
+            "p50_ms": 0.5, "amortized_ms": 0.4,
+            "p50_256_ms": 1.2, "amortized_256_ms": 1.0,
+        },
+    }
+    REPLAY = {
+        "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
+        "p95_ms": 9.0, "p99_ms": 14.0, "n_errors": 0,
+        "runs": [{"p50_ms": 4.0, "achieved_qps": 1010.0, "n_errors": 0}],
+        "host_load1": 0.5, "warmup_requests": 1000,
+        "server_percentiles": {"p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": 8.0},
+    }
+
+    def test_every_phase_key_lands_in_the_artifact(self, monkeypatch, capsys):
+        def fake_run_phase(name, code, argv, **kw):
+            for prefix, canned in self.CANNED.items():
+                if name.startswith(prefix):
+                    return dict(canned)
+            raise AssertionError(f"unexpected phase {name!r}")
+
+        monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(
+            bench, "replay_phase", lambda platform: dict(self.REPLAY)
+        )
+        em = bench.ArtifactEmitter()
+        mining = bench.run_tpu_suite(em, "/tmp/unused.npz")
+        assert mining == self.CANNED["mining"]
+        assert em.finalize()
+        out = capsys.readouterr().out
+        final = json.loads(
+            [ln for ln in out.splitlines() if ln.strip()][-1]
+        )
+        assert final["platform"] == "tpu"
+        assert final["value"] == 0.5
+        assert final["mining_mfu_pct"] > 0  # amortized path, ≤100
+        assert final["mining_chain_n2"] == 1016
+        assert final["popcount_ds2_ms"] == 150.0
+        assert final["bitpack_mxu_ds2_ms"] == 30.0
+        assert final["config4_mine_s"] == 9.5
+        assert final["config4_rows_basis"] == "expected-model-rows"
+        assert final["scale_1m_x_100k_mine_s"] == 20.0
+        assert final["scale_device_resident_mine_s"] == 3.0
+        assert final["sweep_points"] == 68
+        assert final["serving_batch32_p50_ms"] == 0.5
+        assert final["serving_batch256_p50_ms"] == 1.2
+        assert final["replay_achieved_qps"] == 1010.0
+        assert final["replay_server_p50_ms"] == 2.0
+        assert final["replay_runs"] == self.REPLAY["runs"]
+        # the supplementary CPU replay lands under cpu_-prefixed keys
+        assert final["cpu_replay_achieved_qps"] == 1010.0
+
+    def test_failed_optional_phase_never_aborts_the_suite(self, monkeypatch, capsys):
+        def fake_run_phase(name, code, argv, **kw):
+            if name.startswith("mining"):
+                return dict(self.CANNED["mining"])
+            return None  # every optional phase fails
+
+        monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(bench, "replay_phase", lambda platform: None)
+        em = bench.ArtifactEmitter()
+        mining = bench.run_tpu_suite(em, "/tmp/unused.npz")
+        assert mining == self.CANNED["mining"]
+        assert em.finalize()
+        out = capsys.readouterr().out
+        final = json.loads(
+            [ln for ln in out.splitlines() if ln.strip()][-1]
+        )
+        assert final["value"] == 0.5
+        assert "popcount_ds2_ms" not in final
+
+
 class TestSigtermFlush:
     def test_sigterm_mid_run_still_yields_parsed_artifact(self, tmp_path):
         """The r03 failure mode, pinned: a driver kill AFTER the headline
