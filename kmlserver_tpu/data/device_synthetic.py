@@ -38,6 +38,7 @@ The counting and emission downstream are the production paths untouched:
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -168,8 +169,6 @@ def sharded_bitset_from_probs(
     communicates another's slab. Feed the result to
     ``parallel.support.counts_from_sharded_bitset`` for psum'd counts —
     BASELINE config 4 on a v5e-4 with zero host involvement."""
-    import jax.sharding as jsh
-
     from ..parallel.mesh import AXIS_DP, AXIS_TP
 
     if mesh.shape.get(AXIS_TP, 1) > 1:
@@ -186,8 +185,22 @@ def sharded_bitset_from_probs(
             f"v_pad {v_pad} must be a multiple of row_block {row_block}"
         )
     n_blocks = v_pad // row_block
+    # uint32 truncation keeps full-range Python seeds valid (PRNGKey
+    # folds 32 bits of entropy either way)
+    return _sharded_gen_fn(mesh, n_playlists, w_local, row_block, n_blocks)(
+        q_padded, jnp.uint32(seed & 0xFFFFFFFF)
+    )
 
-    def shard_gen(q_full: jax.Array) -> jax.Array:
+
+@functools.lru_cache(maxsize=32)
+def _sharded_gen_fn(mesh, n_playlists, w_local, row_block, n_blocks):
+    """Cached jitted program per (mesh, shape): the seed rides as a traced
+    argument so re-generation with a new seed hits the compile cache."""
+    import jax.sharding as jsh
+
+    from ..parallel.mesh import AXIS_DP
+
+    def shard_gen(q_full: jax.Array, seed: jax.Array) -> jax.Array:
         shard = jax.lax.axis_index(AXIS_DP)
         base = jax.random.fold_in(jax.random.PRNGKey(seed), shard)
         return _scan_bernoulli_words(
@@ -204,10 +217,10 @@ def sharded_bitset_from_probs(
     spec = jsh.PartitionSpec
     return jax.jit(
         jax.shard_map(
-            shard_gen, mesh=mesh, in_specs=spec(),
+            shard_gen, mesh=mesh, in_specs=(spec(), spec()),
             out_specs=spec(None, AXIS_DP),
         )
-    )(q_padded)
+    )
 
 
 def device_synthetic_bitset(

@@ -27,6 +27,7 @@ import json
 import logging
 import os
 import random
+import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -445,8 +446,6 @@ class _Server(ThreadingHTTPServer):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         # in-flight request count, read by the SIGTERM drain settle
-        import threading
-
         self.active_requests = 0
         self.active_lock = threading.Lock()
 

@@ -335,6 +335,9 @@ class TestTpuSuiteWiring:
         monkeypatch.setattr(
             bench, "replay_phase", lambda platform: dict(self.REPLAY)
         )
+        # the suite gates phases on wall-clock headroom; pin it so test
+        # ordering / an exported KMLS_BENCH_DEADLINE_S can't skip phases
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
         em = bench.ArtifactEmitter()
         mining = bench.run_tpu_suite(em, "/tmp/unused.npz")
         assert mining == self.CANNED["mining"]
@@ -370,6 +373,7 @@ class TestTpuSuiteWiring:
 
         monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
         monkeypatch.setattr(bench, "replay_phase", lambda platform: None)
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
         em = bench.ArtifactEmitter()
         mining = bench.run_tpu_suite(em, "/tmp/unused.npz")
         assert mining == self.CANNED["mining"]
