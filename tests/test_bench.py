@@ -386,6 +386,99 @@ class TestTpuSuiteWiring:
         assert "popcount_ds2_ms" not in final
 
 
+class TestMainTakeover:
+    """main()'s pool-came-back-mid-run path: CPU keys must relabel to
+    cpu_*, the CPU mining result must survive as the comparison block,
+    and a failed TPU suite must restore the CPU keys — logic that
+    otherwise first runs unattended against a flaky pool."""
+
+    CPU_MINING = {"median_s": 0.08, "count_path": "native-cpu"}
+    TPU_MINING = {
+        "median_s": 0.4, "platform": "tpu", "device_kind": "TPU v5e",
+        "count_path": "dense-fused",
+    }
+
+    def _run_main(self, monkeypatch, tpu_suite_succeeds: bool):
+        import threading
+
+        class FakeProber:
+            def __init__(self, *a, **kw):
+                self.history = []
+                self.acquired = threading.Event()
+                self._alive = True
+
+            def probe_once(self):
+                self.history.append(
+                    {"t_s": 0.0, "outcome": "hang", "dur_s": 1.0}
+                )
+                return "hang"
+
+            def start_background(self):
+                self.acquired.set()  # pool "comes back" immediately
+
+            def stop(self):
+                self._alive = False
+
+            def alive(self):
+                return self._alive
+
+            def history_snapshot(self):
+                return list(self.history)
+
+        def fake_cpu_suite(em, npz):
+            em.set_headline("cpu", dict(self.CPU_MINING))
+            em.extras["serving_batch32_p50_ms"] = 0.7
+            em.extras["replay_achieved_qps"] = 1005.0
+            em.checkpoint()
+            return em.mining
+
+        def fake_tpu_suite(em, npz):
+            if not tpu_suite_succeeds:
+                return None
+            mining = dict(self.TPU_MINING)
+            em.set_headline("tpu", mining)
+            em.extras["serving_batch32_p50_ms"] = 0.05
+            return mining
+
+        monkeypatch.setattr(bench, "TpuProber", FakeProber)
+        monkeypatch.setattr(bench, "run_cpu_suite", fake_cpu_suite)
+        monkeypatch.setattr(bench, "run_tpu_suite", fake_tpu_suite)
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
+        monkeypatch.delenv("KMLS_BENCH_CPU", raising=False)
+        assert bench.main() == 0
+
+    def test_takeover_relabels_cpu_keys_and_keeps_comparison(
+        self, monkeypatch, capsys
+    ):
+        self._run_main(monkeypatch, tpu_suite_succeeds=True)
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert final["platform"] == "tpu"
+        assert final["value"] == 0.4
+        # CPU serving/replay evidence relabeled, TPU's under standard keys
+        assert final["cpu_serving_batch32_p50_ms"] == 0.7
+        assert final["cpu_replay_achieved_qps"] == 1005.0
+        assert final["serving_batch32_p50_ms"] == 0.05
+        # the CPU mining headline survives as the comparison block
+        assert final["mining_cpu_s"] == 0.08
+        assert final["best_mining_platform"] == "cpu"
+
+    def test_failed_takeover_restores_cpu_keys(self, monkeypatch, capsys):
+        self._run_main(monkeypatch, tpu_suite_succeeds=False)
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert final["platform"] == "cpu"
+        assert final["value"] == 0.08
+        # keys restored to their standard names, no cpu_ leftovers
+        assert final["serving_batch32_p50_ms"] == 0.7
+        assert final["replay_achieved_qps"] == 1005.0
+        assert "cpu_serving_batch32_p50_ms" not in final
+        # no self-comparison block on a cpu-only line
+        assert "mining_cpu_s" not in final
+
+
 class TestSigtermFlush:
     def test_sigterm_mid_run_still_yields_parsed_artifact(self, tmp_path):
         """The r03 failure mode, pinned: a driver kill AFTER the headline
