@@ -82,6 +82,32 @@ x = np.zeros((b.n_playlists, b.n_tracks), np.int32)
 x[b.playlist_rows, b.track_ids] = 1
 np.testing.assert_array_equal(np.asarray(counts), x.T @ x)
 print(f"RANK {rank} BITPACK EXACT")
+
+# device-born workload across PROCESS boundaries: every device (two per
+# process) generates only its own word slab of a Bernoulli-Zipf bitset,
+# and the psum'd counts must equal brute force on the generated
+# memberships — config 4's multi-host generation + counting story
+from kmlserver_tpu.data.device_synthetic import device_synthetic_bitset
+from kmlserver_tpu.ops.encode import unpack_bits
+from kmlserver_tpu.parallel.support import counts_from_sharded_bitset
+
+bitset, f_gen, _ = device_synthetic_bitset(
+    64, 40, 400, min_count=1, seed=6, mesh=flat
+)
+gen_counts = counts_from_sharded_bitset(bitset, flat)
+assert gen_counts.is_fully_replicated, gen_counts.sharding
+# the slabs live on different PROCESSES — allgather before unpacking the
+# ground truth (the counts themselves are already replicated)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+gathered = jax.jit(
+    lambda a: a, out_shardings=NamedSharding(flat, P())
+)(bitset)
+xg = np.asarray(unpack_bits(gathered))[:f_gen, :64].astype(np.int32)
+np.testing.assert_array_equal(
+    np.asarray(gen_counts)[:f_gen, :f_gen], xg @ xg.T
+)
+print(f"RANK {rank} DEVICEGEN EXACT")
 """
 
 
@@ -136,6 +162,7 @@ def test_two_process_mining_job(tmp_path):
     # the cross-process bitpack path verified exact on BOTH ranks
     for r in range(2):
         assert f"RANK {r} BITPACK EXACT" in outs[r], outs[r]
+        assert f"RANK {r} DEVICEGEN EXACT" in outs[r], outs[r]
 
     # artifacts landed once, on the shared "PVC"
     pickles = tmp_path / "dist" / "pickles"
