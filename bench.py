@@ -1159,9 +1159,13 @@ def replay_phase(platform: str) -> dict | None:
             report["warmup_requests"] = n_warm
             server_pcts = _scrape_server_percentiles(url)
             if server_pcts:
-                # NOTE: the server's /metrics reservoir spans warmup + all
-                # runs; it is the steady-state server-side view
                 report["server_percentiles"] = server_pcts
+                # the /metrics reservoir spans warmup + ALL runs (it can
+                # exceed the median run's client p50 when another run was
+                # an outlier) — say so in the artifact itself
+                report["server_percentiles_note"] = (
+                    "cumulative over warmup + all replay runs"
+                )
             return report
         finally:
             server.terminate()
@@ -1545,7 +1549,8 @@ def _record_replay(result: dict, platform: str) -> None:
     # single replay number is auditable instead of luck-dependent
     for src, dst in (("runs", "replay_runs"),
                      ("host_load1", "replay_host_load1"),
-                     ("warmup_requests", "replay_warmup_requests")):
+                     ("warmup_requests", "replay_warmup_requests"),
+                     ("server_percentiles_note", "replay_server_note")):
         if src in replay:
             result[dst] = replay[src]
     server_pcts = replay.get("server_percentiles")

@@ -103,7 +103,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 gathered = jax.jit(
     lambda a: a, out_shardings=NamedSharding(flat, P())
 )(bitset)
-xg = np.asarray(unpack_bits(gathered))[:f_gen, :64].astype(np.int32)
+# unpack_bits' n_tracks param slices the bit columns (= playlists here);
+# int32 cast: a numpy int8 matmul would overflow
+xg = np.asarray(unpack_bits(gathered, 64))[:f_gen].astype(np.int32)
 np.testing.assert_array_equal(
     np.asarray(gen_counts)[:f_gen, :f_gen], xg @ xg.T
 )
