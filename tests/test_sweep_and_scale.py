@@ -91,6 +91,31 @@ class TestPrunedMining:
         assert pruned.tensors.n_songs_missing == plain.tensors.n_songs_missing
         assert pruned.tensors.n_frequent_items == plain.tensors.n_frequent_items
 
+    def test_census_identical_under_default_prune(self):
+        """The itemset census (max_itemset_len >= 3) runs on the pruned
+        count matrix when the default prune engages; frequent itemsets
+        contain only frequent items, so the census must match a
+        prune-disabled run exactly."""
+        baskets = synthetic_baskets(
+            n_playlists=250, n_tracks=700, target_rows=5000, seed=23
+        )
+        pruned = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.03, k_max_consequents=16, max_itemset_len=3
+            ),
+        )
+        plain = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.03, k_max_consequents=16, max_itemset_len=3,
+                prune_vocab_threshold=10**9,
+            ),
+        )
+        assert pruned.pruned_vocab is not None
+        assert pruned.itemset_census == plain.itemset_census
+        assert pruned.itemset_census[1] > 0
+
     def test_prune_with_nothing_frequent_falls_back(self, rng):
         """min_support so high nothing survives: the miner must not create
         zero-sized device shapes — it falls back to the unpruned vocabulary
