@@ -29,40 +29,11 @@ def main() -> int:
     from ..utils.jaxcache import enable_compilation_cache
 
     enable_compilation_cache()
-    mesh = None
-    if cfg.mesh_shape in ("", "1x1"):
-        pass  # explicit single-device
-    elif cfg.mesh_shape.startswith("hybrid"):
-        # "hybrid" or "hybrid:tpN" — DCN×ICI layout (tp pinned intra-host);
-        # anything else hybrid-shaped is a config error, fail fast
-        from ..parallel.distributed import make_hybrid_mesh
+    from ..parallel.distributed import resolve_mesh
 
-        if cfg.mesh_shape == "hybrid":
-            mesh = make_hybrid_mesh()
-        elif cfg.mesh_shape.startswith("hybrid:tp") and cfg.mesh_shape[9:].isdigit():
-            mesh = make_hybrid_mesh(tp=int(cfg.mesh_shape[9:]))
-        else:
-            raise ValueError(
-                f"mesh shape must be 'hybrid' or 'hybrid:tpN', got {cfg.mesh_shape!r}"
-            )
-    elif cfg.mesh_shape == "auto":
-        import jax
-
-        if distributed:
-            # multi-host: the hybrid layout is the only correct default —
-            # the tp block-exchange axis must ride ICI, never DCN
-            from ..parallel.distributed import make_hybrid_mesh
-
-            mesh = make_hybrid_mesh()
-        elif len(jax.devices()) > 1:  # default: shard over every chip present
-            from ..parallel.mesh import make_mesh
-
-            mesh = make_mesh("auto")
-    else:
-        from ..parallel.mesh import make_mesh
-
-        mesh = make_mesh(cfg.mesh_shape)
-    run_mining_job(cfg, mesh=mesh)
+    run_mining_job(
+        cfg, mesh=resolve_mesh(cfg.mesh_shape, distributed=distributed)
+    )
     return 0
 
 

@@ -43,9 +43,14 @@ def run_sweep(
     cfg: MiningConfig,
     supports: np.ndarray,
     dataset: str | None = None,
+    mesh=None,
 ) -> list[dict]:
     """→ one record per support point:
-    ``{min_support, missing_songs, frequent_items, duration_s}``."""
+    ``{min_support, missing_songs, frequent_items, duration_s}``.
+
+    With ``mesh``, the count-once phase runs sharded (the same
+    ``pair_count_fn`` dispatch the miner uses: dense dp×tp or dp-sharded
+    bitset slabs); the per-point emissions reuse the replicated counts."""
     if dataset is None:
         datasets = registry.get_dataset_list(cfg)
         index = registry.get_next_run_index(cfg, datasets)
@@ -55,7 +60,7 @@ def run_sweep(
     n_total = baskets.n_tracks
 
     # resolved before the timer: may trigger the one-time native build
-    use_native = native_cpu_eligible(cfg)
+    use_native = native_cpu_eligible(cfg, mesh)
 
     t0 = time.perf_counter()
     # pruning must use the SMALLEST support in the sweep to stay exact for
@@ -73,7 +78,9 @@ def run_sweep(
         emit = rules_mod.mine_rules_from_counts_np
     else:
         counts, _, _ = pair_count_fn(
-            mined_baskets, bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+            mined_baskets, mesh,
+            bitpack_threshold_elems=cfg.bitpack_threshold_elems,
+            sharded_impl=cfg.sharded_impl,
             hbm_budget_bytes=cfg.hbm_budget_bytes,
         )
         jax.block_until_ready(counts)
@@ -129,7 +136,10 @@ def main() -> int:
     stop = float(os.getenv("KMLS_SWEEP_STOP", "0.2"))
     step = float(os.getenv("KMLS_SWEEP_STEP", "0.0025"))
     supports = np.arange(start, stop, step)  # reference grid (main.py:452)
-    records = run_sweep(cfg, supports)
+    # the sweep honors the same KMLS_MESH_SHAPE contract as the mining job
+    from ..parallel.distributed import resolve_mesh
+
+    records = run_sweep(cfg, supports, mesh=resolve_mesh(cfg.mesh_shape))
     path = write_results_csv(cfg, records)
     print(f"wrote {len(records)} sweep points to {path}")
     return 0

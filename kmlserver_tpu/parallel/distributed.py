@@ -122,3 +122,36 @@ def make_hybrid_mesh(
     ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
     grid = np.asarray(ordered).reshape(dp, tp)
     return Mesh(grid, (AXIS_DP, AXIS_TP))
+
+
+def resolve_mesh(mesh_shape: str, distributed: bool = False) -> Mesh | None:
+    """The ONE ``KMLS_MESH_SHAPE``-string → mesh resolution, shared by the
+    mining job and the sweep harness: ``""``/``"1x1"`` = explicit
+    single-device (None); ``"hybrid"``/``"hybrid:tpN"`` = DCN×ICI layout
+    (tp pinned intra-host); ``"auto"`` = hybrid when the multi-host runtime
+    is active, every local device otherwise (None when only one);
+    anything else = an explicit ``DPxTP`` shape."""
+    if mesh_shape in ("", "1x1"):
+        return None  # explicit single-device
+    if mesh_shape.startswith("hybrid"):
+        # anything else hybrid-shaped is a config error, fail fast
+        if mesh_shape == "hybrid":
+            return make_hybrid_mesh()
+        if mesh_shape.startswith("hybrid:tp") and mesh_shape[9:].isdigit():
+            return make_hybrid_mesh(tp=int(mesh_shape[9:]))
+        raise ValueError(
+            f"mesh shape must be 'hybrid' or 'hybrid:tpN', got {mesh_shape!r}"
+        )
+    if mesh_shape == "auto":
+        if distributed:
+            # multi-host: the hybrid layout is the only correct default —
+            # the tp block-exchange axis must ride ICI, never DCN
+            return make_hybrid_mesh()
+        if len(jax.devices()) > 1:  # shard over every chip present
+            from .mesh import make_mesh
+
+            return make_mesh("auto")
+        return None
+    from .mesh import make_mesh
+
+    return make_mesh(mesh_shape)

@@ -160,6 +160,30 @@ class TestPrunedMining:
 
 
 class TestSweep:
+    def test_sweep_on_mesh_matches_single_device(self, tmp_path):
+        """The count-once phase runs sharded when a mesh is given; every
+        per-point record must match the single-device sweep."""
+        import jax
+
+        from kmlserver_tpu.parallel.mesh import make_mesh
+
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        table = synthetic_table(
+            n_playlists=100, n_tracks=50, target_rows=1200, seed=9
+        )
+        write_tracks_csv(str(ds_dir / "2023_spotify_ds1.csv"), table)
+        cfg = MiningConfig(base_dir=str(tmp_path), datasets_dir=str(ds_dir))
+        supports = np.arange(0.04, 0.16, 0.03)
+        mesh = make_mesh("8x1", devices=jax.devices()[:8])
+        sharded = run_sweep(cfg, supports, mesh=mesh)
+        solo = run_sweep(cfg, supports)
+        strip = lambda rs: [
+            {k: r[k] for k in ("min_support", "missing_songs", "frequent_items")}
+            for r in rs
+        ]
+        assert strip(sharded) == strip(solo)
+
     def test_sweep_monotone_and_csv(self, tmp_path, rng):
         ds_dir = tmp_path / "datasets"
         ds_dir.mkdir()
