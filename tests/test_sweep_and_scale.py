@@ -91,6 +91,29 @@ class TestPrunedMining:
         assert pruned.tensors.n_songs_missing == plain.tensors.n_songs_missing
         assert pruned.tensors.n_frequent_items == plain.tensors.n_frequent_items
 
+    def test_pruned_confidence_mode_matches_oracle(self, rng):
+        """True-confidence mode (incl. the triple-antecedent merge) over a
+        PRUNED vocabulary must still match the slow-path oracle — the
+        prune/confidence/merge interaction in one pin."""
+        from .oracle import reference_slow_rules
+
+        baskets_list = random_baskets(rng, n_playlists=60, n_tracks=30, mean_len=5)
+        baskets = build_baskets(table_from_baskets(baskets_list))
+        result = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.1, k_max_consequents=64,
+                prune_vocab_threshold=1, confidence_mode="confidence",
+                min_confidence=0.05, max_itemset_len=3,
+            ),
+        )
+        assert result.pruned_vocab is not None
+        assert result.triple_merge_applied is True
+        got = result.tensors.to_rules_dict(result.vocab_names)
+        assert got == reference_slow_rules(
+            baskets_list, 0.1, 0.05, max_len=3
+        )
+
     def test_census_identical_under_default_prune(self):
         """The itemset census (max_itemset_len >= 3) runs on the pruned
         count matrix when the default prune engages; frequent itemsets
