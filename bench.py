@@ -77,6 +77,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -373,6 +374,12 @@ class ArtifactEmitter:
             self._finalized = True
             return True
 
+    def ever_printed(self) -> bool:
+        """True once ANY artifact line (checkpoint or final) reached
+        stdout — the signal handler's exit-code discriminator."""
+        with self._lock:
+            return self._last_printed is not None or self._finalized
+
 
 def _install_crash_handlers(emitter: ArtifactEmitter) -> None:
     """SIGTERM/SIGINT/atexit → flush the best-so-far line, kill live phase
@@ -393,7 +400,10 @@ def _install_crash_handlers(emitter: ArtifactEmitter) -> None:
         if signum is not None:
             sys.stdout.flush()
             sys.stderr.flush()
-            os._exit(0)
+            # a kill BEFORE the first artifact line must not look like a
+            # clean run: rc 0 is reserved for runs that flushed at least
+            # one checkpoint (ADVICE r4 #3)
+            os._exit(0 if emitter.ever_printed() else 128 + signum)
 
     atexit.register(_flush)
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -401,6 +411,81 @@ def _install_crash_handlers(emitter: ArtifactEmitter) -> None:
             signal.signal(sig, _flush)
         except (ValueError, OSError):
             pass  # non-main thread / exotic platform: atexit still covers
+
+
+class BenchState:
+    """Cross-invocation TPU phase bank (VERDICT r4 next-round #6).
+
+    Pool windows are short (~15 min) and sporadic; three 5-minute windows
+    across a round must accumulate ONE full TPU artifact, not three
+    headline-only ones. When ``KMLS_BENCH_STATE`` names a file, every
+    completed TPU-suite phase banks its raw result dict there (atomic
+    tmp+rename, the io/artifacts.py discipline) and the next invocation
+    replays banked phases into the artifact line instead of re-running
+    them. The mining phase also banks its rule-tensor npz (sidecar
+    ``<path>.npz``) so the serving phase still has its input when mining
+    itself is skipped. Unset (the default, and every CI path) → no-op.
+    """
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self.phases: dict = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict) or not isinstance(
+                    data.get("phases"), dict
+                ):
+                    raise ValueError("not a phase-bank object")
+                self.phases = dict(data["phases"])
+                log(
+                    f"state bank {path}: resuming with "
+                    f"{sorted(self.phases)} already banked"
+                )
+            except (OSError, ValueError) as exc:
+                log(f"state bank {path} unreadable ({exc}); starting fresh")
+
+    @property
+    def npz_path(self) -> str | None:
+        return self.path + ".npz" if self.path else None
+
+    def get(self, name: str) -> dict | None:
+        return self.phases.get(name)
+
+    def bank(self, name: str, result: dict) -> None:
+        if self.path is None:
+            return
+        self.phases[name] = result
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"version": 1, "phases": self.phases}, f)
+            os.replace(tmp, self.path)
+        except OSError as exc:
+            log(f"state bank write failed ({exc}); {name} not banked")
+
+
+STATE = BenchState(os.environ.get("KMLS_BENCH_STATE") or None)
+
+
+def _banked(
+    name: str, runner, budget_s: float | None = None
+) -> dict | None:
+    """Replay ``name`` from the state bank, or run it live and bank the
+    result. A banked phase replays for free — even past the deadline gate;
+    a live run happens only with ``budget_s`` of deadline headroom (None =
+    no gate, the caller gates)."""
+    cached = STATE.get(name)
+    if cached is not None:
+        log(f"{name}: banked from a prior window — skipping live run")
+        return dict(cached)
+    if budget_s is not None and _remaining() <= budget_s:
+        return None
+    result = runner()
+    if result is not None:
+        STATE.bank(name, result)
+    return result
 
 
 _MINING_BENCH = r"""
@@ -1282,132 +1367,150 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
     failed); optional phases fill the emitter's extras as deadline headroom
     allows, checkpointing the artifact line after each."""
     result = em.extras
-    mining = run_mining("tpu", npz_path)
+    banked_mining = STATE.get("mining_tpu")
+    mining = None
+    if (
+        banked_mining is not None
+        and STATE.npz_path
+        and os.path.exists(STATE.npz_path)
+    ):
+        # both the result AND the serving input survive across windows;
+        # a bank without its npz sidecar re-mines (serving needs the npz)
+        try:
+            shutil.copyfile(STATE.npz_path, npz_path)
+            log("mining_tpu: banked from a prior window — skipping live run")
+            mining = dict(banked_mining)
+        except OSError as exc:
+            log(f"state bank npz restore failed ({exc}); re-mining live")
+    if mining is None:
+        mining = run_mining("tpu", npz_path)
+        if mining is not None:
+            STATE.bank("mining_tpu", mining)
+            if STATE.npz_path:
+                try:
+                    shutil.copyfile(npz_path, STATE.npz_path)
+                except OSError as exc:
+                    log(f"state bank npz copy failed ({exc})")
     if mining is None:
         return None
     em.set_headline("tpu", mining)
 
     # serving + replay directly after the headline: config 5 is a judged
     # BASELINE target and the pool window may be short — the supporting
-    # phases (popcount/scale/config4/sweep) run after
-    if _remaining() > 120:
-        _record_serving(result, npz_path, "tpu")
-        em.checkpoint()
+    # phases (popcount/scale/config4/sweep) run after. A banked phase
+    # replays even past the deadline gate (replaying is free; budgets gate
+    # only live runs, inside _banked).
+    _record_serving(result, npz_path, "tpu", bank="serving_tpu", budget_s=120)
+    em.checkpoint()
 
-    if _remaining() > 300:
-        _record_replay(result, "tpu")
-        em.checkpoint()
+    _record_replay(result, "tpu", bank="replay_tpu", budget_s=300)
+    em.checkpoint()
 
-    if _remaining() > 240:
-        popcount = _run_phase(
-            "popcount", _POPCOUNT_BENCH,
-            ["compiled", "2246", "2171", "240249"],
-            platform="tpu", timeout=min(900, _remaining()),
+    popcount = _banked("popcount_tpu", lambda: _run_phase(
+        "popcount", _POPCOUNT_BENCH,
+        ["compiled", "2246", "2171", "240249"],
+        platform="tpu", timeout=min(900, _remaining()),
+    ), budget_s=240)
+    if popcount is not None:
+        log(
+            f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
+            f"ds2 shape): {popcount['popcount_ms']:.2f}ms/call vs dense "
+            f"MXU {popcount['dense_ms']:.2f}ms, exact match, "
+            f"{popcount['words_per_s'] / 1e9:.2f} Gwords/s amortized"
         )
-        if popcount is not None:
-            log(
-                f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
-                f"ds2 shape): {popcount['popcount_ms']:.2f}ms/call vs dense "
-                f"MXU {popcount['dense_ms']:.2f}ms, exact match, "
-                f"{popcount['words_per_s'] / 1e9:.2f} Gwords/s amortized"
-            )
-            result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
-            result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
-            result["popcount_kernel"] = popcount["kernel"]
-            result["popcount_words_per_s"] = round(popcount["words_per_s"])
-            for key in ("popcount_amortized_ms", "dense_amortized_ms"):
-                if key in popcount:
-                    result[key.replace("_ms", "_ds2_ms")] = round(
-                        popcount[key], 3
-                    )
-            # the MXU unpack-matmul impl (production default for the
-            # bit-packed path), measured next to the VPU kernel
-            for src, dst in (("mxu_ms", "bitpack_mxu_ds2_ms"),
-                             ("mxu_amortized_ms", "bitpack_mxu_amortized_ds2_ms"),
-                             ("mxu_words_per_s", "bitpack_mxu_words_per_s")):
-                if src in popcount:
-                    result[dst] = round(popcount[src], 3)
-        em.checkpoint()
+        result["popcount_ds2_ms"] = round(popcount["popcount_ms"], 3)
+        result["dense_pair_ds2_ms"] = round(popcount["dense_ms"], 3)
+        result["popcount_kernel"] = popcount["kernel"]
+        result["popcount_words_per_s"] = round(popcount["words_per_s"])
+        for key in ("popcount_amortized_ms", "dense_amortized_ms"):
+            if key in popcount:
+                result[key.replace("_ms", "_ds2_ms")] = round(
+                    popcount[key], 3
+                )
+        # the MXU unpack-matmul impl (production default for the
+        # bit-packed path), measured next to the VPU kernel
+        for src, dst in (("mxu_ms", "bitpack_mxu_ds2_ms"),
+                         ("mxu_amortized_ms", "bitpack_mxu_amortized_ds2_ms"),
+                         ("mxu_words_per_s", "bitpack_mxu_words_per_s")):
+            if src in popcount:
+                result[dst] = round(popcount[src], 3)
+    em.checkpoint()
 
-    if _remaining() > 300:
-        # TRUE config-4 shape (10M playlists × 1M tracks) on the single
-        # chip, workload generated in HBM (Bernoulli-Zipf bitset — zero
-        # host generation or transfer); compare CONFIG4_CPU_r03.json's
-        # 77.8 s one-core bracket
-        config4 = _run_phase(
-            "config4-devicegen", _CONFIG4_BENCH, ["--device-gen"],
-            platform="tpu", timeout=min(900, _remaining()),
-        )
-        if config4 is not None:
-            for src, dst in (
-                ("mine_s", "config4_mine_s"),
-                ("mine_cold_s", "config4_mine_cold_s"),
-                ("gen_device_s", "config4_gen_device_s"),
-                ("rows", "config4_rows"),
-                ("rows_basis", "config4_rows_basis"),
-                ("rows_per_s", "config4_rows_per_s"),
-                ("frequent_items", "config4_frequent_items"),
-                ("n_rules", "config4_n_rules"),
-                ("bitset_gib", "config4_bitset_gib"),
-                ("workload_model", "config4_workload_model"),
-                ("rows_measured", "config4_rows_measured"),
-            ):
-                if src in config4:
-                    result[dst] = config4[src]
-        em.checkpoint()
+    # TRUE config-4 shape (10M playlists × 1M tracks) on the single
+    # chip, workload generated in HBM (Bernoulli-Zipf bitset — zero
+    # host generation or transfer); compare CONFIG4_CPU_r03.json's
+    # 77.8 s one-core bracket
+    config4 = _banked("config4_tpu", lambda: _run_phase(
+        "config4-devicegen", _CONFIG4_BENCH, ["--device-gen"],
+        platform="tpu", timeout=min(900, _remaining()),
+    ), budget_s=300)
+    if config4 is not None:
+        for src, dst in (
+            ("mine_s", "config4_mine_s"),
+            ("mine_cold_s", "config4_mine_cold_s"),
+            ("gen_device_s", "config4_gen_device_s"),
+            ("rows", "config4_rows"),
+            ("rows_basis", "config4_rows_basis"),
+            ("rows_per_s", "config4_rows_per_s"),
+            ("frequent_items", "config4_frequent_items"),
+            ("n_rules", "config4_n_rules"),
+            ("bitset_gib", "config4_bitset_gib"),
+            ("workload_model", "config4_workload_model"),
+            ("rows_measured", "config4_rows_measured"),
+        ):
+            if src in config4:
+                result[dst] = config4[src]
+    em.checkpoint()
 
-    if _remaining() > 300:
-        # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
-        # through Apriori prune + the bit-packed popcount path (SCALE.md
-        # documents the model; this captures the numbers)
-        scale = _run_phase(
-            "scale", _SCALE_BENCH,
-            ["--playlists", "1000000", "--tracks", "100000",
-             "--rows", "50000000", "--min-support", "0.001"],
-            platform="tpu", timeout=min(900, _remaining()),
-        )
-        if scale is not None:
-            result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
-            result["scale_rows_per_s"] = scale["rows_per_s"]
-            result["scale_frequent_items"] = scale["frequent_items"]
-            # auto dispatch (warm) + device-resident timings: the HBM-fit
-            # dense path and the tunnel-free on-chip bracket, labeled
-            for src, dst in (
-                ("auto_mine_s", "scale_auto_mine_s"),
-                ("auto_path", "scale_auto_path"),
-                ("auto_rows_per_s", "scale_auto_rows_per_s"),
-                ("device_resident_mine_s", "scale_device_resident_mine_s"),
-                ("device_resident_path", "scale_device_resident_path"),
-            ):
-                if src in scale:
-                    result[dst] = scale[src]
-        em.checkpoint()
+    # config-4 scale mechanics on real HBM: 1M playlists x 100k vocab
+    # through Apriori prune + the bit-packed popcount path (SCALE.md
+    # documents the model; this captures the numbers)
+    scale = _banked("scale_tpu", lambda: _run_phase(
+        "scale", _SCALE_BENCH,
+        ["--playlists", "1000000", "--tracks", "100000",
+         "--rows", "50000000", "--min-support", "0.001"],
+        platform="tpu", timeout=min(900, _remaining()),
+    ), budget_s=300)
+    if scale is not None:
+        result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
+        result["scale_rows_per_s"] = scale["rows_per_s"]
+        result["scale_frequent_items"] = scale["frequent_items"]
+        # auto dispatch (warm) + device-resident timings: the HBM-fit
+        # dense path and the tunnel-free on-chip bracket, labeled
+        for src, dst in (
+            ("auto_mine_s", "scale_auto_mine_s"),
+            ("auto_path", "scale_auto_path"),
+            ("auto_rows_per_s", "scale_auto_rows_per_s"),
+            ("device_resident_mine_s", "scale_device_resident_mine_s"),
+            ("device_resident_path", "scale_device_resident_path"),
+        ):
+            if src in scale:
+                result[dst] = scale[src]
+    em.checkpoint()
 
-    if _remaining() > 180:
-        # the reference's full 68-point support sweep, count-once, on-chip
-        sweep = _run_phase(
-            "sweep", _SWEEP_BENCH, [], platform="tpu",
-            timeout=min(600, _remaining()),
-        )
-        if sweep is not None:
-            result["sweep_points"] = sweep["points"]
-            result["sweep_total_s"] = sweep["total_s"]
-            result["sweep_emission_total_s"] = sweep["emission_total_s"]
-            result["sweep_setup_plus_count_s"] = sweep["setup_plus_count_s"]
-        em.checkpoint()
+    # the reference's full 68-point support sweep, count-once, on-chip
+    sweep = _banked("sweep_tpu", lambda: _run_phase(
+        "sweep", _SWEEP_BENCH, [], platform="tpu",
+        timeout=min(600, _remaining()),
+    ), budget_s=180)
+    if sweep is not None:
+        result["sweep_points"] = sweep["points"]
+        result["sweep_total_s"] = sweep["total_s"]
+        result["sweep_emission_total_s"] = sweep["emission_total_s"]
+        result["sweep_setup_plus_count_s"] = sweep["setup_plus_count_s"]
+    em.checkpoint()
 
-    if _remaining() > 300:
-        # supplementary CPU replay: through this environment's remote-TPU
-        # tunnel every request pays ~65 ms of round trip, which measures
-        # the tunnel, not the serving stack — a production pod has a LOCAL
-        # chip. The CPU-stack replay (native mining fallback + host
-        # kernels) is the closer proxy for framework overhead; record it
-        # under cpu_-prefixed keys next to the tunnel numbers.
-        cpu_replay: dict = {}
-        _record_replay(cpu_replay, "cpu")
-        for key, val in cpu_replay.items():
-            result[f"cpu_{key}"] = val
-        em.checkpoint()
+    # supplementary CPU replay: through this environment's remote-TPU
+    # tunnel every request pays ~65 ms of round trip, which measures
+    # the tunnel, not the serving stack — a production pod has a LOCAL
+    # chip. The CPU-stack replay (native mining fallback + host
+    # kernels) is the closer proxy for framework overhead; record it
+    # under cpu_-prefixed keys next to the tunnel numbers.
+    cpu_replay: dict = {}
+    _record_replay(cpu_replay, "cpu", bank="replay_cpu_supp", budget_s=300)
+    for key, val in cpu_replay.items():
+        result[f"cpu_{key}"] = val
+    em.checkpoint()
     return mining
 
 
@@ -1499,11 +1602,17 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
     return mining
 
 
-def _record_serving(result: dict, npz_path: str, platform: str) -> None:
-    serving = _run_phase(
-        "serving", _SERVING_BENCH, [npz_path], platform=platform,
-        timeout=min(900, _remaining()),
-    )
+def _record_serving(
+    result: dict, npz_path: str, platform: str,
+    bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    def _run() -> dict | None:
+        return _run_phase(
+            "serving", _SERVING_BENCH, [npz_path], platform=platform,
+            timeout=min(900, _remaining()),
+        )
+
+    serving = _banked(bank, _run, budget_s) if bank else _run()
     if serving is None:
         return
     p50 = serving["p50_ms"]
@@ -1520,14 +1629,20 @@ def _record_serving(result: dict, npz_path: str, platform: str) -> None:
         )
 
 
-def _record_replay(result: dict, platform: str) -> None:
-    try:
-        replay = replay_phase(platform)
-    except Exception as exc:
-        # the replay stack is optional evidence; the headline mining
-        # number in hand must reach stdout no matter what breaks here
-        log(f"replay phase crashed ({type(exc).__name__}: {exc}); skipping")
-        replay = None
+def _record_replay(
+    result: dict, platform: str,
+    bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    def _run() -> dict | None:
+        try:
+            return replay_phase(platform)
+        except Exception as exc:
+            # the replay stack is optional evidence; the headline mining
+            # number in hand must reach stdout no matter what breaks here
+            log(f"replay phase crashed ({type(exc).__name__}: {exc}); skipping")
+            return None
+
+    replay = _banked(bank, _run, budget_s) if bank else _run()
     if replay is None:
         return
     log(
@@ -1588,16 +1703,18 @@ def main() -> int:
                     "CPU so the headline number is still captured"
                 )
                 mining = run_cpu_suite(em, f.name)
-            elif _remaining() > 180:
+            else:
                 # cheap CPU comparison point (native POPCNT path) so every
                 # TPU artifact also carries the no-accelerator number —
                 # optional, so its timeout respects the deadline (the
                 # already-measured TPU headline must not be lost to a
                 # harness kill past DEADLINE_S)
-                em.set_cpu_comparison(run_mining(
+                cpu_cmp = _banked("mining_cpu_cmp", lambda: run_mining(
                     "cpu", f.name, attempts=1,
                     timeout=min(600, max(_remaining() - 30, 60)),
-                ))
+                ), budget_s=180)
+                if cpu_cmp is not None:
+                    em.set_cpu_comparison(cpu_cmp)
         else:
             # CPU evidence first, re-probing the pool in the background the
             # whole time; if the pool comes back, the TPU suite runs too.
