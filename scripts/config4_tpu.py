@@ -312,7 +312,13 @@ def run_device_gen(args, dev) -> int:
         out["mine_s"] = round(count_w + emit_w, 3)
         out["count_s"] = round(count_w, 3)
         out["emit_s"] = round(emit_w, 3)
-        out["rows_per_s"] = round(info["expected_rows_total"] / (count_w + emit_w), 1)
+        # normalize by the memberships the mine actually counted, keeping
+        # the key comparable with host-path rows/s (ADVICE r4 #1); the
+        # model-wide expectation travels separately, unmistakably named
+        out["rows_per_s"] = round(measured_rows / (count_w + emit_w), 1)
+        out["model_rows_per_s"] = round(
+            info["expected_rows_total"] / (count_w + emit_w), 1
+        )
         log(f"mine[warm]: counts {count_w:.2f}s + emission {emit_w:.2f}s")
         print(json.dumps(out))
     return 0

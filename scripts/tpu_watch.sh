@@ -4,17 +4,22 @@
 # saving every emitted artifact line (bench.py prints checkpoints + a final
 # line; the last JSON line is the artifact). Windows are short (~15 min) and
 # sporadic, so the probe is bounded and the bench deadline stays under the
-# window length.
+# window length. Captures COMPOUND: every run shares one KMLS_BENCH_STATE
+# bank, so a second window skips the phases a first window already banked
+# and spends its minutes on the still-missing ones.
 cd "$(dirname "$0")/.." || exit 1
 N=0
-MAX_CAPTURES=${TPU_WATCH_MAX_CAPTURES:-3}
+ROUND=${TPU_WATCH_ROUND:-r05}
+MAX_CAPTURES=${TPU_WATCH_MAX_CAPTURES:-4}
 LOG=${TPU_WATCH_LOG:-tpu_watch.log}
+STATE=${TPU_WATCH_STATE:-bench_state_${ROUND}_tpu.json}
 while true; do
   if timeout 120 python -c "import jax; d = jax.devices()[0]; assert d.platform != 'cpu', d" 2>>"$LOG"; then
     N=$((N + 1))
-    OUT="BENCH_PREVIEW_r04_tpu_${N}.jsonl"
-    echo "$(date -u +%FT%TZ) pool UP — bench capture $N -> $OUT" >>"$LOG"
+    OUT="BENCH_PREVIEW_${ROUND}_tpu_${N}.jsonl"
+    echo "$(date -u +%FT%TZ) pool UP — bench capture $N -> $OUT (state bank $STATE)" >>"$LOG"
     KMLS_BENCH_DEADLINE_S=${TPU_WATCH_DEADLINE_S:-900} \
+    KMLS_BENCH_STATE="$STATE" \
       timeout 1100 python bench.py >"$OUT" 2>>"$LOG"
     echo "$(date -u +%FT%TZ) capture $N done rc=$?" >>"$LOG"
     [ "$N" -ge "$MAX_CAPTURES" ] && exit 0

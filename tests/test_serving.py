@@ -576,6 +576,26 @@ class TestAppRouting:
         assert app.handle("GET", "/static/nope.css", None)[0] == 404
         assert app.handle("GET", "/static/", None)[0] == 404
 
+    def test_static_rejects_symlink_escape(self, tmp_path):
+        """Confinement resolves symlinks (ADVICE r4 #4): a link planted
+        inside an operator-supplied static dir must not serve files
+        outside the root."""
+        (tmp_path / "templates").mkdir()
+        static = tmp_path / "static"
+        static.mkdir()
+        (tmp_path / "templates" / "client.html").write_text("<html></html>")
+        secret = tmp_path / "secret.txt"
+        secret.write_text("leak")
+        (static / "inside.css").write_text("body{}")
+        (static / "link.css").symlink_to(secret)
+        app = RecommendApp(
+            ServingConfig(
+                base_dir=str(tmp_path), app_path_from_root=str(tmp_path)
+            )
+        )
+        assert app.handle("GET", "/static/inside.css", None)[0] == 200
+        assert app.handle("GET", "/static/link.css", None)[0] == 404
+
     def test_app_path_from_root_overrides_template_and_static(self, tmp_path):
         """APP_PATH_FROM_ROOT is live config, not a dead knob (the
         reference resolves its template/static dirs from it,
@@ -605,6 +625,30 @@ class TestAppRouting:
         assert status == 200
         assert "kmls_requests_total 1" in text
         assert "kmls_reloads_total 1" in text
+
+    def test_metrics_reset_windows_latency_only(self, app):
+        """POST /metrics/reset (VERDICT r4 #7) clears the latency
+        reservoir so a harness can window percentiles per replay run,
+        while the Prometheus counters stay cumulative."""
+        self._post(app, {"songs": ["whatever"]})
+        import json as json_mod
+
+        status, _, payload = app.handle(
+            "POST", "/metrics/reset", b"", client_host="127.0.0.1"
+        )
+        assert status == 200
+        assert json_mod.loads(payload)["discarded"] == 1
+        text = app.handle("GET", "/metrics", None)[2].decode()
+        assert 'kmls_request_latency_seconds{quantile="0.5"} 0.000000' in text
+        assert "kmls_requests_total 1" in text  # counter survives the reset
+
+    def test_metrics_reset_guarded_to_loopback(self, app):
+        status, _, _ = app.handle(
+            "POST", "/metrics/reset", b"", client_host="10.2.3.4"
+        )
+        assert status == 403
+        # a direct in-process call (no transport) is inherently local
+        assert app.handle("POST", "/metrics/reset", b"")[0] == 200
 
     def test_unknown_route_404(self, app):
         assert app.handle("GET", "/nope", None)[0] == 404
