@@ -227,7 +227,7 @@ class TpuProber:
                     else "error"
                 )
         except subprocess.TimeoutExpired:
-            proc.kill()
+            _kill_tree(proc)
             proc.communicate()
             outcome = "hang"
             detail = f"probe exceeded {self.probe_timeout_s:.0f}s (pool unreachable)"
@@ -290,9 +290,28 @@ _LIVE_PROCS: "set[subprocess.Popen]" = set()
 def _tracked_popen(*args, **kwargs) -> subprocess.Popen:
     for p in [p for p in _LIVE_PROCS if p.poll() is not None]:
         _LIVE_PROCS.discard(p)
+    # own process group: phases can spawn grandchildren (the tune phase
+    # runs one worker subprocess per config), and killing only the direct
+    # child would orphan a grandchild holding the TPU
+    kwargs.setdefault("start_new_session", True)
     proc = subprocess.Popen(*args, **kwargs)
     _LIVE_PROCS.add(proc)
     return proc
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    """SIGKILL the phase's whole process group, then the direct child as
+    a fallback (never raises)."""
+    import signal as _signal
+
+    try:
+        os.killpg(proc.pid, _signal.SIGKILL)
+    except (OSError, PermissionError):
+        pass
+    try:
+        proc.kill()
+    except Exception:
+        pass
 
 
 class ArtifactEmitter:
@@ -393,10 +412,7 @@ def _install_crash_handlers(emitter: ArtifactEmitter) -> None:
             note=f"signal {signum} at t={_elapsed():.0f}s" if signum else None
         )
         for p in list(_LIVE_PROCS):
-            try:
-                p.kill()
-            except Exception:
-                pass
+            _kill_tree(p)
         if signum is not None:
             sys.stdout.flush()
             sys.stderr.flush()
@@ -832,6 +848,21 @@ runpy.run_path("scripts/config4_tpu.py", run_name="__main__")
 
 # the reference's 68-point support sweep (machine-learning/main.py:450-473
 # grid) through the count-once harness, on-device
+# on-hardware tile sweep for the Pallas VPU kernel (VERDICT r4 #4):
+# scripts/popcount_tune.py runs each (variant, tile) config in its own
+# subprocess and prints checkpoint + winner lines. The parent process
+# must NOT import jax — holding a live TPU client would wedge every
+# worker on a single-tenant chip — so the watchdog's "device:" match is
+# satisfied with a sentinel; real backend-hang protection is each
+# worker's own --timeout, and the workers' true device lines are relayed
+# as they finish.
+_TUNE_BENCH = r"""
+import runpy, sys
+print("device: pending (tune workers own the chip)", file=sys.stderr, flush=True)
+sys.argv = ["popcount_tune", "--timeout", "300"] + sys.argv[1:]
+runpy.run_path("scripts/popcount_tune.py", run_name="__main__")
+"""
+
 _SWEEP_BENCH = r"""
 import json, os, sys, tempfile, time
 import numpy as np
@@ -998,7 +1029,7 @@ def _run_phase(
                     f"{grace:.0f}s — backend init hang; killing "
                     "early instead of burning the phase timeout"
                 )
-                proc.kill()
+                _kill_tree(proc)
                 proc.wait()
                 t_err.join(timeout=10)
                 t_out.join(timeout=10)
@@ -1018,7 +1049,7 @@ def _run_phase(
             try:
                 proc.wait(timeout=max(timeout - (time.monotonic() - t_phase), 5.0))
             except subprocess.TimeoutExpired:
-                proc.kill()
+                _kill_tree(proc)
                 timed_out = True
                 log(f"{name} phase timed out after {timeout:.0f}s (backend hang?)")
         proc.wait()
@@ -1296,7 +1327,7 @@ def replay_phase(platform: str) -> dict | None:
             try:
                 server.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                server.kill()
+                _kill_tree(server)
 
 
 def _mfu_keys(mining: dict, prefix: str = "mining") -> dict:
@@ -1537,6 +1568,34 @@ def run_tpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         result["sweep_total_s"] = sweep["total_s"]
         result["sweep_emission_total_s"] = sweep["emission_total_s"]
         result["sweep_setup_plus_count_s"] = sweep["setup_plus_count_s"]
+    em.checkpoint()
+
+    # on-hardware Pallas tile tune (VERDICT r4 #4): pins the kernel's
+    # tile defaults from measurement instead of guesswork, and settles
+    # VPU-vs-MXU with same-bitset numbers (the popcount phase above
+    # carries the MXU twin). Named "pallas-tune" — NOT "popcount-..." —
+    # so result salvage/log greps can't confuse it with the kernel phase.
+    def _tune_runner() -> dict | None:
+        r = _run_phase(
+            "pallas-tune", _TUNE_BENCH, [],
+            platform="tpu", timeout=min(900, _remaining()),
+        )
+        # a no-config-succeeded error is a failure, not a result — banking
+        # it would replay the failure into every later window
+        return None if r is None or "error" in r else r
+
+    tune = _banked("popcount_tune_tpu", _tune_runner, budget_s=240)
+    if tune is not None:
+        for src, dst in (
+            ("best_config", "popcount_tune_best_config"),
+            ("best_variant", "popcount_tune_best_variant"),
+            ("best_ms", "popcount_tune_best_ms"),
+            ("best_words_per_s", "popcount_tune_best_words_per_s"),
+            ("results", "popcount_tune_results"),
+            ("partial", "popcount_tune_partial"),
+        ):
+            if src in tune:
+                result[dst] = tune[src]
     em.checkpoint()
 
     # supplementary CPU replay: through this environment's remote-TPU

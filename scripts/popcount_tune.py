@@ -170,11 +170,21 @@ def main() -> int:
                 f"{r['words_per_s'] / 1e9:.2f} Gwords/s",
                 file=sys.stderr,
             )
+            # checkpoint after every measured config: a harness that
+            # kills a half-done sweep (short pool window) salvages the
+            # last line instead of losing every measurement
+            print(json.dumps(_summary(args, results, partial=True)),
+                  flush=True)
     if not results:
         print(json.dumps({"error": "no config succeeded"}))
         return 1
+    print(json.dumps(_summary(args, results, partial=False)))
+    return 0
+
+
+def _summary(args, results: list[dict], *, partial: bool) -> dict:
     best = min(results, key=lambda r: r["ms"])
-    print(json.dumps({
+    out = {
         "shape": f"{args.playlists}x{args.tracks}",
         "best_config": best["config"],
         "best_variant": best["variant"],
@@ -186,8 +196,10 @@ def main() -> int:
              "words_per_s": round(r["words_per_s"])}
             for r in results
         ],
-    }))
-    return 0
+    }
+    if partial:
+        out["partial"] = True
+    return out
 
 
 if __name__ == "__main__":

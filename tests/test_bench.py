@@ -315,6 +315,13 @@ class TestTpuSuiteWiring:
             "p50_ms": 0.5, "amortized_ms": 0.4,
             "p50_256_ms": 1.2, "amortized_256_ms": 1.0,
         },
+        "pallas-tune": {
+            "shape": "2246x2171", "best_config": "64x128x512",
+            "best_variant": "bcast", "best_ms": 95.0,
+            "best_words_per_s": 2.6e10,
+            "results": [{"config": "64x128x512", "variant": "bcast",
+                         "ms": 95.0, "words_per_s": 2.6e10}],
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -362,6 +369,8 @@ class TestTpuSuiteWiring:
         assert final["replay_achieved_qps"] == 1010.0
         assert final["replay_server_p50_ms"] == 2.0
         assert final["replay_runs"] == self.REPLAY["runs"]
+        assert final["popcount_tune_best_config"] == "64x128x512"
+        assert final["popcount_tune_best_ms"] == 95.0
         # the supplementary CPU replay lands under cpu_-prefixed keys
         assert final["cpu_replay_achieved_qps"] == 1010.0
 
@@ -594,7 +603,8 @@ class TestBenchStateResume:
         banked = json.loads(Path(state_path).read_text())["phases"]
         assert set(banked) == {
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
-            "config4_tpu", "scale_tpu", "sweep_tpu", "replay_cpu_supp",
+            "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
+            "replay_cpu_supp",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -625,6 +635,41 @@ class TestBenchStateResume:
         assert final["serving_batch32_p50_ms"] == 0.5
         assert final["replay_achieved_qps"] == 1010.0
         assert final["cpu_replay_achieved_qps"] == 1010.0
+        assert final["popcount_tune_best_config"] == "64x128x512"
+
+    def test_tune_error_result_is_not_banked(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        """A no-config-succeeded tune is a failure: banking it would
+        replay the failure into every later window."""
+        state_path = str(tmp_path / "bank.json")
+
+        def fake_run_phase(name, code, argv, **kw):
+            if name.startswith("pallas-tune"):
+                return {"error": "no config succeeded"}
+            for prefix, result in TestTpuSuiteWiring.CANNED.items():
+                if name.startswith(prefix):
+                    return dict(result)
+            raise AssertionError(f"unexpected phase {name!r}")
+
+        monkeypatch.setattr(bench, "STATE", bench.BenchState(state_path))
+        monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(
+            bench, "replay_phase",
+            lambda platform: dict(TestTpuSuiteWiring.REPLAY),
+        )
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
+        em = bench.ArtifactEmitter()
+        npz = tmp_path / "w.npz"
+        npz.write_bytes(b"x")
+        bench.run_tpu_suite(em, str(npz))
+        banked = json.loads(Path(state_path).read_text())["phases"]
+        assert "popcount_tune_tpu" not in banked
+        assert em.finalize()
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert "popcount_tune_best_config" not in final
 
     def test_partial_bank_runs_only_missing_phases(
         self, monkeypatch, tmp_path, capsys
