@@ -13,10 +13,11 @@ ROUND=${TPU_WATCH_ROUND:-r05}
 MAX_CAPTURES=${TPU_WATCH_MAX_CAPTURES:-4}
 LOG=${TPU_WATCH_LOG:-tpu_watch.log}
 STATE=${TPU_WATCH_STATE:-bench_state_${ROUND}_tpu.json}
+OUTDIR=${TPU_WATCH_OUTDIR:-.}
 while true; do
   if timeout 120 python -c "import jax; d = jax.devices()[0]; assert d.platform != 'cpu', d" 2>>"$LOG"; then
     N=$((N + 1))
-    OUT="BENCH_PREVIEW_${ROUND}_tpu_${N}.jsonl"
+    OUT="$OUTDIR/BENCH_PREVIEW_${ROUND}_tpu_${N}.jsonl"
     echo "$(date -u +%FT%TZ) pool UP — bench capture $N -> $OUT (state bank $STATE)" >>"$LOG"
     KMLS_BENCH_DEADLINE_S=${TPU_WATCH_DEADLINE_S:-900} \
     KMLS_BENCH_STATE="$STATE" \
@@ -25,7 +26,10 @@ while true; do
     [ "$N" -ge "$MAX_CAPTURES" ] && exit 0
     sleep 1800
   else
+    # a down-probe burns its 120 s timeout, so this cycles every ~6 min —
+    # reachability windows are ~15 min, and a 12-min cadence (the old
+    # sleep 600) could eat most of one before the capture started
     echo "$(date -u +%FT%TZ) pool down" >>"$LOG"
-    sleep 600
+    sleep 240
   fi
 done

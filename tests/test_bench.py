@@ -13,6 +13,11 @@ _spec = importlib.util.spec_from_file_location(
 bench = importlib.util.module_from_spec(_spec)
 sys.modules.setdefault("kmls_bench", bench)
 _spec.loader.exec_module(bench)
+# bench auto-adopts the newest watcher bank in cwd — a REAL window's bank
+# in the repo root must never leak measured results into these canned
+# tests, so the module-global state is forced inert here; tests that
+# exercise banking construct their own BenchState
+bench.STATE = bench.BenchState(None)
 
 
 class TestMfuKeys:
@@ -473,6 +478,150 @@ class TestMainTakeover:
         assert final["mining_cpu_s"] == 0.08
         assert final["best_mining_platform"] == "cpu"
 
+    def test_pool_down_replays_banked_tpu_suite(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        """The driver's round-end bench must inherit what the watcher's
+        windows banked: pool down for the WHOLE run + a bank holding a
+        TPU headline → the artifact goes platform=tpu, labeled with
+        bank provenance and age, CPU evidence relabeled."""
+        import threading
+
+        class DownProber:
+            def __init__(self, *a, **kw):
+                self.history = []
+                self.acquired = threading.Event()
+
+            def probe_once(self):
+                self.history.append(
+                    {"t_s": 0.0, "outcome": "hang", "dur_s": 1.0}
+                )
+                return "hang"
+
+            def start_background(self):
+                pass  # pool never comes back
+
+            def stop(self):
+                pass
+
+            def alive(self):
+                return False  # ends the probe-wait loop immediately
+
+            def history_snapshot(self):
+                return list(self.history)
+
+        state = bench.BenchState(str(tmp_path / "bank.json"))
+        state.bank("mining_tpu", dict(self.TPU_MINING))
+
+        def fake_cpu_suite(em, npz):
+            em.set_headline("cpu", dict(self.CPU_MINING))
+            em.extras["serving_batch32_p50_ms"] = 0.7
+            em.checkpoint()
+            return em.mining
+
+        def fake_tpu_suite(em, npz):
+            assert bench.STATE.replay_only, "bank replay must not run live"
+            mining = dict(self.TPU_MINING)
+            em.set_headline("tpu", mining)
+            em.extras["serving_batch32_p50_ms"] = 0.05
+            return mining
+
+        monkeypatch.setattr(bench, "STATE", state)
+        monkeypatch.setattr(bench, "TpuProber", DownProber)
+        monkeypatch.setattr(bench, "run_cpu_suite", fake_cpu_suite)
+        monkeypatch.setattr(bench, "run_tpu_suite", fake_tpu_suite)
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
+        monkeypatch.delenv("KMLS_BENCH_CPU", raising=False)
+        assert bench.main() == 0
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert final["platform"] == "tpu"
+        assert final["tpu_suite_from_bank"] is True
+        assert final["tpu_bank_age_s"] >= 0
+        assert final["cpu_serving_batch32_p50_ms"] == 0.7
+        assert final["serving_batch32_p50_ms"] == 0.05
+        assert final["mining_cpu_s"] == 0.08
+
+    def test_pool_down_without_bank_stays_cpu(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        import threading
+
+        class DownProber:
+            def __init__(self, *a, **kw):
+                self.history = []
+                self.acquired = threading.Event()
+
+            def probe_once(self):
+                return "hang"
+
+            def start_background(self):
+                pass
+
+            def stop(self):
+                pass
+
+            def alive(self):
+                return False
+
+            def history_snapshot(self):
+                return []
+
+        def fake_cpu_suite(em, npz):
+            em.set_headline("cpu", dict(self.CPU_MINING))
+            return em.mining
+
+        monkeypatch.setattr(bench, "STATE", bench.BenchState(None))
+        monkeypatch.setattr(bench, "TpuProber", DownProber)
+        monkeypatch.setattr(bench, "run_cpu_suite", fake_cpu_suite)
+        monkeypatch.setattr(
+            bench, "run_tpu_suite",
+            lambda em, npz: (_ for _ in ()).throw(
+                AssertionError("tpu suite must not run")
+            ),
+        )
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
+        monkeypatch.delenv("KMLS_BENCH_CPU", raising=False)
+        assert bench.main() == 0
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert final["platform"] == "cpu"
+        assert "tpu_suite_from_bank" not in final
+
+    def test_replay_only_suite_skips_unbanked_phases(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        """replay_only through the REAL run_tpu_suite: banked phases
+        land, missing phases are skipped, zero live runs."""
+        state_path = str(tmp_path / "bank.json")
+        state = bench.BenchState(state_path)
+        canned = TestTpuSuiteWiring.CANNED
+        state.bank("mining_tpu", dict(canned["mining"]))
+        state.bank("sweep_tpu", dict(canned["sweep"]))
+        (tmp_path / "bank.json.npz").write_bytes(b"npz")
+
+        def no_live(*a, **kw):
+            raise AssertionError("live phase ran in replay-only mode")
+
+        state2 = bench.BenchState(state_path)
+        state2.replay_only = True
+        monkeypatch.setattr(bench, "STATE", state2)
+        monkeypatch.setattr(bench, "_run_phase", no_live)
+        monkeypatch.setattr(bench, "replay_phase", no_live)
+        monkeypatch.setattr(bench, "_remaining", lambda: 1e9)
+        em = bench.ArtifactEmitter()
+        mining = bench.run_tpu_suite(em, str(tmp_path / "w.npz"))
+        assert mining == canned["mining"]
+        assert em.finalize()
+        final = json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
+        )
+        assert final["sweep_points"] == 68
+        assert "popcount_ds2_ms" not in final
+        assert "serving_batch32_p50_ms" not in final
+
     def test_failed_takeover_restores_cpu_keys(self, monkeypatch, capsys):
         self._run_main(monkeypatch, tpu_suite_succeeds=False)
         final = json.loads(
@@ -735,6 +884,41 @@ class TestBenchStateResume:
         em = bench.ArtifactEmitter()
         bench.run_tpu_suite(em, str(tmp_path / "w.npz"))
         assert mined, "expected a live re-mine when the npz sidecar is missing"
+
+    def test_resolve_state_path_rules(self, monkeypatch, tmp_path):
+        """Env wins; empty string disables; unset adopts only THIS
+        round's watcher bank (round inferred from the newest ROUND<N>.md)
+        — a previous round's bank left in the tree is never adopted."""
+        monkeypatch.setenv("KMLS_BENCH_STATE", "/x/y.json")
+        assert bench._resolve_state_path() == "/x/y.json"
+        monkeypatch.setenv("KMLS_BENCH_STATE", "")
+        assert bench._resolve_state_path() is None
+        monkeypatch.delenv("KMLS_BENCH_STATE")
+        monkeypatch.chdir(tmp_path)
+        assert bench._resolve_state_path() is None  # no round markers
+        (tmp_path / "ROUND4.md").write_text("r4")
+        (tmp_path / "ROUND5.md").write_text("r5")
+        # only the PREVIOUS round's bank exists → refused
+        (tmp_path / "bench_state_r04_tpu.json").write_text("{}")
+        assert bench._resolve_state_path() is None
+        # this round's bank exists → adopted
+        (tmp_path / "bench_state_r05_tpu.json").write_text("{}")
+        assert bench._resolve_state_path() == "bench_state_r05_tpu.json"
+
+    def test_stale_phases_dropped_at_load(self, monkeypatch, tmp_path):
+        """A bank older than the round length must not leak a previous
+        round's measurements into a fresh artifact."""
+        path = str(tmp_path / "bank.json")
+        state = bench.BenchState(path)
+        state.bank("mining_tpu", {"median_s": 0.4})
+        state.bank("sweep_tpu", {"points": 68})
+        # age one phase past the cap by rewriting its timestamp
+        raw = json.loads(Path(path).read_text())
+        raw["banked_at"]["mining_tpu"] -= bench.BenchState.MAX_AGE_S + 60
+        Path(path).write_text(json.dumps(raw))
+        fresh = bench.BenchState(path)
+        assert fresh.get("mining_tpu") is None
+        assert fresh.get("sweep_tpu") == {"points": 68}
 
     def test_unset_state_is_a_noop(self, monkeypatch, tmp_path):
         """KMLS_BENCH_STATE unset (every CI/driver-default path): nothing

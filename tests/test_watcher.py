@@ -1,0 +1,92 @@
+"""Wiring test for scripts/tpu_watch.sh — the unattended capture loop
+that turns pool reachability windows into bench artifacts. It runs for
+hours with nobody watching, so its plumbing (probe → capture file →
+state-bank env → log) is pinned here against a stubbed `python`."""
+
+import os
+import subprocess
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+STUB = """#!/bin/bash
+# stub `python`: probe calls (-c ...) succeed; `python bench.py` proves
+# the env contract by echoing it into the capture file
+if [ "$1" = "-c" ]; then
+    exit 0
+fi
+if [ "$1" = "bench.py" ]; then
+    echo "{\\"probe\\": \\"ok\\", \\"state\\": \\"$KMLS_BENCH_STATE\\", \\"deadline\\": \\"$KMLS_BENCH_DEADLINE_S\\"}"
+    exit 0
+fi
+exit 9
+"""
+
+
+def test_watch_capture_wiring(tmp_path):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    stub = bindir / "python"
+    stub.write_text(STUB)
+    stub.chmod(0o755)
+    # the watcher cd's to the repo root; redirect all of its outputs into
+    # the tmpdir via the env knobs so a test run never touches real files
+    env = dict(
+        os.environ,
+        PATH=f"{bindir}:{os.environ['PATH']}",
+        TPU_WATCH_MAX_CAPTURES="1",
+        TPU_WATCH_ROUND="rTEST",
+        TPU_WATCH_LOG=str(tmp_path / "watch.log"),
+        TPU_WATCH_STATE=str(tmp_path / "bank.json"),
+        TPU_WATCH_DEADLINE_S="111",
+        TPU_WATCH_OUTDIR=str(tmp_path),
+    )
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "tpu_watch.sh")],
+        env=env, timeout=60, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    line = (tmp_path / "BENCH_PREVIEW_rTEST_tpu_1.jsonl").read_text().strip()
+    # the capture carries the shared state bank + deadline contract
+    assert '"state": "' + str(tmp_path / "bank.json") in line
+    assert '"deadline": "111"' in line
+    log = (tmp_path / "watch.log").read_text()
+    assert "pool UP" in log and "rc=0" in log
+
+
+def test_watch_probe_failure_waits(tmp_path):
+    """A down pool must not produce a capture file; the loop logs and
+    sleeps (we kill it mid-sleep)."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    stub = bindir / "python"
+    stub.write_text("#!/bin/bash\nexit 1\n")  # every probe fails
+    stub.chmod(0o755)
+    env = dict(
+        os.environ,
+        PATH=f"{bindir}:{os.environ['PATH']}",
+        TPU_WATCH_ROUND="rTEST2",
+        TPU_WATCH_LOG=str(tmp_path / "watch.log"),
+        TPU_WATCH_STATE=str(tmp_path / "bank.json"),
+        TPU_WATCH_OUTDIR=str(tmp_path),
+    )
+    proc = subprocess.Popen(
+        ["bash", str(REPO / "scripts" / "tpu_watch.sh")],
+        env=env, start_new_session=True,
+    )
+    try:
+        deadline = time.time() + 30
+        log = tmp_path / "watch.log"
+        while time.time() < deadline:
+            if log.exists() and "pool down" in log.read_text():
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("watcher never logged the down probe")
+    finally:
+        import signal
+
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+    assert not (tmp_path / "BENCH_PREVIEW_rTEST2_tpu_1.jsonl").exists()
