@@ -227,14 +227,36 @@ class RecommendApp:
         """Render the HTML test client with a sampled seed + static sample
         (reference: rest_api/app/main.py:190-203 — which sleeps 2 s when data
         isn't loaded yet; here the page renders immediately with a notice)."""
+        # read finished_loading BEFORE best_tracks: load() publishes the
+        # tracks first, so a True snapshot guarantees the best_tracks read
+        # below sees the published value — the reverse order could blame
+        # an empty ranking for what was really an in-flight load
+        finished = self.engine.finished_loading
         best = self.engine.best_tracks
         if not best:
+            # two distinct states render here: artifacts still loading, vs
+            # loaded-but-empty popularity ranking (the reference's keep
+            # count truncates with no minimum — int(N·pct) is legitimately
+            # 0 on a tiny vocabulary). The old single message claimed
+            # "not loaded yet" for both, telling the operator to retry
+            # something that would never change.
+            if finished:
+                notice = (
+                    "<p><em>Model loaded, but the popularity ranking kept "
+                    "no tracks (vocabulary × TOP_TRACKS_SAVE_PERCENTILE "
+                    "truncates to zero) — use <a href='/docs'>/docs</a> to "
+                    "POST seed songs directly.</em></p>"
+                )
+            else:
+                notice = (
+                    "<p><em>Model artifacts not loaded yet — retry "
+                    "shortly.</em></p>"
+                )
             page = (
                 self._template
                 .replace("{{version}}", self.cfg.version)
                 .replace("{{model_date}}", str(self.engine.cache_value))
-                .replace("{{track_checkboxes}}",
-                         "<p><em>Model artifacts not loaded yet — retry shortly.</em></p>")
+                .replace("{{track_checkboxes}}", notice)
                 .replace("{{sample_seed}}", "—")
                 .replace("{{sample_recommendations}}", "")
             )

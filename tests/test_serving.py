@@ -493,6 +493,25 @@ class TestAppRouting:
         assert app.handle("GET", "/readyz", None)[0] == 503
         assert app.handle("GET", "/healthz", None)[0] == 200
 
+    def test_client_distinguishes_loading_from_empty_ranking(
+        self, tmp_path, mined_pvc
+    ):
+        """Two distinct empty-checkbox states: artifacts not loaded yet
+        (retrying helps) vs a loaded model whose popularity ranking
+        truncated to zero (int(N·pct) reference parity — retrying never
+        helps; the page must say so and point at /docs)."""
+        app = RecommendApp(ServingConfig(base_dir=str(tmp_path)))
+        html = app.handle("GET", "/", None)[2].decode()
+        assert "not loaded yet" in html
+        cfg, _, _ = mined_pvc
+        app2 = RecommendApp(cfg)
+        app2.engine.load()
+        app2.engine.best_tracks = []  # loaded, ranking kept nothing
+        html2 = app2.handle("GET", "/", None)[2].decode()
+        assert "not loaded yet" not in html2
+        assert "popularity ranking kept no tracks" in html2
+        assert "/docs" in html2
+
     def test_sigterm_drain(self, mined_pvc):
         """k8s rollout semantics: on SIGTERM the server must (a) answer
         established keep-alive connections WITH Connection: close so
