@@ -424,11 +424,12 @@ def prune_infrequent(baskets: Baskets, min_count: int) -> tuple[Baskets, np.ndar
     keep_ids = np.flatnonzero(item_counts >= min_count)
     remap = np.full(baskets.n_tracks, -1, dtype=np.int32)
     remap[keep_ids] = np.arange(len(keep_ids), dtype=np.int32)
-    selected = remap[baskets.track_ids] >= 0
+    mapped = remap[baskets.track_ids]  # one gather over the rows, reused
+    selected = mapped >= 0
     names = [baskets.vocab.names[i] for i in keep_ids]
     reduced = Baskets(
         playlist_rows=baskets.playlist_rows[selected],
-        track_ids=remap[baskets.track_ids[selected]],
+        track_ids=mapped[selected],
         n_playlists=baskets.n_playlists,  # denominator stays ALL playlists
         vocab=Vocab(names=names, index={n: i for i, n in enumerate(names)}),
     )
