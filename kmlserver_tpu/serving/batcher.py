@@ -19,9 +19,11 @@ batches removes that ceiling while jax's in-order execution queue preserves
 result ordering.
 
 Under load the window fills instantly (batch of 32 per device call); at low
-traffic a lone request pays at most the window in extra latency. A worker
-failure is propagated to every waiting request — the batcher threads
-themselves never die.
+traffic the window is SKIPPED entirely when the device is idle — waiting
+only buys throughput when a batch is already in flight, so a lone request
+dispatches immediately (batch of 1) and later arrivals form their own batch
+behind it. A worker failure is propagated to every waiting request — the
+batcher threads themselves never die.
 """
 
 from __future__ import annotations
@@ -62,6 +64,11 @@ class MicroBatcher:
         # acquire (every request then times out with no error logged);
         # "no pipelining" is depth 1, not 0
         self._inflight = threading.Semaphore(max(1, max_inflight))
+        # dispatched-but-uncompleted batch count, read by the collector's
+        # idle-fast-path (a stale read is benign: worst case one batch
+        # waits a window it didn't need, or dispatches a little early)
+        self._inflight_n = 0
+        self._n_lock = threading.Lock()
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True, name="kmls-microbatcher"
         )
@@ -82,15 +89,29 @@ class MicroBatcher:
         while True:
             first = self._queue.get()  # block for the batch leader
             batch = [first]
-            deadline = time.perf_counter() + self.window_s
+            # sweep everything already waiting, without blocking
             while len(batch) < self.max_size:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    batch.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+            with self._n_lock:
+                device_idle = self._inflight_n == 0
+            if not device_idle:
+                # device busy: the window buys amortization — keep
+                # collecting up to it (a full batch exits immediately)
+                deadline = time.perf_counter() + self.window_s
+                while len(batch) < self.max_size:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+            # else: nothing in flight — waiting can't improve throughput,
+            # it only adds the window to this batch's latency. Dispatch
+            # now; later arrivals pipeline behind as their own batch.
             # bound the pipeline: past max_inflight undispatched-but-queued
             # device calls, block here (requests keep queueing upstream and
             # land in bigger batches — backpressure, not failure)
@@ -105,6 +126,8 @@ class MicroBatcher:
                     if not pending.future.done():
                         pending.future.set_exception(exc)
                 continue
+            with self._n_lock:
+                self._inflight_n += 1
             self._completions.put((batch, finish))
 
     def _complete_loop(self) -> None:
@@ -112,11 +135,20 @@ class MicroBatcher:
             batch, finish = self._completions.get()
             try:
                 results = finish()
-                for pending, result in zip(batch, results):
-                    pending.future.set_result(result)
+                err = None
             except Exception as exc:  # propagate, don't die
+                err = exc
+            # decrement BEFORE resolving futures: set_result unblocks the
+            # client, and its immediate next request must not observe a
+            # counter that still says busy (it would pay a full window
+            # against an idle device — ping-pong traffic regression)
+            with self._n_lock:
+                self._inflight_n -= 1
+            self._inflight.release()
+            if err is not None:
                 for pending in batch:
                     if not pending.future.done():
-                        pending.future.set_exception(exc)
-            finally:
-                self._inflight.release()
+                        pending.future.set_exception(err)
+            else:
+                for pending, result in zip(batch, results):
+                    pending.future.set_result(result)

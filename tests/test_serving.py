@@ -219,6 +219,27 @@ class TestEngine:
             single, _ = engine.recommend([seeds[i % len(seeds)]])
             assert set(results[i][0]) == set(single)
 
+    def test_idle_device_skips_the_window(self):
+        # batching only buys throughput when a batch is in flight; a lone
+        # request against an idle device must dispatch immediately, not
+        # pay the collection window (here deliberately huge)
+        from kmlserver_tpu.serving.batcher import MicroBatcher
+
+        class InstantEngine:
+            def recommend_many_async(self, seed_sets):
+                def finish():
+                    return [(list(s), "rules") for s in seed_sets]
+
+                return finish
+
+        batcher = MicroBatcher(InstantEngine(), max_size=8, window_ms=400.0)
+        for trial in range(3):  # repeat: the fast path must re-arm
+            t0 = time.perf_counter()
+            got, _ = batcher.recommend([f"s{trial}"])
+            dt = time.perf_counter() - t0
+            assert got == [f"s{trial}"]
+            assert dt < 0.2, f"idle request {trial} waited {dt:.3f}s"
+
     def test_stable_seed_order_independent(self):
         assert stable_seed(["b", "a"]) == stable_seed(["a", "b"])
         assert stable_seed(["a"]) != stable_seed(["b"])
