@@ -333,6 +333,7 @@ class TestTpuSuiteWiring:
         "p95_ms": 9.0, "p99_ms": 14.0, "n_errors": 0,
         "runs": [{"p50_ms": 4.0, "achieved_qps": 1010.0, "n_errors": 0}],
         "host_load1": 0.5, "warmup_requests": 1000,
+        "job_end_to_end_s": 3.5,
         "server_percentiles": {"p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": 8.0},
     }
 
@@ -374,6 +375,7 @@ class TestTpuSuiteWiring:
         assert final["replay_achieved_qps"] == 1010.0
         assert final["replay_server_p50_ms"] == 2.0
         assert final["replay_runs"] == self.REPLAY["runs"]
+        assert final["replay_job_end_to_end_s"] == 3.5
         assert final["popcount_tune_best_config"] == "64x128x512"
         assert final["popcount_tune_best_ms"] == 95.0
         # the supplementary CPU replay lands under cpu_-prefixed keys
