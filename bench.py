@@ -314,13 +314,64 @@ def _kill_tree(proc: subprocess.Popen) -> None:
         pass
 
 
+# hard bound on every stdout artifact line: the driver parses the last
+# JSON line within a ~2,000-char tail window, and the r05 headline was
+# lost to its own key growth (final line 2,112 chars → parsed: null in
+# BENCH_r05.json). 1,800 leaves margin for a trailing newline + partial
+# flushes.
+COMPACT_LINE_LIMIT = 1800
+
+# key order for the compact line: identity + headline first, then the
+# judged serving-path numbers, then utilization/scale evidence; anything
+# that doesn't fit lives only in the sidecar (which always has everything)
+_COMPACT_PRIORITY = (
+    "metric", "value", "unit", "vs_baseline", "platform",
+    "checkpoint", "aborted", "full_artifact",
+    "best_mining_s", "best_mining_platform", "vs_baseline_best",
+    "mining_cpu_s", "mining_count_path",
+    "replay_target_qps", "replay_achieved_qps", "replay_p50_ms",
+    "replay_p95_ms", "replay_p99_ms", "replay_errors",
+    "replay_queue_wait_p99_ms", "replay_device_p99_ms",
+    "replay_queue_wait_p50_ms", "replay_device_p50_ms", "replay_e2e_p999_ms",
+    "replay_server_p50_ms", "replay_server_p95_ms", "replay_server_p99_ms",
+    "serving_batch32_p50_ms", "serving_batch32_amortized_ms",
+    "serving_batch256_p50_ms", "serving_batch256_amortized_ms",
+    "mining_mfu_pct", "mining_mfu_peak_tops", "mining_matmul_gops_per_s",
+    "config4_mine_s", "config4_rows_per_s", "scale_1m_x_100k_mine_s",
+    "popcount_words_per_s", "sweep_points",
+    "tpu_suite_from_bank", "tpu_bank_age_s",
+)
+
+
+def _compact_line(full: dict, limit: int = COMPACT_LINE_LIMIT) -> str:
+    """Serialize ``full`` into a JSON line guaranteed ≤ ``limit`` chars:
+    keys added greedily in priority order (then insertion order) while the
+    serialized line still fits. The full dict always reaches the sidecar;
+    this bounds only what rides stdout past the driver's tail window."""
+    ordered = [k for k in _COMPACT_PRIORITY if k in full]
+    seen = set(ordered)
+    ordered += [k for k in full if k not in seen]
+    out: dict = {}
+    line = "{}"
+    for key in ordered:
+        candidate = json.dumps({**out, key: full[key]})
+        if len(candidate) <= limit:
+            out[key] = full[key]
+            line = candidate
+    return line
+
+
 class ArtifactEmitter:
     """Crash-proof artifact emission (VERDICT r3 next-round #1).
 
     Holds the headline mining result + every optional phase's keys
-    (``extras``) and prints a COMPLETE artifact line on every
-    :meth:`checkpoint` — the driver parses the last JSON line on stdout,
-    so each print strictly supersedes the previous one. Signal-handler
+    (``extras``) and prints an artifact line on every :meth:`checkpoint` —
+    the driver parses the last JSON line on stdout, so each print strictly
+    supersedes the previous one. Stdout lines are the COMPACT projection
+    (≤ 1,800 chars — the r05 headline was lost to a 2,112-char line
+    overrunning the driver's tail window) with the complete artifact
+    mirrored to a sidecar file (``KMLS_BENCH_SIDECAR``, default
+    ``bench_full.json``) on every emission. Signal-handler
     emissions (``note`` set) are prefixed with a newline so they land on
     a fresh line even if the signal interrupted the main thread
     mid-write; normal checkpoints don't need it (the emitter is the only
@@ -339,6 +390,42 @@ class ArtifactEmitter:
         self.extras: dict = {}
         self._finalized = False
         self._last_printed: str | None = None
+        # every stdout line is the COMPACT projection (≤ 1,800 chars so the
+        # driver's tail window can never lose it again); the complete
+        # artifact goes to this sidecar on every checkpoint. The default
+        # name is per-PROCESS: the watcher and the driver share one cwd
+        # (the same topology the bank's merge-on-write exists for), and a
+        # fixed shared name would let them clobber each other's artifact
+        # while both compact lines point at it. Empty string disables the
+        # sidecar (stdout stays compact regardless).
+        self.sidecar_path = (
+            os.environ.get(
+                "KMLS_BENCH_SIDECAR", f"bench_full_{os.getpid()}.json"
+            ) or None
+        )
+        self._sidecar_ok = False
+
+    def _write_sidecar(self, line: dict) -> None:
+        if self.sidecar_path is None:
+            return
+        tmp = self.sidecar_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(line, f, indent=1)
+            os.replace(tmp, self.sidecar_path)
+            self._sidecar_ok = True
+        except OSError as exc:
+            # drop the pointer too: advertising full_artifact after a
+            # failed write would hand consumers a STALE sidecar missing
+            # this checkpoint's keys
+            self._sidecar_ok = False
+            log(f"sidecar write failed ({exc}); stdout line still emitted")
+
+    def _render(self, line: dict) -> str:
+        self._write_sidecar(line)
+        if self._sidecar_ok:
+            line = {**line, "full_artifact": self.sidecar_path}
+        return _compact_line(line)
 
     def set_headline(self, platform: str, mining: dict) -> None:
         with self._lock:
@@ -374,7 +461,7 @@ class ArtifactEmitter:
             line = self.compose(checkpoint=True, note=note)
             if line is None:
                 return
-            s = json.dumps(line)
+            s = self._render(line)
             if s == self._last_printed:
                 return
             sys.stdout.write(("\n" if note else "") + s + "\n")
@@ -388,7 +475,7 @@ class ArtifactEmitter:
             line = self.compose(checkpoint=False)
             if line is None:
                 return False
-            sys.stdout.write(json.dumps(line) + "\n")
+            sys.stdout.write(self._render(line) + "\n")
             sys.stdout.flush()
             self._finalized = True
             return True
@@ -468,24 +555,25 @@ class BenchState:
                 ):
                     raise ValueError("not a phase-bank object")
                 self.phases = dict(data["phases"])
-                # v1 files carry no timestamps: treat as fresh (the age
-                # guard exists for v2 banks crossing a round boundary);
-                # non-numeric timestamps count as stale, never as a crash
+                # every writer stamps banked_at (v2); an entry WITHOUT a
+                # numeric timestamp is a legacy v1 bank (or a corrupted
+                # one) of unknowable age — treat it as stale, never as
+                # fresh: bench_state_*_tpu.json is committable round
+                # evidence and _resolve_state_path auto-adopts it, so a
+                # timestampless entry in the tree would otherwise replay
+                # into every fresh-checkout artifact forever (ADVICE r5 #4)
                 meta = data.get("banked_at")
+                meta = meta if isinstance(meta, dict) else {}
                 self.banked_at = {
                     n: t for n, t in meta.items()
                     if isinstance(t, (int, float))
-                } if isinstance(meta, dict) else {}
+                }
                 now = time.time()
                 stale = [
-                    n for n, t in self.banked_at.items()
-                    if now - t > self.MAX_AGE_S
+                    n for n in self.phases
+                    if self.banked_at.get(n) is None
+                    or now - self.banked_at[n] > self.MAX_AGE_S
                 ]
-                if isinstance(meta, dict):
-                    stale += [
-                        n for n, t in meta.items()
-                        if not isinstance(t, (int, float))
-                    ]
                 for n in stale:
                     self.phases.pop(n, None)
                     self.banked_at.pop(n, None)
@@ -522,8 +610,11 @@ class BenchState:
         # merge-on-write: the watcher and the driver can share one bank
         # (auto-adoption makes that the default topology) — a blind dump
         # of this process's view would erase phases the other process
-        # banked since our load. Phases banked by this process win their
-        # own names; everything else on disk is preserved.
+        # banked since our load. NEWEST banked_at wins regardless of
+        # origin (ADVICE r5 #2): "own names win" would let a process
+        # overwrite a fresher on-disk result with the stale copy it merely
+        # loaded at startup. The phase just banked above carries a
+        # timestamp of now, so it wins its own name naturally.
         phases, banked_at = dict(self.phases), dict(self.banked_at)
         try:
             with open(self.path) as f:
@@ -532,10 +623,13 @@ class BenchState:
                 disk_at = disk.get("banked_at")
                 disk_at = disk_at if isinstance(disk_at, dict) else {}
                 for other, res in disk["phases"].items():
-                    if other not in phases:
+                    disk_t = disk_at.get(other)
+                    if not isinstance(disk_t, (int, float)):
+                        continue  # timestampless disk entry = stale
+                    ours_t = banked_at.get(other)
+                    if other not in phases or ours_t is None or disk_t > ours_t:
                         phases[other] = res
-                        if isinstance(disk_at.get(other), (int, float)):
-                            banked_at[other] = disk_at[other]
+                        banked_at[other] = disk_t
         except (OSError, ValueError, TypeError):
             pass  # no readable disk copy to merge — write ours
         tmp = self.path + ".tmp"
@@ -623,15 +717,26 @@ def _release_tpu_lock(lock) -> None:
 
 
 def _banked(
-    name: str, runner, budget_s: float | None = None
+    name: str, runner, budget_s: float | None = None,
+    extras: dict | None = None,
 ) -> dict | None:
     """Replay ``name`` from the state bank, or run it live and bank the
     result. A banked phase replays for free — even past the deadline gate;
     a live run happens only with ``budget_s`` of deadline headroom (None =
-    no gate, the caller gates) and never in replay-only mode."""
+    no gate, the caller gates) and never in replay-only mode.
+
+    A replayed phase stamps ``<name>_from_bank`` / ``<name>_bank_age_s``
+    into ``extras`` (the artifact's extra-key dict) so a mixed artifact —
+    fresh mining next to hours-old banked phases — says which numbers came
+    from which window (ADVICE r5 #1)."""
     cached = STATE.get(name)
     if cached is not None:
         log(f"{name}: banked from a prior window — skipping live run")
+        if extras is not None:
+            extras[f"{name}_from_bank"] = True
+            age = STATE.age_s(name)
+            if age is not None:
+                extras[f"{name}_bank_age_s"] = round(age)
         return dict(cached)
     if STATE.replay_only:
         return None
@@ -1042,24 +1147,24 @@ print("{}")
 """
 
 _REPLAY_CLIENT = r"""
-import json, os, pickle, sys
-from kmlserver_tpu.serving.replay import (
-    pooled_http_sender_factory, replay_pooled, sample_seed_sets,
-)
+import os, pickle, sys
+from kmlserver_tpu.serving.replay import replay_async_http, sample_seed_sets
 
 url, qps, n, pickles = sys.argv[1], float(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
 # seed vocabulary straight from the artifact pickle — no jax in the client
 # (the server owns the TPU; libtpu is one process per chip)
 with open(pickles, "rb") as f:
     vocab = sorted(pickle.load(f).keys())
-# worker-pool sizing is Little's law: in-flight = QPS x latency. Through
-# the remote-TPU tunnel responses take ~0.3-0.5 s, so 1k QPS needs
-# hundreds of blocking workers; the local-chip/CPU default of 64 would
-# itself cap throughput and mismeasure the server
-report = replay_pooled(
-    pooled_http_sender_factory(url), sample_seed_sets(vocab, n), qps=qps,
-    n_workers=int(os.environ.get("KMLS_BENCH_REPLAY_WORKERS", "64")),
-    max_queue=int(os.environ.get("KMLS_BENCH_REPLAY_QUEUE", "512")),
+# the single-loop pipelined client (replay_async_http): thread-pool
+# loadgens convoy on the GIL and pay ~2 syscall traps per request on this
+# sandbox — they melt before the server does and mismeasure it. In-flight
+# capacity = n_conns x pipeline; through the remote-TPU tunnel (~0.3-0.5 s
+# per response) Little's law at 1k QPS needs ~500 in flight, so the conn
+# count scales with the env override rather than a fixed 64.
+report = replay_async_http(
+    url, sample_seed_sets(vocab, n), qps=qps,
+    n_conns=min(int(os.environ.get("KMLS_BENCH_REPLAY_WORKERS", "48")), 128),
+    max_queue=int(os.environ.get("KMLS_BENCH_REPLAY_QUEUE", "4096")),
 )
 print(report.to_json())
 """
@@ -1255,9 +1360,32 @@ def _parse_latency_percentiles(metrics_text: str) -> dict:
     return out
 
 
+def _parse_attribution(metrics_text: str) -> dict:
+    """Queue-vs-device attribution summaries (serving/metrics.py renders
+    them in milliseconds) → {"queue_wait_p99_ms": ..., ...} (empty if
+    absent — an old server simply doesn't carry the split)."""
+    out = {}
+    for metric, label in (
+        ("kmls_queue_wait_ms", "queue_wait"),
+        ("kmls_device_ms", "device"),
+        ("kmls_e2e_ms", "e2e"),
+    ):
+        for q, suffix in (
+            ("0.5", "p50_ms"), ("0.99", "p99_ms"), ("0.999", "p999_ms")
+        ):
+            m = re.search(
+                r'%s\{quantile="%s"\} ([0-9.eE+-]+)' % (metric, q),
+                metrics_text,
+            )
+            if m:
+                out[f"{label}_{suffix}"] = float(m.group(1))
+    return out
+
+
 def _scrape_server_percentiles(url: str) -> dict | None:
     """Read the server's own latency percentiles from /metrics
-    (serving/metrics.py renders them) → {"p50_ms": ..., ...} or None.
+    (serving/metrics.py renders them) → {"p50_ms": ..., ...} or None,
+    plus the queue-vs-device attribution under an "attribution" subkey.
     Recording these NEXT TO the client-observed replay numbers separates
     server time from harness queueing (VERDICT r2 next-round #7)."""
     try:
@@ -1266,7 +1394,13 @@ def _scrape_server_percentiles(url: str) -> dict | None:
     except Exception as exc:
         log(f"[replay] /metrics scrape failed: {type(exc).__name__}: {exc}")
         return None
-    return _parse_latency_percentiles(text) or None
+    pcts = _parse_latency_percentiles(text)
+    if not pcts:
+        return None
+    attribution = _parse_attribution(text)
+    if attribution:
+        pcts["attribution"] = attribution
+    return pcts
 
 
 def _reset_server_metrics(url: str) -> bool:
@@ -1633,6 +1767,10 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
             shutil.copyfile(STATE.npz_path, npz_path)
             log("mining_tpu: banked from a prior window — skipping live run")
             mining = dict(banked_mining)
+            result["mining_tpu_from_bank"] = True
+            age = STATE.age_s("mining_tpu")
+            if age is not None:
+                result["mining_tpu_bank_age_s"] = round(age)
         except OSError as exc:
             log(f"state bank npz restore failed ({exc}); re-mining live")
     if mining is None and banked_mining is not None and STATE.replay_only:
@@ -1640,6 +1778,10 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # banked headline alone is still real on-chip evidence
         log("mining_tpu: banked (npz sidecar missing; serving skipped)")
         mining = dict(banked_mining)
+        result["mining_tpu_from_bank"] = True
+        age = STATE.age_s("mining_tpu")
+        if age is not None:
+            result["mining_tpu_bank_age_s"] = round(age)
     if mining is None:
         if STATE.replay_only:
             return None  # no live runs in replay-only mode
@@ -1670,7 +1812,7 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         "popcount", _POPCOUNT_BENCH,
         ["compiled", "2246", "2171", "240249"],
         platform="tpu", timeout=min(900, _remaining()),
-    ), budget_s=240)
+    ), budget_s=240, extras=result)
     if popcount is not None:
         log(
             f"popcount kernel [{popcount['kernel']}] (compiled TPU, "
@@ -1703,7 +1845,7 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
     config4 = _banked("config4_tpu", lambda: _run_phase(
         "config4-devicegen", _CONFIG4_BENCH, ["--device-gen"],
         platform="tpu", timeout=min(900, _remaining()),
-    ), budget_s=300)
+    ), budget_s=300, extras=result)
     if config4 is not None:
         for src, dst in (
             ("mine_s", "config4_mine_s"),
@@ -1730,7 +1872,7 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         ["--playlists", "1000000", "--tracks", "100000",
          "--rows", "50000000", "--min-support", "0.001"],
         platform="tpu", timeout=min(900, _remaining()),
-    ), budget_s=300)
+    ), budget_s=300, extras=result)
     if scale is not None:
         result["scale_1m_x_100k_mine_s"] = scale["mine_s"]
         result["scale_rows_per_s"] = scale["rows_per_s"]
@@ -1752,7 +1894,7 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
     sweep = _banked("sweep_tpu", lambda: _run_phase(
         "sweep", _SWEEP_BENCH, [], platform="tpu",
         timeout=min(600, _remaining()),
-    ), budget_s=180)
+    ), budget_s=180, extras=result)
     if sweep is not None:
         result["sweep_points"] = sweep["points"]
         result["sweep_total_s"] = sweep["total_s"]
@@ -1774,7 +1916,9 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # it would replay the failure into every later window
         return None if r is None or "error" in r else r
 
-    tune = _banked("popcount_tune_tpu", _tune_runner, budget_s=240)
+    tune = _banked(
+        "popcount_tune_tpu", _tune_runner, budget_s=240, extras=result
+    )
     if tune is not None:
         for src, dst in (
             ("best_config", "popcount_tune_best_config"),
@@ -1904,7 +2048,7 @@ def _record_serving(
             timeout=min(900, _remaining()),
         )
 
-    serving = _banked(bank, _run, budget_s) if bank else _run()
+    serving = _banked(bank, _run, budget_s, extras=result) if bank else _run()
     if serving is None:
         return
     p50 = serving["p50_ms"]
@@ -1934,7 +2078,7 @@ def _record_replay(
             log(f"replay phase crashed ({type(exc).__name__}: {exc}); skipping")
             return None
 
-    replay = _banked(bank, _run, budget_s) if bank else _run()
+    replay = _banked(bank, _run, budget_s, extras=result) if bank else _run()
     if replay is None:
         return
     log(
@@ -1972,8 +2116,21 @@ def _record_replay(
             f"p50 {server_pcts.get('p50_ms', float('nan')):.2f}ms "
             f"(client-server p50 gap {gap:.2f}ms = harness queueing + HTTP)"
         )
+        attribution = server_pcts.get("attribution") or {}
         for key, val in server_pcts.items():
-            result[f"replay_server_{key}"] = round(val, 3)
+            if key != "attribution":
+                result[f"replay_server_{key}"] = round(val, 3)
+        # the queue-vs-device split: WHERE the server-side tail lives
+        # (replay_queue_wait_p99_ms vs replay_device_p99_ms), so the next
+        # round optimizes the right stage instead of guessing
+        for key, val in attribution.items():
+            result[f"replay_{key}"] = round(val, 3)
+        if "queue_wait_p99_ms" in attribution and "device_p99_ms" in attribution:
+            log(
+                f"replay attribution: queue-wait p99 "
+                f"{attribution['queue_wait_p99_ms']:.2f}ms vs device p99 "
+                f"{attribution['device_p99_ms']:.2f}ms"
+            )
 
 
 def _tpu_takeover(
@@ -2037,7 +2194,7 @@ def main() -> int:
                 cpu_cmp = _banked("mining_cpu_cmp", lambda: run_mining(
                     "cpu", f.name, attempts=1,
                     timeout=min(600, max(_remaining() - 30, 60)),
-                ), budget_s=180)
+                ), budget_s=180, extras=result)
                 if cpu_cmp is not None:
                     em.set_cpu_comparison(cpu_cmp)
         else:

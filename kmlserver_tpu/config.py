@@ -194,15 +194,41 @@ class ServingConfig:
     # requests are bucketed to powers of two up to this bound.
     max_seed_tracks: int = 128
     # Micro-batching window for aggregating concurrent requests into one
-    # device call (milliseconds); 0 disables batching.
+    # device call (milliseconds); 0 disables batching. With the adaptive
+    # controller on, this is the window CEILING — the controller sizes the
+    # actual wait from the observed arrival rate and the shed budget.
     batch_window_ms: float = 2.0
     batch_max_size: int = 32
+    # Adaptive deadline-aware window: size the collection wait from the
+    # arrival-gap EWMA (time to fill the batch at the current rate) instead
+    # of always burning the full fixed window. Off = fixed window.
+    batch_adaptive_window: bool = True
+    # Floor for the adaptive window (milliseconds). Not lower: closed-loop
+    # clients arrive in bursts (a completed batch releases its waiters at
+    # once), and a near-zero floor splits each wave into undersized
+    # batches — measured 896 vs 1000+ QPS through the 65 ms-RTT tunnel
+    # model at 0.2 ms.
+    batch_window_min_ms: float = 1.0
+    # Load shedding: when the PROJECTED queue wait for a new request
+    # exceeds this budget (milliseconds), reject it up front with HTTP 429
+    # + Retry-After instead of letting it rot in the queue (backpressure
+    # made visible, not a silent p99 cliff). 0 disables shedding.
+    shed_queue_budget_ms: float = 250.0
+    # Retry-After hint (seconds) returned with a 429 shed.
+    shed_retry_after_s: float = 1.0
     # Device-call pipeline depth: batches dispatched but not yet completed.
     # >1 overlaps the next batch's dispatch with the previous transfer —
     # essential when the host<->device link is high-latency (remote tunnel).
     batch_max_inflight: int = 4
     # Prefer the tensor-native npz artifact over the pickle when present.
     prefer_tensor_artifact: bool = True
+    # On a CPU backend, serve lookups with the native C++ kernel
+    # (native/kmls_serve.cpp) instead of the jitted XLA kernel — exact
+    # (lax.top_k tie order reproduced), ~24x faster on the scatter-bound
+    # XLA:CPU path (measured 12.6 -> 0.52 ms per 32-row ds2 batch).
+    # Ignored on accelerators; falls back automatically when the .so
+    # can't build. KMLS_NATIVE=0 also kills it.
+    native_serve: bool = True
 
     @property
     def pickles_dir(self) -> str:
@@ -227,6 +253,11 @@ class ServingConfig:
             max_seed_tracks=_getenv_int("KMLS_MAX_SEED_TRACKS", 128),
             batch_window_ms=_getenv_float("KMLS_BATCH_WINDOW_MS", 2.0),
             batch_max_size=_getenv_int("KMLS_BATCH_MAX_SIZE", 32),
+            batch_adaptive_window=_getenv_bool("KMLS_BATCH_ADAPTIVE", True),
+            batch_window_min_ms=_getenv_float("KMLS_BATCH_WINDOW_MIN_MS", 1.0),
+            shed_queue_budget_ms=_getenv_float("KMLS_SHED_QUEUE_BUDGET_MS", 250.0),
+            shed_retry_after_s=_getenv_float("KMLS_SHED_RETRY_AFTER_S", 1.0),
             batch_max_inflight=_getenv_int("KMLS_BATCH_MAX_INFLIGHT", 4),
             prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),
+            native_serve=_getenv_bool("KMLS_NATIVE_SERVE", True),
         )

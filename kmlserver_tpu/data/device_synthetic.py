@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcompat import shard_map
 from .synthetic import zipf_weights
 
 # margin (in standard deviations of Binomial at min_count) for the
@@ -216,7 +217,7 @@ def _sharded_gen_fn(mesh, n_playlists, w_local, row_block, n_blocks):
 
     spec = jsh.PartitionSpec
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             shard_gen, mesh=mesh, in_specs=(spec(), spec()),
             out_specs=spec(None, AXIS_DP),
         )

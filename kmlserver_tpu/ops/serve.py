@@ -25,8 +25,7 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnames=("k_best",))
-def recommend_batch(
+def _recommend_batch_impl(
     rule_ids: jax.Array,  # int32 (V, K_max), -1 padded
     rule_confs: jax.Array,  # float32 (V, K_max), 0 padded
     seed_ids: jax.Array,  # int32 (B, L), -1 padded
@@ -55,3 +54,19 @@ def recommend_batch(
         top_ids = jnp.pad(top_ids, pad, constant_values=-1)
         top_confs = jnp.pad(top_confs, pad)
     return top_ids, top_confs
+
+
+recommend_batch = partial(jax.jit, static_argnames=("k_best",))(
+    _recommend_batch_impl
+)
+
+# Donating twin: the padded seed buffer is consumed by the call, letting XLA
+# reuse its device memory for the outputs — steady-state batches then do no
+# fresh HBM allocation on the seed path. Each dispatch stages a new seed
+# array anyway (the host staging buffer is what gets reused), so donation
+# costs nothing. Kept separate from `recommend_batch` because donation on
+# the CPU backend is unimplemented and warns per call; the engine picks the
+# donating variant only on accelerator backends.
+recommend_batch_donated = partial(
+    jax.jit, static_argnames=("k_best",), donate_argnums=(2,)
+)(_recommend_batch_impl)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..mining.vocab import Baskets
 from ..ops import encode
+from ..utils.jaxcompat import pcast_varying, shard_map
 from .mesh import AXIS_DP, AXIS_TP, round_up
 
 
@@ -78,7 +79,7 @@ def _allgather_counts(mesh: Mesh):
         return jax.lax.psum(c_local, AXIS_DP)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=P(AXIS_DP, AXIS_TP),
             out_specs=P(None, AXIS_TP),
         )
@@ -104,15 +105,15 @@ def _ring_counts(mesh: Mesh):
 
         # mark the accumulator device-varying so the fori_loop carry type
         # matches after blocks of `c` (which varies per shard) land in it
-        out0 = jax.lax.pcast(
+        out0 = pcast_varying(
             jnp.zeros((v_loc * tp, v_loc), dtype=jnp.int32),
-            (AXIS_DP, AXIS_TP), to="varying",
+            (AXIS_DP, AXIS_TP),
         )
         _, out = jax.lax.fori_loop(0, tp, step, (x_local, out0))
         return jax.lax.psum(out, AXIS_DP)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=P(AXIS_DP, AXIS_TP),
             out_specs=P(None, AXIS_TP),
         )
@@ -228,7 +229,7 @@ def _sharded_counts_fn(mesh, impl, interpret, variant, swar):
         return jax.lax.psum(c, AXIS_DP)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=P(None, AXIS_DP),
             out_specs=P(None, None),
             # the pallas_call's out_shape carries no vma annotation; the

@@ -46,9 +46,41 @@ import numpy as np
 
 from ..config import ServingConfig
 from ..io import artifacts, registry
-from ..ops.serve import recommend_batch
+from ..ops.serve import recommend_batch, recommend_batch_donated
 
 logger = logging.getLogger("kmlserver_tpu.serving")
+
+
+_HOST_STAGING_SAFE: bool | None = None
+
+
+def _staging_is_safe() -> bool:
+    """True when reusing one host staging buffer across dispatches is
+    provably safe: the buffer is refilled while earlier transfers may
+    still be in flight, so ``jax.device_put`` must have fully consumed it
+    by the time it returns. Only the CPU backend qualifies — its
+    transfers are synchronous, and the probe below confirms the copy
+    (``jnp.asarray`` is zero-copy there, which is exactly why the staging
+    path goes through ``device_put``). On accelerators the transfer may
+    complete asynchronously AFTER device_put returns — a tiny probe
+    passing proves nothing about a larger buffer still in flight — so
+    reuse stays off and each dispatch allocates fresh (allocation is not
+    the bottleneck there; donation is the device-side win)."""
+    global _HOST_STAGING_SAFE
+    if _HOST_STAGING_SAFE is None:
+        if jax.default_backend() != "cpu":
+            _HOST_STAGING_SAFE = False
+            return False
+        probe = np.full((2, 2), -1, dtype=np.int32)
+        on_device = jax.device_put(probe)
+        probe[0, 0] = 123
+        _HOST_STAGING_SAFE = int(np.asarray(on_device)[0, 0]) == -1
+        if not _HOST_STAGING_SAFE:
+            logger.warning(
+                "device_put aliases host buffers on this backend; "
+                "staging-buffer reuse disabled (fresh allocation per batch)"
+            )
+    return _HOST_STAGING_SAFE
 
 
 def stable_seed(seed_tracks: list[str]) -> int:
@@ -70,6 +102,17 @@ class RuleBundle:
     rule_confs: jax.Array  # device, float32 (V, K)
     known_mask: np.ndarray  # host, bool (V,) — rule-dict key membership
     model_token: str  # token value when loaded
+    # every (batch, length) seed shape warmed before publication — the
+    # serving thread checks membership so an unwarmed dispatch (a compile
+    # on the hot path) is counted and logged, never silent
+    warmed_shapes: set = dataclasses.field(default_factory=set)
+    # host copies of the rule tensors, present ONLY when the native CPU
+    # serving kernel is active (serving/native_serve.py): XLA:CPU lowers
+    # the scatter-max to ~190ns/update, which IS the serving tail on a
+    # CPU pod; the native kernel does identical updates at ~2ns. None on
+    # accelerator backends — their lookups stay on the device.
+    host_rule_ids: np.ndarray | None = None
+    host_rule_confs: np.ndarray | None = None
 
 
 class RecommendEngine:
@@ -84,7 +127,16 @@ class RecommendEngine:
         self.finished_loading = False
         self.reload_counter = 0
         self._reload_lock = threading.Lock()
-        self._kernel = partial(recommend_batch, k_best=cfg.k_best_tracks)
+        self._kernel = None  # resolved lazily: donation needs the backend
+        # dispatches whose (batch, length) shape was never pre-warmed —
+        # each one paid a jit compile on the serving path; must stay 0
+        self.unwarmed_dispatches = 0
+        # reusable host staging buffers, one per padded seed shape: steady
+        # state does no fresh host allocation per batch. Guarded by the
+        # lock (fill + transfer must not interleave across threads) and by
+        # _staging_is_safe() (device_put must copy).
+        self._staging: dict[tuple[int, int], np.ndarray] = {}
+        self._staging_lock = threading.Lock()
 
     # ---------- artifact loading / hot swap ----------
 
@@ -195,22 +247,76 @@ class RecommendEngine:
                     (len(r) for r in rules_dict.values()), default=1
                 ),
             )
+        host_ids = host_confs = None
+        if self._use_native_serve():
+            # rule rows are trailing-padded (emission writes the top-k
+            # descending, then -1 fill) — the native kernel's early-break
+            # contract; ascontiguousarray guards a sliced npz view
+            host_ids = np.ascontiguousarray(rule_ids, dtype=np.int32)
+            host_confs = np.ascontiguousarray(rule_confs, dtype=np.float32)
+            # jnp.asarray is zero-copy on the CPU backend, so keeping the
+            # "device" tensors next to the host copies costs no memory
+            dev_ids, dev_confs = jnp.asarray(host_ids), jnp.asarray(host_confs)
+        else:
+            dev_ids = jax.device_put(jnp.asarray(rule_ids))
+            dev_confs = jax.device_put(jnp.asarray(rule_confs))
         return RuleBundle(
             vocab=vocab,
             index={n: i for i, n in enumerate(vocab)},
-            rule_ids=jax.device_put(jnp.asarray(rule_ids)),
-            rule_confs=jax.device_put(jnp.asarray(rule_confs)),
+            rule_ids=dev_ids,
+            rule_confs=dev_confs,
             known_mask=np.asarray(known),
             model_token=token,
+            host_rule_ids=host_ids,
+            host_rule_confs=host_confs,
         )
 
+    def _use_native_serve(self) -> bool:
+        """Native host kernel iff the backend is CPU (an accelerator's
+        lookups belong on the accelerator), the knob allows it, and the
+        .so is loadable."""
+        if not self.cfg.native_serve or jax.default_backend() != "cpu":
+            return False
+        from . import native_serve
+
+        return native_serve.available()
+
+    def _resolve_kernel(self):
+        if self._kernel is None:
+            # donation (seed-buffer HBM reuse) is unimplemented on the CPU
+            # backend and warns per call — pick the variant once, at the
+            # first load, when the backend is known
+            fn = (
+                recommend_batch
+                if jax.default_backend() == "cpu"
+                else recommend_batch_donated
+            )
+            self._kernel = partial(fn, k_best=self.cfg.k_best_tracks)
+        return self._kernel
+
     def _warmup(self, bundle: RuleBundle) -> None:
+        """Compile EVERY (batch-bucket, length-bucket) shape before the
+        bundle publishes: no request — whatever its batch size — ever pays
+        a compile or a 32-wide kernel for a batch of 3."""
+        if bundle.host_rule_ids is not None:
+            return  # native host kernel: nothing ever compiles
+        kernel = self._resolve_kernel()
         for length in self._len_buckets():
-            for batch in (1, self.cfg.batch_max_size):
-                seeds = jnp.zeros((batch, length), dtype=jnp.int32)
+            for batch in self._batch_buckets():
+                seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
                 jax.block_until_ready(
-                    self._kernel(bundle.rule_ids, bundle.rule_confs, seeds)
+                    kernel(bundle.rule_ids, bundle.rule_confs, seeds)
                 )
+                bundle.warmed_shapes.add((batch, length))
+
+    @property
+    def host_kernel_active(self) -> bool:
+        """True when the current bundle serves through the native host
+        kernel — its ``finish()`` is a sub-millisecond, GIL-releasing C
+        call, safe to run inline on an event loop (the async batcher uses
+        this to skip the executor hop entirely)."""
+        bundle = self.bundle
+        return bundle is not None and bundle.host_rule_ids is not None
 
     def reload_if_required(self) -> None:
         """Reference: reload when stale or never fully loaded
@@ -235,6 +341,81 @@ class RecommendEngine:
                 return b
         return buckets[-1]
 
+    def _batch_buckets(self) -> list[int]:
+        """Power-of-two batch buckets 1, 2, 4, …, up to (and always
+        including) ``batch_max_size`` — the full set the warmup compiles."""
+        cap = max(self.cfg.batch_max_size, 1)
+        buckets = []
+        b = 1
+        while b < cap:
+            buckets.append(b)
+            b *= 2
+        buckets.append(cap)
+        return buckets
+
+    def _bucket_batch(self, n: int) -> int:
+        """Smallest warmed batch bucket holding ``n`` rows; oversized
+        batches (possible only via direct ``recommend_many`` calls — the
+        micro-batcher caps at ``batch_max_size``) round up to a multiple
+        of the cap, keeping the shape set bounded."""
+        cap = max(self.cfg.batch_max_size, 1)
+        if n > cap:
+            return ((n + cap - 1) // cap) * cap
+        for b in self._batch_buckets():
+            if n <= b:
+                return b
+        return cap
+
+    @staticmethod
+    def _fill_seed_rows(
+        bundle: RuleBundle, seed_sets: list[list[str]],
+        arr: np.ndarray, length: int,
+    ) -> np.ndarray:
+        """Membership-filter each seed set into its -1-padded row of
+        ``arr`` → per-row any-known-seed mask. The ONE copy of the seed
+        filtering rule — the native and device paths both go through it,
+        which is what keeps them bit-identical."""
+        for r, seeds in enumerate(seed_sets):
+            ids = [
+                bundle.index[s]
+                for s in seeds
+                if s in bundle.index and bundle.known_mask[bundle.index[s]]
+            ][:length]
+            arr[r, : len(ids)] = ids
+        return (arr[: len(seed_sets)] >= 0).any(axis=1)
+
+    def _stage_seeds(
+        self, bundle: RuleBundle, seed_sets: list[list[str]],
+        rows: int, length: int,
+    ) -> tuple[jax.Array, np.ndarray]:
+        """Fill the padded (rows, length) seed-index array and transfer it
+        → (device seed array, per-row any-known-seed mask, host). Reuses
+        one staging buffer per shape when the backend's ``device_put``
+        copies (probed); the known-row mask is snapshotted BEFORE the
+        buffer can be refilled by the next dispatch."""
+        shape = (rows, length)
+        with self._staging_lock:
+            if _staging_is_safe():
+                arr = self._staging.get(shape)
+                if arr is None:
+                    arr = self._staging.setdefault(
+                        shape, np.empty(shape, dtype=np.int32)
+                    )
+                arr.fill(-1)
+            else:
+                arr = np.full(shape, -1, dtype=np.int32)
+            known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
+            seeds_dev = jax.device_put(arr)
+        if shape not in bundle.warmed_shapes:
+            # a compile is landing on the serving path — count it loudly
+            self.unwarmed_dispatches += 1
+            logger.warning(
+                "unwarmed seed shape %s dispatched (compile on the "
+                "serving path); warmed buckets: batches %s x lengths %s",
+                shape, self._batch_buckets(), self._len_buckets(),
+            )
+        return seeds_dev, known_rows
+
     def recommend(self, seed_tracks: list[str]) -> tuple[list[str], str]:
         """→ (songs, source) where source ∈ {"rules", "fallback", "empty"}.
 
@@ -257,13 +438,23 @@ class RecommendEngine:
             logger.info("no seed of %d known; static fallback", len(seed_tracks))
             return self.static_recommendation(seed_tracks), "fallback"
         known_ids = known_ids[: self.cfg.max_seed_tracks]
-        length = self._bucket_len(len(known_ids))
-        seed_arr = np.full((1, length), -1, dtype=np.int32)
-        seed_arr[0, : len(known_ids)] = known_ids
-        top_ids, top_confs = self._kernel(
-            bundle.rule_ids, bundle.rule_confs, jnp.asarray(seed_arr)
-        )
-        ids = np.asarray(top_ids[0])
+        if bundle.host_rule_ids is not None:
+            from . import native_serve
+
+            arr = np.full((1, max(len(known_ids), 1)), -1, dtype=np.int32)
+            arr[0, : len(known_ids)] = known_ids
+            top_ids, _ = native_serve.serve_topk(
+                bundle.host_rule_ids, bundle.host_rule_confs, arr,
+                self.cfg.k_best_tracks,
+            )
+            ids = top_ids[0]
+        else:
+            length = self._bucket_len(len(known_ids))
+            seeds_dev, _ = self._stage_seeds(bundle, [seed_tracks], 1, length)
+            top_ids, _ = self._resolve_kernel()(
+                bundle.rule_ids, bundle.rule_confs, seeds_dev
+            )
+            ids = np.asarray(top_ids[0])
         songs = [bundle.vocab[int(i)] for i in ids if i >= 0]
         return songs, ("rules" if songs else "empty")
 
@@ -290,29 +481,62 @@ class RecommendEngine:
                 ]
 
             return finish_fallback
+        if bundle.host_rule_ids is not None:
+            # native host kernel: no compile, so no shape bucketing — the
+            # seed array is exact-sized, built fresh (it must survive
+            # until finish() runs on the completion thread, so it can't
+            # share the device path's reusable staging buffers)
+            length = min(
+                max((len(s) for s in seed_sets), default=1),
+                self.cfg.max_seed_tracks,
+            )
+            arr = np.full((len(seed_sets), length), -1, dtype=np.int32)
+            known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
+
+            def finish_native() -> list[tuple[list[str], str]]:
+                from . import native_serve
+
+                # the ctypes call releases the GIL for the whole batch
+                host_ids, _ = native_serve.serve_topk(
+                    bundle.host_rule_ids, bundle.host_rule_confs, arr,
+                    self.cfg.k_best_tracks,
+                )
+                out: list[tuple[list[str], str]] = []
+                for r, seeds in enumerate(seed_sets):
+                    if known_rows[r]:
+                        songs = [
+                            bundle.vocab[int(i)] for i in host_ids[r] if i >= 0
+                        ]
+                        out.append((songs, "rules" if songs else "empty"))
+                    else:
+                        out.append(
+                            (self.static_recommendation(seeds), "fallback")
+                        )
+                return out
+
+            return finish_native
+
         length = self._bucket_len(
             max((len(s) for s in seed_sets), default=1)
         )
-        # pad the batch dimension to a multiple of the canonical size: a
+        # pad the batch dimension UP to the nearest power-of-two bucket: a
         # varying batch dimension would compile a fresh kernel per distinct
-        # size (oversized batches round UP, keeping the shape set bounded)
-        step = self.cfg.batch_max_size
-        n_rows = ((max(len(seed_sets), 1) + step - 1) // step) * step
-        arr = np.full((n_rows, length), -1, dtype=np.int32)
-        for r, seeds in enumerate(seed_sets):
-            ids = [
-                bundle.index[s]
-                for s in seeds
-                if s in bundle.index and bundle.known_mask[bundle.index[s]]
-            ][:length]
-            arr[r, : len(ids)] = ids
-        top_ids, _ = self._kernel(bundle.rule_ids, bundle.rule_confs, jnp.asarray(arr))
+        # size, and padding every batch to the 32-wide cap (the old scheme)
+        # made a batch of 3 pay a 32-row kernel — ~8x the work on the
+        # scatter/top-k. Every bucket is pre-warmed at bundle publish.
+        n_rows = self._bucket_batch(max(len(seed_sets), 1))
+        seeds_dev, known_rows = self._stage_seeds(
+            bundle, seed_sets, n_rows, length
+        )
+        top_ids, _ = self._resolve_kernel()(
+            bundle.rule_ids, bundle.rule_confs, seeds_dev
+        )
 
         def finish() -> list[tuple[list[str], str]]:
             host_ids = np.asarray(top_ids)  # blocks on the device transfer
             out: list[tuple[list[str], str]] = []
             for r, seeds in enumerate(seed_sets):
-                if (arr[r] >= 0).any():
+                if known_rows[r]:
                     songs = [bundle.vocab[int(i)] for i in host_ids[r] if i >= 0]
                     out.append((songs, "rules" if songs else "empty"))
                 else:

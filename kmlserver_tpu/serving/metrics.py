@@ -2,9 +2,13 @@
 
 The reference's only observability is log lines and one in-memory
 ``reload_counter`` (rest_api/app/main.py:18-29,120,143; SURVEY.md §5 calls
-out the absence of a metrics endpoint). This adds latency/QPS counters with a
-bounded reservoir so the p50-at-QPS target is measurable, exposed in
-Prometheus text format at ``GET /metrics``.
+out the absence of a metrics endpoint). This adds latency/QPS counters with
+bounded reservoirs so the p50-at-QPS target is measurable, exposed in
+Prometheus text format at ``GET /metrics`` — including the queue-vs-device
+latency attribution the micro-batcher threads through
+(``kmls_queue_wait_ms`` / ``kmls_device_ms`` / ``kmls_e2e_ms``, quantiles
+up to p999), which is what lets a replay harness say WHERE a tail lives
+instead of only that one exists.
 """
 
 from __future__ import annotations
@@ -12,11 +16,15 @@ from __future__ import annotations
 import threading
 import time
 
+# every summary rendered below carries these quantiles; p999 needs the
+# larger reservoir to mean anything (16384 samples → ~16 above p999)
+_QUANTILES = (0.50, 0.95, 0.99, 0.999)
+
 
 class LatencyReservoir:
     """Fixed-size ring of recent latencies; cheap percentile reads."""
 
-    def __init__(self, size: int = 4096):
+    def __init__(self, size: int = 16384):
         self._buf = [0.0] * size
         self._n = 0
         self._lock = threading.Lock()
@@ -47,7 +55,14 @@ class ServingMetrics:
         self.requests_total = 0
         self.requests_by_source = {"rules": 0, "fallback": 0, "empty": 0}
         self.errors_total = 0
+        self.shed_total = 0
         self.latency = LatencyReservoir()
+        # per-request latency attribution from the micro-batcher:
+        # queue_wait = enqueue→dispatch, device = dispatch→result-on-host
+        # (device compute + transfer + in-order queue), e2e = enqueue→done
+        self.queue_wait = LatencyReservoir()
+        self.device = LatencyReservoir()
+        self.e2e = LatencyReservoir()
         self._lock = threading.Lock()
 
     def record(self, source: str, seconds: float) -> None:
@@ -60,13 +75,38 @@ class ServingMetrics:
         with self._lock:
             self.errors_total += 1
 
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def record_attribution(
+        self, queue_wait_s: float, device_s: float, e2e_s: float
+    ) -> None:
+        self.queue_wait.observe(queue_wait_s)
+        self.device.observe(device_s)
+        self.e2e.observe(e2e_s)
+
     def reset_latency(self) -> int:
-        """Clear ONLY the latency reservoir (→ observations discarded).
+        """Clear the latency + attribution reservoirs (→ request-latency
+        observations discarded).
 
         Lets a measurement harness window the percentiles to one replay
         run (VERDICT r4 #7). The Prometheus counters stay cumulative —
         resetting counters would break scrape-delta semantics."""
-        return self.latency.reset()
+        n = self.latency.reset()
+        self.queue_wait.reset()
+        self.device.reset()
+        self.e2e.reset()
+        return n
+
+    @staticmethod
+    def _summary_ms(name: str, reservoir: LatencyReservoir) -> list[str]:
+        values = reservoir.percentiles(*_QUANTILES)
+        lines = [f"# TYPE {name} summary"]
+        for q, val in zip(_QUANTILES, values):
+            label = f"{q:g}"
+            lines.append(f'{name}{{quantile="{label}"}} {val * 1e3:.4f}')
+        return lines
 
     def render(self, reload_counter: int, finished_loading: bool) -> str:
         p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)
@@ -76,6 +116,8 @@ class ServingMetrics:
             f"kmls_requests_total {self.requests_total}",
             "# TYPE kmls_request_errors_total counter",
             f"kmls_request_errors_total {self.errors_total}",
+            "# TYPE kmls_requests_shed_total counter",
+            f"kmls_requests_shed_total {self.shed_total}",
             "# TYPE kmls_requests_by_source counter",
         ]
         for source, count in sorted(self.requests_by_source.items()):
@@ -85,6 +127,13 @@ class ServingMetrics:
             f'kmls_request_latency_seconds{{quantile="0.5"}} {p50:.6f}',
             f'kmls_request_latency_seconds{{quantile="0.95"}} {p95:.6f}',
             f'kmls_request_latency_seconds{{quantile="0.99"}} {p99:.6f}',
+        ]
+        # batcher attribution summaries, milliseconds (absent→all-zero is
+        # fine: an unbatched deployment simply never observes into them)
+        lines += self._summary_ms("kmls_queue_wait_ms", self.queue_wait)
+        lines += self._summary_ms("kmls_device_ms", self.device)
+        lines += self._summary_ms("kmls_e2e_ms", self.e2e)
+        lines += [
             "# TYPE kmls_reloads_total counter",
             f"kmls_reloads_total {reload_counter}",
             "# TYPE kmls_finished_loading gauge",

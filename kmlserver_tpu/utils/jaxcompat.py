@@ -1,0 +1,42 @@
+"""Version-bridging shims for jax APIs that moved or appeared across the
+versions this repo must run on (the image pins what it pins; the code must
+serve either side).
+
+- ``shard_map``: promoted from ``jax.experimental.shard_map`` to the top
+  level, and its replication-check kwarg renamed ``check_rep`` →
+  ``check_vma`` along the way; the installed 0.4.x only has the
+  experimental home with the old spelling. Callers here use the NEW
+  spelling; the shim translates downward.
+- ``pcast_varying``: ``jax.lax.pcast(..., to="varying")`` exists only where
+  the device-varying type system does. Older shard_map tracing has no
+  varying/invariant distinction, so the cast is correctly a no-op there —
+  the accumulator carry types already match without it.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # pre-promotion jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_impl  # type: ignore
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+
+def shard_map(f, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map_impl(f, **kwargs)
+
+
+def pcast_varying(x, axes):
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")

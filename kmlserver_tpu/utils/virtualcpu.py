@@ -51,7 +51,15 @@ def force_virtual_cpu(n_devices: int = 8) -> dict[str, str | None]:
 
     _jeb.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older jax (e.g. 0.4.37) has no jax_num_cpu_devices config option.
+        # The XLA_FLAGS device-count flag set above does the same job as
+        # long as it lands before the first backend build — and it does:
+        # backends were just cleared, so the next device query constructs
+        # the CPU client fresh and reads the env then.
+        pass
     return prior
 
 

@@ -7,6 +7,8 @@ import json
 import sys
 from pathlib import Path
 
+import pytest
+
 _spec = importlib.util.spec_from_file_location(
     "kmls_bench", Path(__file__).resolve().parent.parent / "bench.py"
 )
@@ -18,6 +20,22 @@ _spec.loader.exec_module(bench)
 # tests, so the module-global state is forced inert here; tests that
 # exercise banking construct their own BenchState
 bench.STATE = bench.BenchState(None)
+
+
+@pytest.fixture(autouse=True)
+def _sidecar_to_tmp(tmp_path, monkeypatch):
+    """Every emitter mirrors its full artifact to a sidecar; point it at a
+    tmp file so tests never litter the repo root (subprocess-based tests
+    inherit the env)."""
+    monkeypatch.setenv(
+        "KMLS_BENCH_SIDECAR", str(tmp_path / "bench_full.json")
+    )
+
+
+def _full_artifact(tmp_path) -> dict:
+    """The COMPLETE artifact a test run produced (the stdout line is the
+    compact ≤1,800-char projection; completeness assertions read this)."""
+    return json.loads((tmp_path / "bench_full.json").read_text())
 
 
 class TestMfuKeys:
@@ -337,7 +355,9 @@ class TestTpuSuiteWiring:
         "server_percentiles": {"p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": 8.0},
     }
 
-    def test_every_phase_key_lands_in_the_artifact(self, monkeypatch, capsys):
+    def test_every_phase_key_lands_in_the_artifact(
+        self, monkeypatch, capsys, tmp_path
+    ):
         def fake_run_phase(name, code, argv, **kw):
             for prefix, canned in self.CANNED.items():
                 if name.startswith(prefix):
@@ -356,9 +376,17 @@ class TestTpuSuiteWiring:
         assert mining == self.CANNED["mining"]
         assert em.finalize()
         out = capsys.readouterr().out
-        final = json.loads(
-            [ln for ln in out.splitlines() if ln.strip()][-1]
-        )
+        stdout_line = [ln for ln in out.splitlines() if ln.strip()][-1]
+        # stdout carries the bounded compact projection with the headline
+        # + judged serving keys; completeness is asserted on the sidecar
+        assert len(stdout_line) <= bench.COMPACT_LINE_LIMIT
+        compact = json.loads(stdout_line)
+        assert compact["platform"] == "tpu"
+        assert compact["value"] == 0.5
+        assert compact["replay_achieved_qps"] == 1010.0
+        assert compact["serving_batch32_p50_ms"] == 0.5
+        assert compact["full_artifact"].endswith("bench_full.json")
+        final = _full_artifact(tmp_path)
         assert final["platform"] == "tpu"
         assert final["value"] == 0.5
         assert final["mining_mfu_pct"] > 0  # amortized path, ≤100
@@ -856,9 +884,11 @@ class TestBenchStateResume:
         assert bench.run_tpu_suite(em2, str(npz2)) == canned["mining"]
         assert npz2.read_bytes() == b"npz-sentinel"  # serving input restored
         assert em2.finalize()
-        final = json.loads(
-            [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()][-1]
-        )
+        stdout_line = [
+            ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+        ][-1]
+        assert len(stdout_line) <= bench.COMPACT_LINE_LIMIT
+        final = _full_artifact(tmp_path)
         assert final["platform"] == "tpu"
         assert final["value"] == 0.5
         assert final["popcount_ds2_ms"] == 150.0
@@ -869,6 +899,11 @@ class TestBenchStateResume:
         assert final["replay_achieved_qps"] == 1010.0
         assert final["cpu_replay_achieved_qps"] == 1010.0
         assert final["popcount_tune_best_config"] == "64x128x512"
+        # replayed-from-bank phases carry per-phase provenance (ADVICE r5 #1)
+        assert final["serving_tpu_from_bank"] is True
+        assert final["serving_tpu_bank_age_s"] >= 0
+        assert final["replay_tpu_from_bank"] is True
+        assert final["mining_tpu_from_bank"] is True
 
     def test_tune_error_result_is_not_banked(
         self, monkeypatch, tmp_path, capsys
@@ -1012,3 +1047,175 @@ class TestBenchStateResume:
         assert state.get("mining_tpu") is None  # nothing banked anywhere
         assert state.npz_path is None
         assert not list(tmp_path.iterdir())
+
+
+class TestCompactLine:
+    """The final stdout JSON line must stay under the driver's tail window
+    (the r05 headline died at 2,112 chars → parsed: null)."""
+
+    def _bloated(self):
+        extras = {
+            f"optional_phase_{i}_detail": "x" * 60 for i in range(60)
+        }
+        extras["replay_p50_ms"] = 4.0
+        extras["replay_p99_ms"] = 11.0
+        extras["replay_errors"] = 0
+        extras["replay_queue_wait_p99_ms"] = 3.5
+        extras["replay_device_p99_ms"] = 6.0
+        return extras
+
+    def test_compact_line_bounded_and_prioritized(self):
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu", **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["value"] == 1.0
+        # the judged serving keys outrank the bloat
+        assert parsed["replay_queue_wait_p99_ms"] == 3.5
+        assert parsed["replay_device_p99_ms"] == 6.0
+
+    def test_emitter_final_line_bounded_with_full_sidecar(
+        self, tmp_path, capsys
+    ):
+        prober = bench.TpuProber(probe_timeout_s=1.0, interval_s=1.0)
+        # a probe history long enough to sink the old full-line emission
+        for i in range(80):
+            prober.history.append(
+                {"t_s": float(i), "outcome": "hang", "dur_s": 60.0}
+            )
+        em = bench.ArtifactEmitter(prober)
+        em.extras.update(self._bloated())
+        em.set_headline("cpu", {"median_s": 2.0})
+        assert em.finalize()
+        lines = [
+            ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+        ]
+        assert all(len(ln) <= bench.COMPACT_LINE_LIMIT for ln in lines)
+        final = json.loads(lines[-1])
+        assert final is not None and final["value"] == 2.0
+        assert "checkpoint" not in final
+        # everything — bloat and probe history included — is in the sidecar
+        full = _full_artifact(tmp_path)
+        assert full["optional_phase_59_detail"] == "x" * 60
+        assert len(full["probe_history"]) == 80
+        assert final["full_artifact"].endswith("bench_full.json")
+
+    def test_sidecar_disabled_still_bounded(self, monkeypatch, capsys):
+        monkeypatch.setenv("KMLS_BENCH_SIDECAR", "")
+        em = bench.ArtifactEmitter()
+        em.extras.update(self._bloated())
+        em.set_headline("cpu", {"median_s": 1.0})
+        assert em.finalize()
+        lines = [
+            ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+        ]
+        assert all(len(ln) <= bench.COMPACT_LINE_LIMIT for ln in lines)
+        assert "full_artifact" not in json.loads(lines[-1])
+
+
+class TestReplayAttributionKeys:
+    def test_parse_attribution_from_rendered_metrics(self):
+        from kmlserver_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.record_attribution(queue_wait_s=0.002, device_s=0.004, e2e_s=0.006)
+        text = m.render(reload_counter=1, finished_loading=True)
+        out = bench._parse_attribution(text)
+        assert out["queue_wait_p99_ms"] == 2.0
+        assert out["device_p99_ms"] == 4.0
+        assert out["e2e_p999_ms"] == 6.0
+
+    def test_record_replay_emits_split_keys(self):
+        replay = dict(TestTpuSuiteWiring.REPLAY)
+        replay["server_percentiles"] = {
+            "p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": 8.0,
+            "attribution": {
+                "queue_wait_p50_ms": 0.8, "queue_wait_p99_ms": 3.2,
+                "device_p50_ms": 1.1, "device_p99_ms": 4.4,
+                "e2e_p999_ms": 9.9,
+            },
+        }
+        result = {}
+        # drive _record_replay with a canned replay via a no-bank path
+        orig = bench.replay_phase
+        bench.replay_phase = lambda platform: replay
+        try:
+            bench._record_replay(result, "cpu")
+        finally:
+            bench.replay_phase = orig
+        assert result["replay_queue_wait_p99_ms"] == 3.2
+        assert result["replay_device_p99_ms"] == 4.4
+        assert result["replay_e2e_p999_ms"] == 9.9
+        assert result["replay_server_p50_ms"] == 2.0
+        # the attribution dict itself must not leak as a server_ key
+        assert "replay_server_attribution" not in result
+
+
+class TestBankMergeAndStaleness:
+    def test_merge_prefers_newer_banked_at_regardless_of_origin(
+        self, tmp_path
+    ):
+        """ADVICE r5 #2: a process must not overwrite a fresher on-disk
+        result with the stale copy it merely loaded at startup."""
+        path = str(tmp_path / "bank.json")
+        import time as time_mod
+
+        now = time_mod.time()
+        # process A loads a bank holding an OLD serving result
+        state_a = bench.BenchState(None)
+        state_a.path = path
+        state_a.phases = {"serving_tpu": {"p50_ms": 99.0}}
+        state_a.banked_at = {"serving_tpu": now - 600}
+        # meanwhile process B banked a FRESHER serving result on disk
+        (tmp_path / "bank.json").write_text(json.dumps({
+            "version": 2,
+            "phases": {"serving_tpu": {"p50_ms": 1.0}},
+            "banked_at": {"serving_tpu": now - 5},
+        }))
+        # A banks an unrelated phase → merge-on-write runs
+        state_a.bank("sweep_tpu", {"points": 68})
+        disk = json.loads((tmp_path / "bank.json").read_text())
+        assert disk["phases"]["serving_tpu"] == {"p50_ms": 1.0}  # B's wins
+        assert disk["phases"]["sweep_tpu"] == {"points": 68}
+
+    def test_v1_bank_without_timestamps_is_stale(self, tmp_path):
+        """ADVICE r5 #4: a timestampless (v1) bank in the tree must not
+        replay into every fresh-checkout artifact forever."""
+        path = tmp_path / "bank.json"
+        path.write_text(json.dumps({
+            "version": 1,
+            "phases": {"mining_tpu": {"median_s": 0.4}},
+        }))
+        state = bench.BenchState(str(path))
+        assert state.get("mining_tpu") is None
+
+    def test_banked_replay_stamps_provenance(self, tmp_path):
+        state = bench.BenchState(str(tmp_path / "bank.json"))
+        state.bank("popcount_tpu", {"popcount_ms": 1.0})
+        old_state = bench.STATE
+        bench.STATE = state
+        try:
+            extras = {}
+            got = bench._banked(
+                "popcount_tpu", lambda: None, extras=extras
+            )
+        finally:
+            bench.STATE = old_state
+        assert got == {"popcount_ms": 1.0}
+        assert extras["popcount_tpu_from_bank"] is True
+        assert extras["popcount_tpu_bank_age_s"] >= 0
+
+    def test_live_run_stamps_nothing(self, tmp_path):
+        state = bench.BenchState(str(tmp_path / "bank.json"))
+        old_state = bench.STATE
+        bench.STATE = state
+        try:
+            extras = {}
+            got = bench._banked(
+                "popcount_tpu", lambda: {"popcount_ms": 2.0}, extras=extras
+            )
+        finally:
+            bench.STATE = old_state
+        assert got == {"popcount_ms": 2.0}
+        assert extras == {}
