@@ -43,7 +43,10 @@ asserted equal on-device, words/s emitted), config4-devicegen (TRUE
 (the reference's 68-point support grid, count-once).
 Phases (cpu suite): mining, popcount stand-in (interpret mode, small
 shape), scale stand-in (20k×5k on an 8-virtual-device mesh), serving,
-replay — all keys labeled ``*_cpu*``.
+replay — all keys labeled ``*_cpu*`` — plus replay10k (the 10k-QPS
+Zipf-mix in-process bracket through cache → batcher → native kernel;
+always CPU-measured and self-labeled, reported as ``replay10k_*`` with
+``cache_hit_ratio`` and per-device dispatch counts).
 
 THE ARTIFACT IS UNLOSEABLE (VERDICT r3 next-round #1). The driver records
 the LAST parseable JSON line on this process's stdout (r01/r02 artifacts
@@ -331,6 +334,10 @@ _COMPACT_PRIORITY = (
     "mining_cpu_s", "mining_count_path",
     "replay_target_qps", "replay_achieved_qps", "replay_p50_ms",
     "replay_p95_ms", "replay_p99_ms", "replay_errors",
+    "replay10k_qps", "replay10k_achieved_qps", "replay10k_p50_ms",
+    "replay10k_p99_ms", "replay10k_errors", "replay10k_cache_hit_ratio",
+    "replay10k_cached_p50_ms", "replay10k_uncached_p50_ms",
+    "replay10k_devices_active",
     "replay_queue_wait_p99_ms", "replay_device_p99_ms",
     "replay_queue_wait_p50_ms", "replay_device_p50_ms", "replay_e2e_p999_ms",
     "replay_server_p50_ms", "replay_server_p95_ms", "replay_server_p99_ms",
@@ -1146,6 +1153,88 @@ write_tracks_csv(sys.argv[1], synthetic_table(**DS2_SHAPE, seed=123))
 print("{}")
 """
 
+# the 10k-QPS throughput phase: in-process (cache → batcher → engine, the
+# same path both HTTP front ends serve) with a Zipf-skewed query mix —
+# real playlist-seed traffic repeats its head, which is what the
+# epoch-keyed answer cache feeds on. In-process because at 10k QPS an HTTP
+# loadgen on this syscall-taxed sandbox measures the loadgen, not the
+# server (the 1k replay phase keeps the full-stack HTTP bracket).
+_REPLAY10K_BENCH = r"""
+import dataclasses, json, os, sys, tempfile
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_REPLAY10K_QPS", "10000"))
+n_req = int(os.environ.get("KMLS_BENCH_REPLAY10K_REQUESTS", "40000"))
+zipf_s = float(os.environ.get("KMLS_BENCH_REPLAY10K_ZIPF_S", "1.1"))
+with tempfile.TemporaryDirectory(prefix="kmls_replay10k_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    run_mining_job(
+        MiningConfig(base_dir=base, datasets_dir=ds_dir, min_support=0.05)
+    )
+    # shedding off for this bracket: overload must surface as LATENCY
+    # (replay_pooled times from the scheduled arrival), not as 429 drops
+    # that would void the zero-errors claim while hiding the tail
+    cfg = dataclasses.replace(
+        ServingConfig.from_env(), base_dir=base,
+        batch_max_size=64, shed_queue_budget_ms=0.0,
+    )
+    app = RecommendApp(cfg)
+    assert app.engine.load(), "mined artifacts must load"
+
+    def make_send():
+        def send(seeds):
+            recs, source, cached = app.recommend_direct(seeds)
+            return source, cached
+        return send
+
+    vocab = app.engine.bundle.vocab
+    payloads = sample_seed_sets(vocab, n_req, rng_seed=11, zipf_s=zipf_s)
+    # warm the answer cache + jit/native paths with the same Zipf pool
+    # (steady state is what 10k QPS sustains; the measured hit ratio
+    # below still comes only from the measured run's own responses)
+    replay_pooled(
+        make_send, payloads[: min(4000, n_req)], qps=qps / 4, n_workers=16
+    )
+    # 16 workers, not 64: with a warm cache most requests are dictionary
+    # lookups, and on a small host the extra threads only convoy on the
+    # GIL — measured here, 64 workers capped the whole phase at ~5.3k
+    # QPS while 16 clear the target with headroom
+    report = replay_pooled(
+        make_send, payloads, qps=qps, n_workers=16, max_queue=8192
+    )
+    counts = list(app.engine.dispatch_counts)
+    print(json.dumps({
+        "qps": qps,
+        "offered_qps": report.offered_qps,
+        "achieved_qps": report.achieved_qps,
+        "p50_ms": report.p50_ms,
+        "p95_ms": report.p95_ms,
+        "p99_ms": report.p99_ms,
+        "errors": report.n_errors,
+        "cache_hit_ratio": report.cache_hit_ratio,
+        "cached_p50_ms": report.cached_p50_ms,
+        "uncached_p50_ms": report.uncached_p50_ms,
+        "zipf_s": zipf_s,
+        "per_device_dispatch": counts,
+        "devices_active": sum(1 for c in counts if c > 0),
+        "n_replicas": app.engine.n_replicas,
+        "platform": dev.platform,
+    }))
+"""
+
 _REPLAY_CLIENT = r"""
 import os, pickle, sys
 from kmlserver_tpu.serving.replay import replay_async_http, sample_seed_sets
@@ -1947,6 +2036,13 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # a banked prior-window supplement does not)
         result.setdefault(f"cpu_{key}", val)
     em.checkpoint()
+
+    # the 10k-QPS Zipf throughput bracket is CPU-measured by construction
+    # (self-labeled keys, no takeover relabeling) — skip only when a CPU
+    # suite earlier in this run already recorded it
+    if "replay10k_p50_ms" not in result:
+        _record_replay10k(result, bank="replay10k_cpu", budget_s=240)
+        em.checkpoint()
     return mining
 
 
@@ -1968,6 +2064,12 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
 
     if _remaining() > 240:
         _record_replay(result, "cpu")
+        em.checkpoint()
+
+    if _remaining() > 180:
+        # the 10k-QPS Zipf throughput bracket: cache + batcher + native
+        # kernel in-process (PR 2's tentpole acceptance)
+        _record_replay10k(result)
         em.checkpoint()
 
     if _remaining() > 180:
@@ -2131,6 +2233,52 @@ def _record_replay(
                 f"{attribution['queue_wait_p99_ms']:.2f}ms vs device p99 "
                 f"{attribution['device_p99_ms']:.2f}ms"
             )
+
+
+def _record_replay10k(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The 10k-QPS in-process throughput bracket (cache → batcher →
+    engine, Zipf-skewed mix). Always CPU-platform — the native host
+    kernel owns the CPU hot path and an HTTP loadgen can't honestly pace
+    10k QPS on this sandbox — so the keys carry their own platform label
+    and are never relabeled by a TPU takeover."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "replay10k", _REPLAY10K_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    r10k = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if r10k is None:
+        return
+    log(
+        f"replay10k @ {r10k['qps']:.0f} QPS (zipf {r10k['zipf_s']}): "
+        f"p50 {r10k['p50_ms']:.2f}ms p99 {r10k['p99_ms']:.2f}ms, achieved "
+        f"{r10k['achieved_qps']:.0f} QPS, {r10k['errors']} errors, "
+        f"cache hit ratio {r10k.get('cache_hit_ratio') or 0:.2f}"
+    )
+    for src, dst in (
+        ("qps", "replay10k_qps"),
+        ("offered_qps", "replay10k_offered_qps"),
+        ("achieved_qps", "replay10k_achieved_qps"),
+        ("p50_ms", "replay10k_p50_ms"),
+        ("p95_ms", "replay10k_p95_ms"),
+        ("p99_ms", "replay10k_p99_ms"),
+        ("errors", "replay10k_errors"),
+        ("cache_hit_ratio", "replay10k_cache_hit_ratio"),
+        ("cached_p50_ms", "replay10k_cached_p50_ms"),
+        ("uncached_p50_ms", "replay10k_uncached_p50_ms"),
+        ("zipf_s", "replay10k_zipf_s"),
+        ("per_device_dispatch", "replay10k_per_device_dispatch"),
+        ("devices_active", "replay10k_devices_active"),
+        ("n_replicas", "replay10k_n_replicas"),
+        ("platform", "replay10k_platform"),
+    ):
+        if src in r10k and r10k[src] is not None:
+            val = r10k[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
 
 
 def _tpu_takeover(

@@ -216,10 +216,25 @@ class ServingConfig:
     shed_queue_budget_ms: float = 250.0
     # Retry-After hint (seconds) returned with a 429 shed.
     shed_retry_after_s: float = 1.0
-    # Device-call pipeline depth: batches dispatched but not yet completed.
-    # >1 overlaps the next batch's dispatch with the previous transfer —
-    # essential when the host<->device link is high-latency (remote tunnel).
+    # Device-call pipeline depth PER REPLICA: batches dispatched but not yet
+    # completed. >1 overlaps the next batch's dispatch with the previous
+    # transfer — essential when the host<->device link is high-latency
+    # (remote tunnel). The aggregate pipeline bound is this times the
+    # number of serving replicas.
     batch_max_inflight: int = 4
+    # Serving replicas, one per local device: 0 = auto (every local device
+    # on accelerator backends; 1 on CPU, where the native host kernel owns
+    # the hot path and extra virtual-device replicas only multiply warmup
+    # compiles). N > 0 pins min(N, local device count) replicas — e.g.
+    # KMLS_SERVE_DEVICES=8 on an 8-virtual-device CPU host exercises the
+    # full data-parallel dispatch tier without hardware.
+    serve_devices: int = 0
+    # Epoch-keyed recommendation cache in front of the batcher: answers are
+    # keyed by (bundle epoch, canonicalized seed set), so a bundle hot-swap
+    # invalidates the whole cache for free (the epoch moves, old keys can
+    # never match again). 0 entries — or KMLS_CACHE_ENABLED=0 — disables.
+    cache_enabled: bool = True
+    cache_max_entries: int = 8192
     # Prefer the tensor-native npz artifact over the pickle when present.
     prefer_tensor_artifact: bool = True
     # On a CPU backend, serve lookups with the native C++ kernel
@@ -258,6 +273,9 @@ class ServingConfig:
             shed_queue_budget_ms=_getenv_float("KMLS_SHED_QUEUE_BUDGET_MS", 250.0),
             shed_retry_after_s=_getenv_float("KMLS_SHED_RETRY_AFTER_S", 1.0),
             batch_max_inflight=_getenv_int("KMLS_BATCH_MAX_INFLIGHT", 4),
+            serve_devices=_getenv_int("KMLS_SERVE_DEVICES", 0),
+            cache_enabled=_getenv_bool("KMLS_CACHE_ENABLED", True),
+            cache_max_entries=_getenv_int("KMLS_CACHE_MAX_ENTRIES", 8192),
             prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),
             native_serve=_getenv_bool("KMLS_NATIVE_SERVE", True),
         )

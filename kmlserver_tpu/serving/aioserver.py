@@ -10,8 +10,11 @@ config-5 target before the engine does any work at all. A single-threaded
 event loop holds ~700+ QPS flat at the same concurrency because each
 request costs one parse + one dispatch, no thread handoffs.
 
-The recommendation path never blocks the loop: the micro-batcher exposes a
-non-blocking ``submit()`` (→ Future), the loop attaches a done-callback,
+The recommendation path never blocks the loop: ``app.submit_recommend``
+first consults the epoch-keyed answer cache (a hit resolves inline on the
+loop — no batcher, no executor, no thread handoff; concurrent identical
+misses singleflight onto one shared future), then the micro-batcher's
+non-blocking ``submit()`` (→ Future); the loop attaches a done-callback,
 and the batcher's completion thread hands the finished result back via
 ``call_soon_threadsafe``. Every other route is sub-millisecond and runs
 inline. One request is outstanding per connection (HTTP/1.1 without

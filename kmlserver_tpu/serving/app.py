@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config import ServingConfig
 from .batcher import Overloaded
+from .cache import RecommendCache
 from .engine import RecommendEngine
 from .metrics import ServingMetrics
 
@@ -81,6 +82,14 @@ class RecommendApp:
         self.engine = engine or RecommendEngine(cfg)
         self.metrics = ServingMetrics()
         self.batcher = None
+        # epoch-keyed answer cache in front of the batcher (serving/cache
+        # .py): a bundle hot swap invalidates it wholesale because the
+        # engine's epoch is the key prefix — no flush coordination needed
+        self.cache = (
+            RecommendCache(cfg.cache_max_entries)
+            if cfg.cache_enabled and cfg.cache_max_entries > 0
+            else None
+        )
         # defer_batcher: the asyncio transport installs its loop-native
         # AsyncMicroBatcher instead — don't spawn the threaded pipeline
         if cfg.batch_window_ms > 0 and not defer_batcher:
@@ -160,7 +169,11 @@ class RecommendApp:
                 )
             if path == "/metrics":
                 text = self.metrics.render(
-                    self.engine.reload_counter, self.engine.finished_loading
+                    self.engine.reload_counter, self.engine.finished_loading,
+                    cache=self.cache,
+                    dispatch_counts=getattr(
+                        self.engine, "dispatch_counts", None
+                    ),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -238,10 +251,10 @@ class RecommendApp:
         return _json_response(500, {"detail": "Internal Server Error"})
 
     def _recommend_result_response(
-        self, t0: float, recs: list[str], source: str
+        self, t0: float, recs: list[str], source: str, cached: bool = False
     ) -> Response:
         self.metrics.record(source, time.perf_counter() - t0)
-        return _json_response(
+        status, headers, payload = _json_response(
             200,
             {
                 "songs": recs,
@@ -249,6 +262,63 @@ class RecommendApp:
                 "version": self.cfg.version,
             },
         )
+        if cached:
+            # lets load harnesses (serving/replay.py) split cached vs
+            # computed latency without guessing from timing
+            headers["X-KMLS-Cache"] = "hit"
+        return status, headers, payload
+
+    def _cache_key(self, songs: list[str]) -> tuple:
+        return RecommendCache.key(
+            self.engine.bundle_epoch, songs, self.cfg.max_seed_tracks
+        )
+
+    def _cache_lookup_or_lead(self, songs: list[str]):
+        """The ONE copy of the cache front half, shared by both
+        transports → ``("hit", (songs, source))`` | ``("flight",
+        future)`` | ``("off", None)``. A miss joins the in-flight
+        singleflight future for this key or leads a new batcher
+        submission (the leader's done-callback stores the answer);
+        raises what ``batcher.submit`` raises (Overloaded included).
+        "off" covers: cache disabled, no batcher, or a batcher without
+        ``submit`` (test doubles) — callers compute inline there."""
+        if (
+            self.cache is None
+            or self.batcher is None
+            or not hasattr(self.batcher, "submit")
+        ):
+            return "off", None
+        key = self._cache_key(songs)
+        hit = self.cache.get(key)
+        if hit is not None:
+            return "hit", hit
+        future, joined = self.cache.join_or_lead(
+            key, lambda: self.batcher.submit(songs)
+        )
+        if not joined:
+            cache = self.cache
+            future.add_done_callback(lambda f: cache.finish(key, f))
+        return "flight", future
+
+    def recommend_direct(
+        self, songs: list[str]
+    ) -> tuple[list[str], str, bool]:
+        """Blocking cached recommend → ``(songs, source, cache_hit)``.
+        Used by the threaded POST path and the in-process replay harness;
+        raises (Overloaded included) like the underlying batcher/engine."""
+        state, payload = self._cache_lookup_or_lead(songs)
+        if state == "hit":
+            return payload[0], payload[1], True
+        if state == "flight":
+            recs, source = payload.result(timeout=30.0)
+            return recs, source, False
+        if self.batcher is not None:
+            recs, source = self.batcher.recommend(songs)
+        else:
+            recs, source = self.engine.recommend(songs)
+        if self.cache is not None:
+            self.cache.put(self._cache_key(songs), (recs, source))
+        return recs, source, False
 
     def _post_recommend(self, body: bytes | None) -> Response:
         t0 = time.perf_counter()
@@ -256,37 +326,53 @@ class RecommendApp:
         if err is not None:
             return err
         try:
-            if self.batcher is not None:
-                recs, source = self.batcher.recommend(songs)
-            else:
-                recs, source = self.engine.recommend(songs)
+            recs, source, cached = self.recommend_direct(songs)
         except Exception as exc:
             return self._recommend_error_response(exc)
-        return self._recommend_result_response(t0, recs, source)
+        return self._recommend_result_response(t0, recs, source, cached=cached)
 
     # ---------- async-transport entry points ----------
 
     def submit_recommend(self, body: bytes | None):
         """Non-blocking twin of :meth:`_post_recommend` for the asyncio
         transport: → ``(response, None, t0)`` when the answer is immediate
-        (validation error, shed, or the unbatched path), else ``(None,
-        future, t0)`` — resolve the future off-loop and build the reply
-        with :meth:`finish_recommend`."""
+        (validation error, cache hit, shed, or the unbatched path), else
+        ``(None, future, t0)`` — resolve the future off-loop and build the
+        reply with :meth:`finish_recommend`.
+
+        Cache semantics mirror :meth:`recommend_direct`: hit → immediate
+        response; miss → singleflight through the batcher, so concurrent
+        identical misses on the event loop share ONE batch slot (asyncio
+        futures take any number of done-callbacks, and ``result()`` is
+        re-readable — every joined connection builds its own reply off the
+        same future)."""
         t0 = time.perf_counter()
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err, None, t0
         if self.batcher is None:
             try:
-                recs, source = self.engine.recommend(songs)
+                recs, source, cached = self.recommend_direct(songs)
             except Exception as exc:
                 return self._recommend_error_response(exc), None, t0
-            return self._recommend_result_response(t0, recs, source), None, t0
+            return (
+                self._recommend_result_response(t0, recs, source, cached=cached),
+                None, t0,
+            )
         try:
-            future = self.batcher.submit(songs)
+            state, payload = self._cache_lookup_or_lead(songs)
+            if state == "off":
+                return None, self.batcher.submit(songs), t0
         except Exception as exc:  # Overloaded (shed) lands here
             return self._recommend_error_response(exc), None, t0
-        return None, future, t0
+        if state == "hit":
+            return (
+                self._recommend_result_response(
+                    t0, payload[0], payload[1], cached=True
+                ),
+                None, t0,
+            )
+        return None, payload, t0
 
     def finish_recommend(self, future, t0: float) -> Response:
         """Build the response for a completed :meth:`submit_recommend`

@@ -42,6 +42,20 @@ reported to :class:`~.metrics.ServingMetrics` as ``queue_wait`` /
 ``device`` / ``e2e`` attributions, so ``/metrics`` can say WHERE the tail
 lives. A worker failure is propagated to every waiting request — the
 batcher threads themselves never die.
+
+**Multi-device dispatch**: when the engine publishes more than one
+replica (``KMLS_SERVE_DEVICES``), the batcher becomes a least-loaded
+multi-queue dispatcher — each batch goes to the replica with the fewest
+batches in flight (ties rotate so an all-idle fleet still spreads), with
+per-replica in-flight accounting and one completion lane per replica
+(jax's in-order execution guarantee holds per device, not across
+devices). The pipeline bound and the shed projection are computed against
+AGGREGATE capacity: ``max_inflight`` batches per replica, and a projected
+queue wait of (batches ahead × device-time EWMA) / replica count —
+N devices drain the same queue N times faster. Engines without a replica
+set (``n_replicas`` absent or 1) get the exact single-lane behavior the
+fakes and the native host kernel expect: the ``replica`` kwarg is only
+passed when there is a choice to make.
 """
 
 from __future__ import annotations
@@ -104,29 +118,40 @@ class MicroBatcher:
         self.shed_retry_after_s = shed_retry_after_s
         self.metrics = metrics
         self.shed_total = 0
+        # pipeline depth PER REPLICA; the aggregate bound is this times
+        # the engine's live replica count (clamped: depth 0 would deadlock
+        # the collector — "no pipelining" is depth 1, not 0)
+        self.max_inflight = max(1, max_inflight)
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
-        # (batch, finish_fn, t_dispatch) triples awaiting their device
-        # results, FIFO — jax executes dispatches in order, so completion
-        # order matches
-        self._completions: "queue.Queue[tuple[list[_Pending], object, float]]" = (
-            queue.Queue()
-        )
-        # clamp: Semaphore(0) would deadlock the collector on its first
-        # acquire (every request then times out with no error logged);
-        # "no pipelining" is depth 1, not 0
-        self._inflight = threading.Semaphore(max(1, max_inflight))
-        # dispatched-but-uncompleted batch count, read by the collector's
-        # idle-fast-path and the shedding projection (a stale read is
-        # benign: worst case one batch waits a window it didn't need, or
-        # one request sheds/admits marginally early)
-        self._inflight_n = 0
-        # dispatch times of the in-flight batches, FIFO (completion order
-        # matches dispatch order): the OLDEST entry's age is a live lower
-        # bound on the current device time, which lets the shedding
-        # projection react to a stalled/slow device before the first
-        # completion ever lands (the EWMA alone is blind while cold)
-        self._dispatch_times: "collections.deque[float]" = collections.deque()
+        # one completion lane PER REPLICA: (batch, finish_fn, t_dispatch)
+        # triples awaiting their device results, FIFO within a lane — jax
+        # executes dispatches in order per device, so completion order
+        # matches per lane (but NOT across lanes; a single global lane
+        # would head-of-line-block fast devices behind a slow one).
+        # Lanes + their completer threads are created on first dispatch
+        # to a replica index, by the collector thread only.
+        self._completions: dict[int, "queue.Queue"] = {}
+        # dispatched-but-uncompleted batches per replica, read by the
+        # collector's idle-fast-path, the least-loaded pick, and the
+        # shedding projection (a stale read is benign: worst case one
+        # batch waits a window it didn't need, or one request
+        # sheds/admits marginally early)
+        self._inflight_by_replica: dict[int, int] = {}
+        # rotation point for least-loaded ties: an all-idle replica set
+        # must still spread consecutive batches across devices
+        self._rr = 0
+        # per-replica dispatch times of in-flight batches, FIFO: the
+        # OLDEST entry's age is a live lower bound on the current device
+        # time, which lets the shedding projection react to a
+        # stalled/slow device before the first completion ever lands
+        # (the EWMA alone is blind while cold)
+        self._dispatch_times: dict[int, "collections.deque[float]"] = {}
         self._n_lock = threading.Lock()
+        # collector blocks here while every replica's pipeline is full;
+        # completions notify (replaces the old single-lane semaphore,
+        # whose fixed depth couldn't track a replica count that appears
+        # only at the engine's first load)
+        self._pipe_cond = threading.Condition(self._n_lock)
         # controller state: a sliding window of arrival timestamps
         # (written under _rate_lock by every recommend() call) and a
         # device-batch-time EWMA (written by the completion thread only).
@@ -144,31 +169,70 @@ class MicroBatcher:
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True, name="kmls-microbatcher"
         )
-        self._completer = threading.Thread(
-            target=self._complete_loop, daemon=True, name="kmls-batch-completer"
-        )
         self._collector.start()
-        self._completer.start()
+
+    # ---------- replica bookkeeping ----------
+
+    def _n_replicas(self) -> int:
+        return max(1, getattr(self.engine, "n_replicas", 1))
+
+    def _total_inflight_locked(self) -> int:
+        return sum(self._inflight_by_replica.values())
+
+    def _pick_replica_locked(self, n: int) -> int:
+        """Least-loaded replica index; ties broken by a rotating start so
+        an idle fleet spreads consecutive batches instead of hammering
+        replica 0. Caller holds ``_n_lock``."""
+        best, best_load = 0, None
+        for off in range(n):
+            i = (self._rr + off) % n
+            load = self._inflight_by_replica.get(i, 0)
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        self._rr = (best + 1) % n
+        return best
+
+    def _completion_lane(self, idx: int) -> "queue.Queue":
+        """The collector is the only caller, so lane creation is
+        single-writer; completer threads are per-lane and never die."""
+        lane = self._completions.get(idx)
+        if lane is None:
+            lane = queue.Queue()
+            self._completions[idx] = lane
+            threading.Thread(
+                target=self._complete_loop, args=(idx,), daemon=True,
+                name=f"kmls-batch-completer-{idx}",
+            ).start()
+        return lane
+
+    def per_replica_inflight(self) -> dict[int, int]:
+        """Snapshot for tests/diagnostics."""
+        with self._n_lock:
+            return dict(self._inflight_by_replica)
 
     # ---------- admission ----------
 
     def projected_queue_wait_s(self) -> float:
         """Expected queue wait for a request enqueued NOW: batches ahead of
         it (in flight + already queued) times the per-batch device-time
-        estimate — the completion EWMA, floored by the age of the oldest
-        still-in-flight batch (a stalled device shows up in the age before
-        any completion can move the EWMA). 0 while there's no evidence at
-        all — shedding needs measurements, not guesses."""
+        estimate, divided by the replica count — N devices drain the same
+        queue N times faster, so the budget is against AGGREGATE capacity.
+        The estimate is the completion EWMA, floored by the age of the
+        oldest still-in-flight batch on any replica (a stalled device
+        shows up in the age before any completion can move the EWMA).
+        0 while there's no evidence at all — shedding needs measurements,
+        not guesses."""
         now = time.perf_counter()
         device_s = self._device_s_ewma or 0.0
         with self._n_lock:
-            inflight = self._inflight_n
-            if self._dispatch_times:
-                device_s = max(device_s, now - self._dispatch_times[0])
+            inflight = self._total_inflight_locked()
+            for lane in self._dispatch_times.values():
+                if lane:
+                    device_s = max(device_s, now - lane[0])
         if device_s <= 0.0:
             return 0.0
         queued_batches = self._queue.qsize() / max(self.max_size, 1)
-        return (inflight + queued_batches) * device_s
+        return (inflight + queued_batches) * device_s / self._n_replicas()
 
     def _arrival_gap_s(self) -> float | None:
         """Mean inter-arrival gap over the sliding window, or None before
@@ -232,9 +296,13 @@ class MicroBatcher:
                 except queue.Empty:
                     break
             with self._n_lock:
-                device_idle = self._inflight_n == 0
+                # idle fast path fires while ANY replica sits idle: waiting
+                # only buys amortization when every device already has work
+                device_idle = (
+                    self._total_inflight_locked() < self._n_replicas()
+                )
             if not device_idle:
-                # device busy: the window buys amortization — keep
+                # all replicas busy: the window buys amortization — keep
                 # collecting up to it (a full batch exits immediately)
                 now = time.perf_counter()
                 deadline = now + self._busy_window_s(batch, now)
@@ -246,32 +314,56 @@ class MicroBatcher:
                         batch.append(self._queue.get(timeout=remaining))
                     except queue.Empty:
                         break
-            # else: nothing in flight — waiting can't improve throughput,
-            # it only adds the window to this batch's latency. Dispatch
-            # now; later arrivals pipeline behind as their own batch.
-            # bound the pipeline: past max_inflight undispatched-but-queued
-            # device calls, block here (requests keep queueing upstream and
-            # land in bigger batches — backpressure, not failure)
-            self._inflight.acquire()
-            t_dispatch = time.perf_counter()
-            try:
-                finish = self.engine.recommend_many_async(
-                    [p.seeds for p in batch]
+            # bound the pipeline AGGREGATELY: past max_inflight
+            # undispatched-but-queued device calls PER replica, block here
+            # (requests keep queueing upstream and land in bigger batches
+            # — backpressure, not failure). Reserve the least-loaded
+            # replica under the same lock so the pick and the accounting
+            # can't race a concurrent completion.
+            with self._pipe_cond:
+                while (
+                    self._total_inflight_locked()
+                    >= self.max_inflight * self._n_replicas()
+                ):
+                    self._pipe_cond.wait(timeout=1.0)
+                n = self._n_replicas()
+                idx = self._pick_replica_locked(n) if n > 1 else 0
+                self._inflight_by_replica[idx] = (
+                    self._inflight_by_replica.get(idx, 0) + 1
                 )
+                t_dispatch = time.perf_counter()
+                self._dispatch_times.setdefault(
+                    idx, collections.deque()
+                ).append(t_dispatch)
+            try:
+                # the replica kwarg is passed only when there's a choice:
+                # single-replica engines (fakes, the native host kernel)
+                # keep the bare signature they always had
+                if n > 1:
+                    finish = self.engine.recommend_many_async(
+                        [p.seeds for p in batch], replica=idx
+                    )
+                else:
+                    finish = self.engine.recommend_many_async(
+                        [p.seeds for p in batch]
+                    )
             except Exception as exc:  # propagate, don't die
-                self._inflight.release()
+                with self._pipe_cond:
+                    self._inflight_by_replica[idx] -= 1
+                    lane = self._dispatch_times.get(idx)
+                    if lane:
+                        lane.pop()
+                    self._pipe_cond.notify_all()
                 for pending in batch:
                     if not pending.future.done():
                         pending.future.set_exception(exc)
                 continue
-            with self._n_lock:
-                self._inflight_n += 1
-                self._dispatch_times.append(t_dispatch)
-            self._completions.put((batch, finish, t_dispatch))
+            self._completion_lane(idx).put((batch, finish, t_dispatch))
 
-    def _complete_loop(self) -> None:
+    def _complete_loop(self, idx: int) -> None:
+        lane = self._completions[idx]
         while True:
-            batch, finish, t_dispatch = self._completions.get()
+            batch, finish, t_dispatch = lane.get()
             try:
                 results = finish()
                 err = None
@@ -282,22 +374,27 @@ class MicroBatcher:
             # client, and its immediate next request must not observe a
             # counter that still says busy (it would pay a full window
             # against an idle device — ping-pong traffic regression)
-            with self._n_lock:
-                self._inflight_n -= 1
-                if self._dispatch_times:
-                    self._dispatch_times.popleft()
-            self._inflight.release()
+            device_s = t_complete - t_dispatch
+            with self._pipe_cond:
+                self._inflight_by_replica[idx] -= 1
+                times = self._dispatch_times.get(idx)
+                if times:
+                    times.popleft()
+                if err is None:
+                    # EWMA updated under the lock: per-replica completer
+                    # threads race here, and a torn read-modify-write
+                    # would corrupt the shedding estimate
+                    self._device_s_ewma = (
+                        device_s if self._device_s_ewma is None
+                        else (1 - _EWMA_ALPHA) * self._device_s_ewma
+                        + _EWMA_ALPHA * device_s
+                    )
+                self._pipe_cond.notify_all()
             if err is not None:
                 for pending in batch:
                     if not pending.future.done():
                         pending.future.set_exception(err)
                 continue
-            device_s = t_complete - t_dispatch
-            self._device_s_ewma = (
-                device_s if self._device_s_ewma is None
-                else (1 - _EWMA_ALPHA) * self._device_s_ewma
-                + _EWMA_ALPHA * device_s
-            )
             for pending, result in zip(batch, results):
                 pending.future.set_result(result)
             if self.metrics is not None:
@@ -324,9 +421,10 @@ class AsyncMicroBatcher:
     compute runs as ONE executor task, and the loop wakes once per BATCH.
 
     Policy-identical to :class:`MicroBatcher` — idle fast path, adaptive
-    deadline-aware window, shed-before-budget, queue/device attribution —
-    with the same knobs; the policy methods mirror their threaded
-    namesakes line for line, minus the locking.
+    deadline-aware window, shed-before-budget, least-loaded multi-replica
+    dispatch, queue/device attribution — with the same knobs; the policy
+    methods mirror their threaded namesakes line for line, minus the
+    locking (all state here is loop-confined: plain ints and dicts).
     """
 
     def __init__(
@@ -346,7 +444,7 @@ class AsyncMicroBatcher:
 
         self.engine = engine
         self.max_size = max_size
-        self.max_inflight = max(1, max_inflight)
+        self.max_inflight = max(1, max_inflight)  # per replica
         self.window_s = window_ms / 1e3
         self.adaptive = adaptive
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
@@ -355,28 +453,60 @@ class AsyncMicroBatcher:
         self.metrics = metrics
         self.shed_total = 0
         self._pending: list[_Pending] = []
-        self._inflight_n = 0
-        self._dispatch_times: "collections.deque[float]" = collections.deque()
+        self._inflight_by_replica: dict[int, int] = {}
+        self._rr = 0
+        self._dispatch_times: dict[int, "collections.deque[float]"] = {}
         self._arrivals: "collections.deque[float]" = collections.deque(maxlen=64)
         self._device_s_ewma: float | None = None
         self._flush_handle = None
         # finish() blocks (device transfer, or the GIL-releasing native
-        # call) — it must run off-loop; pool depth = pipeline depth
+        # call) — it must run off-loop; pool depth = aggregate pipeline
+        # depth. The replica count isn't known until the engine's first
+        # load, so the pool is sized for the largest realistic replica set
+        # (threads spawn on demand — headroom costs nothing) and the
+        # ADMISSION bound in _flush clamps to this same number: a batch
+        # the pool couldn't run concurrently must not be admitted, or its
+        # executor queue wait would masquerade as device time in the
+        # attribution and the shedding EWMA.
+        self._executor_workers = min(32, self.max_inflight * 8)
         self._executor = ThreadPoolExecutor(
-            max_workers=self.max_inflight, thread_name_prefix="kmls-abatch"
+            max_workers=self._executor_workers,
+            thread_name_prefix="kmls-abatch",
         )
+
+    # ---------- replica bookkeeping (mirrors MicroBatcher, no locks) ----
+
+    def _n_replicas(self) -> int:
+        return max(1, getattr(self.engine, "n_replicas", 1))
+
+    def _total_inflight(self) -> int:
+        return sum(self._inflight_by_replica.values())
+
+    def _pick_replica(self, n: int) -> int:
+        best, best_load = 0, None
+        for off in range(n):
+            i = (self._rr + off) % n
+            load = self._inflight_by_replica.get(i, 0)
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        self._rr = (best + 1) % n
+        return best
 
     # ---------- policy (mirrors MicroBatcher, loop-confined) ----------
 
     def projected_queue_wait_s(self) -> float:
         now = time.perf_counter()
         device_s = self._device_s_ewma or 0.0
-        if self._dispatch_times:
-            device_s = max(device_s, now - self._dispatch_times[0])
+        for lane in self._dispatch_times.values():
+            if lane:
+                device_s = max(device_s, now - lane[0])
         if device_s <= 0.0:
             return 0.0
         queued_batches = len(self._pending) / max(self.max_size, 1)
-        return (self._inflight_n + queued_batches) * device_s
+        return (
+            (self._total_inflight() + queued_batches)
+            * device_s / self._n_replicas()
+        )
 
     def _arrival_gap_s(self) -> float | None:
         n = len(self._arrivals)
@@ -430,8 +560,8 @@ class AsyncMicroBatcher:
                     self._flush_handle = loop.call_later(
                         window, self._flush, loop
                     )
-        elif self._inflight_n == 0:
-            self._flush(loop)  # idle fast path: dispatch now
+        elif self._total_inflight() < self._n_replicas():
+            self._flush(loop)  # idle fast path: some replica is free now
         elif self._flush_handle is None:
             self._flush_handle = loop.call_later(
                 self._busy_window_s(now), self._flush, loop
@@ -446,15 +576,30 @@ class AsyncMicroBatcher:
             self._flush_handle = None
         if not self._pending:
             return
-        if self._inflight_n >= self.max_inflight:
-            # pipeline full: the next completion re-flushes — pending
-            # requests pile into a bigger batch (backpressure, not failure)
+        n = self._n_replicas()
+        if self._total_inflight() >= min(
+            self.max_inflight * n, self._executor_workers
+        ):
+            # aggregate pipeline full — or past what the executor pool
+            # can actually run concurrently: the next completion
+            # re-flushes and pending requests pile into a bigger batch
+            # (backpressure, not failure)
             return
         batch = self._pending[: self.max_size]
         del self._pending[: len(batch)]
+        idx = self._pick_replica(n) if n > 1 else 0
         t_dispatch = time.perf_counter()
         try:
-            finish = self.engine.recommend_many_async([p.seeds for p in batch])
+            # replica kwarg only when there's a choice — single-replica
+            # engines (fakes, native host kernel) keep the bare signature
+            if n > 1:
+                finish = self.engine.recommend_many_async(
+                    [p.seeds for p in batch], replica=idx
+                )
+            else:
+                finish = self.engine.recommend_many_async(
+                    [p.seeds for p in batch]
+                )
         except Exception as exc:  # propagate, don't die
             for pending in batch:
                 if not pending.future.done():
@@ -462,20 +607,22 @@ class AsyncMicroBatcher:
             if self._pending:
                 loop.call_soon(self._flush, loop)
             return
+        self._inflight_by_replica[idx] = (
+            self._inflight_by_replica.get(idx, 0) + 1
+        )
+        self._dispatch_times.setdefault(
+            idx, collections.deque()
+        ).append(t_dispatch)
         if getattr(self.engine, "host_kernel_active", False):
             # inline: the native kernel is a sub-ms GIL-releasing C call —
             # running it here costs less than one thread handoff, and the
             # whole request lifecycle stays on a single thread
-            self._inflight_n += 1
-            self._dispatch_times.append(t_dispatch)
             try:
                 outcome = (finish(), None)
             except Exception as exc:
                 outcome = (None, exc)
-            self._resolve(batch, outcome, t_dispatch, loop)
+            self._resolve(batch, outcome, t_dispatch, loop, idx)
             return
-        self._inflight_n += 1
-        self._dispatch_times.append(t_dispatch)
 
         def run_finish():
             try:
@@ -486,22 +633,23 @@ class AsyncMicroBatcher:
         task = self._executor.submit(run_finish)
         task.add_done_callback(
             lambda f: loop.call_soon_threadsafe(
-                self._complete, batch, f, t_dispatch, loop
+                self._complete, batch, f, t_dispatch, loop, idx
             )
         )
         if self._pending:
             # overflow past max_size: keep draining
             loop.call_soon(self._flush, loop)
 
-    def _complete(self, batch, task, t_dispatch: float, loop) -> None:
-        self._resolve(batch, task.result(), t_dispatch, loop)
+    def _complete(self, batch, task, t_dispatch: float, loop, idx: int) -> None:
+        self._resolve(batch, task.result(), t_dispatch, loop, idx)
 
-    def _resolve(self, batch, outcome, t_dispatch: float, loop) -> None:
+    def _resolve(self, batch, outcome, t_dispatch: float, loop, idx: int) -> None:
         results, err = outcome
         t_complete = time.perf_counter()
-        self._inflight_n -= 1
-        if self._dispatch_times:
-            self._dispatch_times.popleft()
+        self._inflight_by_replica[idx] -= 1
+        lane = self._dispatch_times.get(idx)
+        if lane:
+            lane.popleft()
         if err is not None:
             for pending in batch:
                 if not pending.future.done():

@@ -1,0 +1,126 @@
+"""Epoch-keyed recommendation cache with singleflight miss collapsing.
+
+Rule lookup is deterministic per published bundle: the same seed set
+against the same rule generation always yields the same answer (the
+static-fallback path included — its sampling seed is a stable digest of
+the seed tracks). Real playlist-seed traffic is Zipf-skewed, so a bounded
+LRU in front of the batcher turns the hot head of the request
+distribution into dictionary lookups — the same shape of win prefix/KV
+caching delivers in inference serving stacks.
+
+Correctness comes from the key, not from invalidation machinery: entries
+are keyed by ``(bundle_epoch, canonicalized seed set)``, and the engine
+bumps ``bundle_epoch`` on every successful hot swap AFTER publishing the
+new bundle (see the ordering contract in engine.load). A post-swap lookup
+therefore constructs a key no stale entry can match — the whole cache is
+invalidated wholesale, for free, without touching it. Stale old-epoch
+entries age out of the LRU naturally.
+
+Canonicalization: answers are order-independent for seed sets within the
+kernel's seed cap (the score merge is a max over seeds; the fallback
+digest sorts internally), so the key sorts the seeds — requests that
+permute the same seeds share one entry. Duplicates are KEPT (the fallback
+digest distinguishes ``["a", "a"]`` from ``["a"]``), and oversized seed
+lists keep their original order (truncation to the cap is positional, so
+order changes the answer there).
+
+Singleflight: concurrent identical misses collapse onto ONE in-flight
+future — the first requester dispatches to the batcher, later identical
+requests attach to the same future instead of duplicating device work.
+Works for both transports because both speak futures (``concurrent
+.futures.Future`` from the threaded batcher, ``asyncio.Future`` from the
+loop-native one); the cache never blocks on a future itself.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class RecommendCache:
+    """Bounded LRU of ``key → (songs, source)`` plus the in-flight
+    singleflight table. Thread-safe; counters are Prometheus-monotonic
+    (rendered by serving/metrics.py)."""
+
+    def __init__(self, max_entries: int = 8192):
+        self.max_entries = max(1, max_entries)
+        self._lru: "OrderedDict[tuple, tuple[list[str], str]]" = OrderedDict()
+        self._inflight: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.singleflight_joins = 0
+
+    # ---------- keys ----------
+
+    @staticmethod
+    def key(epoch: int, seeds: list[str], seed_cap: int) -> tuple:
+        """→ ``(epoch, canonical seed tuple)``. Sorted (order-free answers)
+        with duplicates kept; seed lists past the kernel cap keep request
+        order because truncation there is positional."""
+        core = tuple(sorted(seeds)) if len(seeds) <= seed_cap else tuple(seeds)
+        return (epoch, core)
+
+    # ---------- LRU ----------
+
+    def get(self, key: tuple) -> tuple[list[str], str] | None:
+        with self._lock:
+            value = self._lru.get(key)
+            if value is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def put(self, key: tuple, value: tuple[list[str], str]) -> None:
+        with self._lock:
+            self._lru[key] = value
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.max_entries:
+                self._lru.popitem(last=False)
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def hit_ratio(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    # ---------- singleflight ----------
+
+    def join_or_lead(self, key: tuple, submit):
+        """→ ``(future, joined)``. Atomically joins the in-flight future
+        for ``key``, or installs ``submit()``'s future as the new leader.
+        ``submit`` may raise (e.g. the batcher's Overloaded shed) — then
+        nothing is installed and followers are unaffected. The leader must
+        arrange :meth:`finish` to run when its future completes."""
+        with self._lock:
+            future = self._inflight.get(key)
+            if future is not None:
+                self.singleflight_joins += 1
+                return future, True
+            # submit() under the lock keeps lead-election atomic; the
+            # batcher's admission path never calls back into the cache,
+            # so the lock order is acyclic
+            future = submit()
+            self._inflight[key] = future
+            return future, False
+
+    def finish(self, key: tuple, future) -> None:
+        """Leader's done-callback: retire the in-flight entry and store
+        the answer on success (failures — sheds included — cache nothing)."""
+        with self._lock:
+            self._inflight.pop(key, None)
+        try:
+            if future.cancelled() or future.exception() is not None:
+                return
+            result = future.result()
+        except Exception:
+            return
+        self.put(key, result)

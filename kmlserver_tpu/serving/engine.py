@@ -27,6 +27,15 @@ Reference behaviors replicated (rest_api/app/main.py):
 The engine prefers the tensor-native npz artifact (straight ``device_put``)
 and falls back to the reference-format pickle, so it can serve a PVC
 populated by either the rebuild's or the reference's mining job.
+
+Multi-device serving: a publication builds one :class:`RuleBundle` replica
+per serving device (``KMLS_SERVE_DEVICES``; rule tensors ``device_put`` to
+each device, every shape bucket warmed per replica) and swaps the whole
+set atomically. ``recommend_many_async(..., replica=i)`` executes a batch
+on replica ``i``'s device — the batcher's least-loaded dispatcher uses
+this to run concurrent batches on different devices instead of
+serializing them on one in-order execution queue. ``bundle_epoch`` is the
+monotonic publication counter the recommendation cache keys on.
 """
 
 from __future__ import annotations
@@ -94,7 +103,12 @@ def stable_seed(seed_tracks: list[str]) -> int:
 
 @dataclasses.dataclass
 class RuleBundle:
-    """One immutable generation of serving state. Swapped atomically."""
+    """One immutable generation of serving state. Swapped atomically.
+
+    With multi-device serving active (``KMLS_SERVE_DEVICES``), one bundle
+    exists PER local device — the vocab/index/known-mask host state is
+    shared across the replica set, the rule tensors live on each replica's
+    own device, and the whole set swaps as one publication."""
 
     vocab: list[str]
     index: dict[str, int]
@@ -102,6 +116,12 @@ class RuleBundle:
     rule_confs: jax.Array  # device, float32 (V, K)
     known_mask: np.ndarray  # host, bool (V,) — rule-dict key membership
     model_token: str  # token value when loaded
+    # the device this replica's tensors are committed to (None = host-
+    # kernel bundle or default placement) and the generation counter the
+    # recommendation cache keys on — monotonic per engine, bumped on every
+    # successful publication, so a cache entry can never outlive its rules
+    device: object = None
+    epoch: int = 0
     # every (batch, length) seed shape warmed before publication — the
     # serving thread checks membership so an unwarmed dispatch (a compile
     # on the hot path) is counted and logged, never silent
@@ -122,6 +142,16 @@ class RecommendEngine:
     def __init__(self, cfg: ServingConfig):
         self.cfg = cfg
         self.bundle: RuleBundle | None = None
+        # the full replica set (one bundle per serving device); `bundle`
+        # stays the primary replica for single-device callers
+        self.replicas: list[RuleBundle] = []
+        # monotonic publication counter — the recommendation cache's key
+        # prefix. 0 = nothing published yet.
+        self.bundle_epoch = 0
+        # cumulative per-replica dispatch counters (Prometheus-monotonic:
+        # they survive hot swaps), index-aligned with `replicas`
+        self.dispatch_counts: list[int] = []
+        self._dispatch_lock = threading.Lock()
         self.best_tracks: list[dict] | None = None
         self.cache_value: str | None = None  # the reference's app.cache_value
         self.finished_loading = False
@@ -183,14 +213,16 @@ class RecommendEngine:
             npz_path = artifacts.tensor_artifact_path(rec_path)
             try:
                 best = artifacts.load_pickle(best_path)
-                bundle = self._build_bundle(rec_path, npz_path)
-                # warm the serving kernel for every seed-bucket shape BEFORE
-                # publishing: the first jit compile costs seconds on TPU and
-                # must not land inside a request (readiness implies warmed).
-                # Reloads with unchanged tensor shapes hit the jit cache and
-                # skip this. Inside the try: tensors that np.load accepts
-                # but the kernel rejects must fail-soft too.
-                self._warmup(bundle)
+                replicas = self._build_replicas(rec_path, npz_path)
+                # warm the serving kernel for every seed-bucket shape on
+                # EVERY replica BEFORE publishing: the first jit compile
+                # costs seconds on TPU and must not land inside a request
+                # (readiness implies warmed — on all devices). Reloads with
+                # unchanged tensor shapes hit the jit cache and skip this.
+                # Inside the try: tensors that np.load accepts but the
+                # kernel rejects must fail-soft too.
+                for bundle in replicas:
+                    self._warmup(bundle)
             except FileNotFoundError as exc:
                 logger.warning("artifacts not ready: %s", exc)
                 return False
@@ -201,20 +233,42 @@ class RecommendEngine:
                 # bundle, retry on the next poll
                 logger.exception("artifact load failed; keeping current bundle")
                 return False
-            # atomic publication: single reference assignments
+            # atomic publication: single reference assignments. Ordering
+            # contract for the epoch-keyed cache: the bundle reference
+            # lands BEFORE the epoch bump, so an answer stored under the
+            # new epoch can only have been computed from the new rules —
+            # a stale answer can land only under the OLD epoch key, which
+            # no post-swap lookup can ever construct. (The benign inverse
+            # — a new-rules answer briefly stored under the old key — just
+            # serves fresher data than advertised.)
+            epoch = self.bundle_epoch + 1
+            for bundle in replicas:
+                bundle.epoch = epoch
             self.best_tracks = best
-            self.bundle = bundle
-            self.cache_value = bundle.model_token or self.cache_value
+            self.replicas = replicas
+            self.bundle = replicas[0]
+            self.bundle_epoch = epoch
+            with self._dispatch_lock:
+                while len(self.dispatch_counts) < len(replicas):
+                    self.dispatch_counts.append(0)
+            self.cache_value = replicas[0].model_token or self.cache_value
             self.finished_loading = True
             self.reload_counter += 1
             logger.info(
-                "reload #%d complete: %d tracks, %d rule keys, token %r",
-                self.reload_counter, len(bundle.vocab),
-                int(bundle.known_mask.sum()), bundle.model_token,
+                "reload #%d complete (epoch %d): %d tracks, %d rule keys, "
+                "%d replica(s), token %r",
+                self.reload_counter, epoch, len(replicas[0].vocab),
+                int(replicas[0].known_mask.sum()), len(replicas),
+                replicas[0].model_token,
             )
             return True
 
-    def _build_bundle(self, rec_path: str, npz_path: str) -> RuleBundle:
+    def _build_replicas(self, rec_path: str, npz_path: str) -> list[RuleBundle]:
+        """Load the rule tensors once, then replicate them onto every
+        serving device (``device_put`` per device) — or onto the host when
+        the native CPU kernel is active (one replica: the host kernel has
+        no per-device state to parallelize over). Host-side state (vocab,
+        index, known mask) is shared across the set."""
         token = self._read_token() or ""
         loaded = None
         if self.cfg.prefer_tensor_artifact and os.path.exists(npz_path):
@@ -247,7 +301,8 @@ class RecommendEngine:
                     (len(r) for r in rules_dict.values()), default=1
                 ),
             )
-        host_ids = host_confs = None
+        index = {n: i for i, n in enumerate(vocab)}
+        known_mask = np.asarray(known)
         if self._use_native_serve():
             # rule rows are trailing-padded (emission writes the top-k
             # descending, then -1 fill) — the native kernel's early-break
@@ -256,20 +311,49 @@ class RecommendEngine:
             host_confs = np.ascontiguousarray(rule_confs, dtype=np.float32)
             # jnp.asarray is zero-copy on the CPU backend, so keeping the
             # "device" tensors next to the host copies costs no memory
-            dev_ids, dev_confs = jnp.asarray(host_ids), jnp.asarray(host_confs)
-        else:
-            dev_ids = jax.device_put(jnp.asarray(rule_ids))
-            dev_confs = jax.device_put(jnp.asarray(rule_confs))
-        return RuleBundle(
-            vocab=vocab,
-            index={n: i for i, n in enumerate(vocab)},
-            rule_ids=dev_ids,
-            rule_confs=dev_confs,
-            known_mask=np.asarray(known),
-            model_token=token,
-            host_rule_ids=host_ids,
-            host_rule_confs=host_confs,
-        )
+            return [RuleBundle(
+                vocab=vocab, index=index,
+                rule_ids=jnp.asarray(host_ids),
+                rule_confs=jnp.asarray(host_confs),
+                known_mask=known_mask, model_token=token,
+                host_rule_ids=host_ids, host_rule_confs=host_confs,
+            )]
+        ids_arr = jnp.asarray(rule_ids)
+        confs_arr = jnp.asarray(rule_confs)
+        return [
+            RuleBundle(
+                vocab=vocab, index=index,
+                rule_ids=jax.device_put(ids_arr, dev),
+                rule_confs=jax.device_put(confs_arr, dev),
+                known_mask=known_mask, model_token=token,
+                device=dev,
+            )
+            for dev in self._serve_devices()
+        ]
+
+    def _serve_devices(self) -> list:
+        """The local devices the replica set spans. ``serve_devices == 0``
+        (auto) replicates onto every local device on accelerator backends;
+        on CPU it stays at one — virtual CPU devices share the same host
+        cores, so extra replicas there only multiply warmup compiles unless
+        an operator (or a test) opts in via KMLS_SERVE_DEVICES."""
+        devs = jax.local_devices()
+        n = self.cfg.serve_devices
+        if n <= 0:
+            n = 1 if jax.default_backend() == "cpu" else len(devs)
+        return devs[: max(1, min(n, len(devs)))]
+
+    @property
+    def n_replicas(self) -> int:
+        """Serving replicas currently published (1 before the first load —
+        the batcher's least-loaded dispatcher sizes its lanes off this)."""
+        return max(1, len(self.replicas))
+
+    def _note_dispatch(self, idx: int) -> None:
+        with self._dispatch_lock:
+            while len(self.dispatch_counts) <= idx:
+                self.dispatch_counts.append(0)
+            self.dispatch_counts[idx] += 1
 
     def _use_native_serve(self) -> bool:
         """Native host kernel iff the backend is CPU (an accelerator's
@@ -304,6 +388,10 @@ class RecommendEngine:
         for length in self._len_buckets():
             for batch in self._batch_buckets():
                 seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
+                if bundle.device is not None:
+                    # commit the seeds to the replica's device so the
+                    # warmed executable is the one its dispatches will hit
+                    seeds = jax.device_put(seeds, bundle.device)
                 jax.block_until_ready(
                     kernel(bundle.rule_ids, bundle.rule_confs, seeds)
                 )
@@ -392,7 +480,10 @@ class RecommendEngine:
         → (device seed array, per-row any-known-seed mask, host). Reuses
         one staging buffer per shape when the backend's ``device_put``
         copies (probed); the known-row mask is snapshotted BEFORE the
-        buffer can be refilled by the next dispatch."""
+        buffer can be refilled by the next dispatch. The transfer targets
+        the bundle's own device, so a replica's dispatch runs on the
+        replica's chip — the staging buffer is shared across replicas
+        (fill + transfer are serialized under the lock either way)."""
         shape = (rows, length)
         with self._staging_lock:
             if _staging_is_safe():
@@ -405,7 +496,7 @@ class RecommendEngine:
             else:
                 arr = np.full(shape, -1, dtype=np.int32)
             known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
-            seeds_dev = jax.device_put(arr)
+            seeds_dev = jax.device_put(arr, bundle.device)
         if shape not in bundle.warmed_shapes:
             # a compile is landing on the serving path — count it loudly
             self.unwarmed_dispatches += 1
@@ -448,6 +539,7 @@ class RecommendEngine:
                 self.cfg.k_best_tracks,
             )
             ids = top_ids[0]
+            self._note_dispatch(0)
         else:
             length = self._bucket_len(len(known_ids))
             seeds_dev, _ = self._stage_seeds(bundle, [seed_tracks], 1, length)
@@ -455,10 +547,13 @@ class RecommendEngine:
                 bundle.rule_ids, bundle.rule_confs, seeds_dev
             )
             ids = np.asarray(top_ids[0])
+            self._note_dispatch(0)
         songs = [bundle.vocab[int(i)] for i in ids if i >= 0]
         return songs, ("rules" if songs else "empty")
 
-    def recommend_many_async(self, seed_sets: list[list[str]]):
+    def recommend_many_async(
+        self, seed_sets: list[list[str]], replica: int | None = None
+    ):
         """Batched lookup split into DISPATCH (device call enqueued, returns
         immediately — jax dispatch is asynchronous) and FINISH (a zero-arg
         callable that blocks on the result and builds the responses).
@@ -468,8 +563,18 @@ class RecommendEngine:
         tunnel adds ~65 ms per blocked call) a dispatch-block-respond loop
         caps throughput at batch_size/RTT; overlapping the next dispatch
         with the previous transfer removes that ceiling. Per-request
-        semantics identical to :meth:`recommend`."""
-        bundle = self.bundle
+        semantics identical to :meth:`recommend`.
+
+        ``replica`` selects which device replica executes the batch (the
+        least-loaded dispatcher in serving/batcher.py passes it); None —
+        or the native host kernel — uses the primary. Concurrent batches
+        on DIFFERENT replicas run on different devices instead of
+        serializing on one in-order execution queue."""
+        replicas = self.replicas
+        idx = 0
+        if replica is not None and replicas:
+            idx = replica % len(replicas)
+        bundle = replicas[idx] if replicas else self.bundle
         if bundle is None:
             # same late-load nudge as the single-request path
             threading.Thread(target=self.reload_if_required, daemon=True).start()
@@ -492,6 +597,7 @@ class RecommendEngine:
             )
             arr = np.full((len(seed_sets), length), -1, dtype=np.int32)
             known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
+            self._note_dispatch(idx)
 
             def finish_native() -> list[tuple[list[str], str]]:
                 from . import native_serve
@@ -531,6 +637,7 @@ class RecommendEngine:
         top_ids, _ = self._resolve_kernel()(
             bundle.rule_ids, bundle.rule_confs, seeds_dev
         )
+        self._note_dispatch(idx)
 
         def finish() -> list[tuple[list[str], str]]:
             host_ids = np.asarray(top_ids)  # blocks on the device transfer

@@ -18,6 +18,12 @@ Two targets:
 Seed sets are sampled from the engine's vocabulary (mixing known and
 unknown seeds exercises both the rules path and the static fallback, like
 the reference's three canned Swagger examples at rest_api/app/main.py:158-174).
+``--zipf-s`` switches the mix to a Zipf-skewed repetition of a payload
+pool — the head-heavy shape real playlist-seed traffic has, which is what
+the epoch-keyed answer cache feeds on (default off, preserving the
+all-distinct legacy mix bit for bit). Targets that report cache outcomes
+(the in-process app path, or an HTTP server's ``X-KMLS-Cache`` header)
+get cached/uncached latency split out in the report.
 """
 
 from __future__ import annotations
@@ -55,9 +61,50 @@ class ReplayReport:
     device_p50_ms: float | None = None
     device_p99_ms: float | None = None
     e2e_p999_ms: float | None = None
+    # cache split, present when the target reports per-response cache
+    # outcomes (a send() returning (source, cached), or the HTTP server's
+    # X-KMLS-Cache header): cached answers are dictionary lookups and
+    # computed answers pay the device — reporting them pooled would let a
+    # high hit ratio mask a computed-path regression
+    cache_hit_ratio: float | None = None
+    cached_p50_ms: float | None = None
+    cached_p99_ms: float | None = None
+    uncached_p50_ms: float | None = None
+    uncached_p99_ms: float | None = None
+    # per-replica device dispatch counters (in-process target only): the
+    # evidence the data-parallel dispatcher spread work across devices
+    per_device_dispatch: list[int] | None = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
+
+
+def _unpack_send_result(result) -> tuple[str, bool | None]:
+    """send() contract: a bare source tag (legacy), or (source, cached)."""
+    if isinstance(result, tuple):
+        return result[0], bool(result[1])
+    return result, None
+
+
+def _cache_split_fields(
+    lat_cached: list[float], lat_uncached: list[float], n_ok: int
+) -> dict:
+    """→ the ReplayReport cache-split kwargs (empty when the target never
+    reported a cache outcome)."""
+    if not lat_cached and not lat_uncached:
+        return {}
+    cached_sorted = sorted(lat_cached)
+    uncached_sorted = sorted(lat_uncached)
+    out = {
+        "cache_hit_ratio": len(cached_sorted) / n_ok if n_ok else 0.0,
+    }
+    if cached_sorted:
+        out["cached_p50_ms"] = _percentile(cached_sorted, 0.50)
+        out["cached_p99_ms"] = _percentile(cached_sorted, 0.99)
+    if uncached_sorted:
+        out["uncached_p50_ms"] = _percentile(uncached_sorted, 0.50)
+        out["uncached_p99_ms"] = _percentile(uncached_sorted, 0.99)
+    return out
 
 
 def attach_attribution(report: "ReplayReport", metrics) -> "ReplayReport":
@@ -89,17 +136,33 @@ def sample_seed_sets(
     seeds_per_request: int = 3,
     unknown_fraction: float = 0.1,
     rng_seed: int = 0,
+    zipf_s: float = 0.0,
+    zipf_pool: int = 512,
 ) -> list[list[str]]:
-    """n request payloads: mostly known tracks, a slice of unknown ones."""
+    """n request payloads: mostly known tracks, a slice of unknown ones.
+
+    ``zipf_s > 0`` switches to a Zipf-distributed query mix: a pool of
+    ``zipf_pool`` distinct payloads is drawn exactly as before, and each of
+    the n requests picks pool entry k with probability ∝ 1/k^s — the
+    skewed head real playlist-seed traffic has, and what an epoch-keyed
+    answer cache feeds on. Default OFF (0.0) so every existing bench/replay
+    number keeps its all-distinct request mix, bit for bit."""
     rng = random.Random(rng_seed)
-    out = []
-    for i in range(n):
+
+    def _draw(i: int) -> list[str]:
         if vocab and rng.random() >= unknown_fraction:
             k = min(seeds_per_request, len(vocab))
-            out.append(rng.sample(vocab, k))
-        else:
-            out.append([f"__replay_unknown_{i}__"])
-    return out
+            return rng.sample(vocab, k)
+        return [f"__replay_unknown_{i}__"]
+
+    if zipf_s <= 0.0:
+        return [_draw(i) for i in range(n)]
+    pool = [_draw(i) for i in range(max(1, min(zipf_pool, max(n, 1))))]
+    ranks = np.arange(1, len(pool) + 1, dtype=np.float64)
+    p = ranks ** -zipf_s
+    p /= p.sum()
+    picks = np.random.default_rng(rng_seed).choice(len(pool), size=n, p=p)
+    return [pool[int(i)] for i in picks]
 
 
 def replay(
@@ -118,6 +181,8 @@ def replay(
     arrival = np.cumsum(gaps)
 
     lat_ms: list[float] = []
+    lat_cached: list[float] = []
+    lat_uncached: list[float] = []
     by_source: dict[str, int] = {}
     errors = 0
     lock = threading.Lock()
@@ -127,10 +192,12 @@ def replay(
     def worker(seeds: list[str]) -> None:
         t0 = time.perf_counter()
         try:
-            source = send(seeds)
+            source, cached = _unpack_send_result(send(seeds))
             dt_ms = (time.perf_counter() - t0) * 1e3
             with lock:
                 lat_ms.append(dt_ms)
+                if cached is not None:
+                    (lat_cached if cached else lat_uncached).append(dt_ms)
                 by_source[source] = by_source.get(source, 0) + 1
         except Exception:
             nonlocal errors
@@ -163,6 +230,7 @@ def replay(
         lat_sorted = sorted(lat_ms)
         sources = dict(by_source)
         n_errors = errors
+        split = _cache_split_fields(lat_cached, lat_uncached, len(lat_ms))
     n_ok = len(lat_sorted)
     return ReplayReport(
         target_qps=qps,
@@ -175,6 +243,7 @@ def replay(
         p95_ms=_percentile(lat_sorted, 0.95),
         p99_ms=_percentile(lat_sorted, 0.99),
         by_source=sources,
+        **split,
     )
 
 
@@ -200,6 +269,8 @@ def replay_pooled(
 
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max_queue)
     lat_ms: list[float] = []
+    lat_cached: list[float] = []
+    lat_uncached: list[float] = []
     by_source: dict[str, int] = {}
     errors = 0
     lock = threading.Lock()
@@ -211,16 +282,37 @@ def replay_pooled(
             item = q.get()
             if item is None:
                 return
-            arrival_abs, seeds = item
-            try:
-                source = send(seeds)
-                dt_ms = (time.perf_counter() - arrival_abs) * 1e3
-                with lock:
-                    lat_ms.append(dt_ms)
-                    by_source[source] = by_source.get(source, 0) + 1
-            except Exception:
-                with lock:
-                    errors += 1
+            # drain a burst behind the blocking get: at 10k-QPS pacing,
+            # one futex wake per item IS the loadgen ceiling on a small
+            # host (~8k/s measured on a 2-core sandbox); a woken worker
+            # that sweeps everything already queued amortizes the wakeup
+            # the same way the async HTTP client amortizes syscalls.
+            # Low-rate behavior is unchanged — an empty queue yields a
+            # burst of one.
+            burst = [item]
+            while len(burst) < 64:
+                try:
+                    extra = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if extra is None:
+                    q.put_nowait(None)  # keep the sentinel for the pool
+                    break
+                burst.append(extra)
+            for arrival_abs, seeds in burst:
+                try:
+                    source, cached = _unpack_send_result(send(seeds))
+                    dt_ms = (time.perf_counter() - arrival_abs) * 1e3
+                    with lock:
+                        lat_ms.append(dt_ms)
+                        if cached is not None:
+                            (lat_cached if cached else lat_uncached).append(
+                                dt_ms
+                            )
+                        by_source[source] = by_source.get(source, 0) + 1
+                except Exception:
+                    with lock:
+                        errors += 1
 
     workers = [
         threading.Thread(target=worker, daemon=True) for _ in range(n_workers)
@@ -248,6 +340,7 @@ def replay_pooled(
         lat_sorted = sorted(lat_ms)
         sources = dict(by_source)
         n_errors = errors
+        split = _cache_split_fields(lat_cached, lat_uncached, len(lat_ms))
     n_ok = len(lat_sorted)
     return ReplayReport(
         target_qps=qps,
@@ -260,6 +353,7 @@ def replay_pooled(
         p95_ms=_percentile(lat_sorted, 0.95),
         p99_ms=_percentile(lat_sorted, 0.99),
         by_source=sources,
+        **split,
     )
 
 
@@ -303,6 +397,8 @@ def replay_async_http(
     arrival = np.cumsum(rng.exponential(1.0 / qps, size=len(payloads)))
 
     lat_ms: list[float] = []
+    lat_cached: list[float] = []
+    lat_uncached: list[float] = []
     by_source: dict[str, int] = {}
     errors = 0
 
@@ -349,7 +445,8 @@ def replay_async_http(
                     for t_arr, _i in burst:
                         head = await reader.readuntil(b"\r\n\r\n")
                         clen = 0
-                        for line in head.lower().split(b"\r\n"):
+                        head_lower = head.lower()
+                        for line in head_lower.split(b"\r\n"):
                             if line.startswith(b"content-length"):
                                 clen = int(line.split(b":", 1)[1])
                         body = await reader.readexactly(clen)
@@ -358,7 +455,15 @@ def replay_async_http(
                         if status != 200:
                             errors += 1
                             continue
-                        lat_ms.append((time.perf_counter() - t_arr) * 1e3)
+                        dt_ms = (time.perf_counter() - t_arr) * 1e3
+                        lat_ms.append(dt_ms)
+                        # the server marks answer-cache hits with an
+                        # X-KMLS-Cache header (serving/app.py) — the only
+                        # way a black-box client can split cached latency
+                        if b"x-kmls-cache: hit" in head_lower:
+                            lat_cached.append(dt_ms)
+                        else:
+                            lat_uncached.append(dt_ms)
                         source = (
                             "empty" if b'"songs": []' in body else "nonempty"
                         )
@@ -409,6 +514,7 @@ def replay_async_http(
         p95_ms=_percentile(lat_sorted, 0.95),
         p99_ms=_percentile(lat_sorted, 0.99),
         by_source=by_source,
+        **_cache_split_fields(lat_cached, lat_uncached, n_ok),
     )
 
 
@@ -477,6 +583,12 @@ def main() -> int:
         "--client", choices=("async", "pooled"), default="async",
         help="HTTP loadgen: single-loop pipelined (default) or thread pool",
     )
+    parser.add_argument(
+        "--zipf-s", type=float, default=0.0,
+        help="Zipf exponent for a skewed query mix over a pool of distinct "
+             "payloads (0 = off, the all-distinct legacy mix; 1.1 models "
+             "real playlist-seed traffic and feeds the answer cache)",
+    )
     args = parser.parse_args()
 
     if args.url:
@@ -486,7 +598,7 @@ def main() -> int:
                 "NOTE: no local artifacts found (BASE_DIR); all seeds are "
                 "unknown — this measures the static-fallback path only",
             )
-        payloads = sample_seed_sets(vocab, args.requests)
+        payloads = sample_seed_sets(vocab, args.requests, zipf_s=args.zipf_s)
         if args.client == "async":
             report = replay_async_http(
                 args.url, payloads, qps=args.qps,
@@ -500,29 +612,38 @@ def main() -> int:
         print(report.to_json())
         return 0
     else:
-        from ..config import ServingConfig
-        from .batcher import MicroBatcher
-        from .engine import RecommendEngine
-        from .metrics import ServingMetrics
+        import dataclasses as dataclasses_mod
 
-        cfg = ServingConfig.from_env()
-        engine = RecommendEngine(cfg)
-        if not engine.load():
+        from ..config import ServingConfig
+        from .app import RecommendApp
+
+        # the app core, not a bare batcher: the in-process target then
+        # measures the same cache → batcher → engine path the HTTP front
+        # ends serve, and reports the cache split + per-replica dispatch
+        cfg = dataclasses_mod.replace(
+            ServingConfig.from_env(),
+            batch_max_size=args.batch_max_size,
+            batch_window_ms=args.batch_window_ms,
+        )
+        app = RecommendApp(cfg)
+        if not app.engine.load():
             print("artifacts not found; run the mining job first")
             return 1
-        metrics = ServingMetrics()
-        batcher = MicroBatcher(
-            engine, max_size=args.batch_max_size,
-            window_ms=args.batch_window_ms, metrics=metrics,
+        metrics = app.metrics
+
+        def send(seeds: list[str]) -> tuple[str, bool]:
+            recs, source, cached = app.recommend_direct(seeds)
+            return source, cached
+
+        payloads = sample_seed_sets(
+            app.engine.bundle.vocab, args.requests, zipf_s=args.zipf_s
         )
-
-        def send(seeds: list[str]) -> str:
-            return batcher.recommend(seeds)[1]
-
-        payloads = sample_seed_sets(engine.bundle.vocab, args.requests)
 
     report = replay(send, payloads, qps=args.qps)
     attach_attribution(report, metrics)
+    if app.cache is not None:
+        report.cache_hit_ratio = app.cache.hit_ratio()
+    report.per_device_dispatch = list(app.engine.dispatch_counts)
     print(report.to_json())
     return 0
 

@@ -11,7 +11,8 @@ cd "$(dirname "$0")/.." || exit 1
 N=0
 ROUND=${TPU_WATCH_ROUND:-r05}
 MAX_CAPTURES=${TPU_WATCH_MAX_CAPTURES:-4}
-LOG=${TPU_WATCH_LOG:-tpu_watch.log}
+LOG=${TPU_WATCH_LOG:-artifacts/tpu_watch.log}
+mkdir -p "$(dirname "$LOG")"
 STATE=${TPU_WATCH_STATE:-bench_state_${ROUND}_tpu.json}
 OUTDIR=${TPU_WATCH_OUTDIR:-.}
 while true; do

@@ -345,6 +345,14 @@ class TestTpuSuiteWiring:
             "results": [{"config": "64x128x512", "variant": "bcast",
                          "ms": 95.0, "words_per_s": 2.6e10}],
         },
+        "replay10k": {
+            "qps": 10000.0, "offered_qps": 10020.0,
+            "achieved_qps": 10010.0, "p50_ms": 0.4, "p95_ms": 1.4,
+            "p99_ms": 4.9, "errors": 0, "cache_hit_ratio": 0.98,
+            "cached_p50_ms": 0.4, "uncached_p50_ms": 2.0, "zipf_s": 1.1,
+            "per_device_dispatch": [230, 243], "devices_active": 2,
+            "n_replicas": 2, "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -406,6 +414,11 @@ class TestTpuSuiteWiring:
         assert final["replay_job_end_to_end_s"] == 3.5
         assert final["popcount_tune_best_config"] == "64x128x512"
         assert final["popcount_tune_best_ms"] == 95.0
+        # the 10k-QPS bracket: self-labeled CPU keys, cache + dispatch
+        assert final["replay10k_p99_ms"] == 4.9
+        assert final["replay10k_cache_hit_ratio"] == 0.98
+        assert final["replay10k_devices_active"] == 2
+        assert final["replay10k_platform"] == "cpu"
         # the supplementary CPU replay lands under cpu_-prefixed keys
         assert final["cpu_replay_achieved_qps"] == 1010.0
 
@@ -865,7 +878,7 @@ class TestBenchStateResume:
         assert set(banked) == {
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
-            "replay_cpu_supp",
+            "replay_cpu_supp", "replay10k_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -1074,6 +1087,59 @@ class TestCompactLine:
         # the judged serving keys outrank the bloat
         assert parsed["replay_queue_wait_p99_ms"] == 3.5
         assert parsed["replay_device_p99_ms"] == 6.0
+
+    def test_compact_line_keeps_replay10k_and_cache_keys(self):
+        """The r05 headline was lost at 2,112 chars against a 2,000-char
+        tail window; the PR-2 key additions (replay10k_* + cache_*) must
+        not regress the ≤1,800 budget, and must outrank filler."""
+        r10k = {
+            "replay10k_qps": 10000.0,
+            "replay10k_achieved_qps": 10021.8,
+            "replay10k_p50_ms": 0.403,
+            "replay10k_p99_ms": 4.881,
+            "replay10k_errors": 0,
+            "replay10k_cache_hit_ratio": 0.997,
+            "replay10k_cached_p50_ms": 0.402,
+            "replay10k_uncached_p50_ms": 2.035,
+            "replay10k_devices_active": 8,
+            "replay10k_per_device_dispatch": [59, 61, 58, 60, 57, 62, 59, 57],
+        }
+        for key in r10k:
+            if key != "replay10k_per_device_dispatch":
+                assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **r10k, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["replay10k_p99_ms"] == 4.881
+        assert parsed["replay10k_cache_hit_ratio"] == 0.997
+        assert parsed["replay10k_cached_p50_ms"] == 0.402
+
+    def test_record_replay10k_emits_bounded_artifact(self, monkeypatch):
+        canned = {
+            "qps": 10000.0, "offered_qps": 10021.8, "achieved_qps": 10011.2,
+            "p50_ms": 0.41, "p95_ms": 1.4, "p99_ms": 4.9, "errors": 0,
+            "cache_hit_ratio": 0.98, "cached_p50_ms": 0.4,
+            "uncached_p50_ms": 2.1, "zipf_s": 1.1,
+            "per_device_dispatch": [10, 11, 9, 12, 10, 9, 11, 10],
+            "devices_active": 8, "n_replicas": 8, "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_replay10k(result)
+        assert result["replay10k_qps"] == 10000.0
+        assert result["replay10k_errors"] == 0
+        assert result["replay10k_cache_hit_ratio"] == 0.98
+        assert result["replay10k_devices_active"] == 8
+        assert result["replay10k_platform"] == "cpu"
+        # the full dict + headline still fits the compact budget
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu", **result}
+        assert len(bench._compact_line(full)) <= bench.COMPACT_LINE_LIMIT
 
     def test_emitter_final_line_bounded_with_full_sidecar(
         self, tmp_path, capsys

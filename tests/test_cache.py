@@ -1,0 +1,290 @@
+"""The epoch-keyed recommendation cache: key canonicalization, LRU
+bounds, singleflight collapsing, correctness under hot swap (a reload
+must never serve a stale-epoch cached answer), and the /metrics
+exposition of the cache + per-device dispatch counters."""
+
+import dataclasses
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from kmlserver_tpu.config import ServingConfig  # noqa: F401 (fixture deps)
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.cache import RecommendCache
+from kmlserver_tpu.serving.metrics import ServingMetrics
+
+from .test_batching import _rule_seeds
+from .test_serving import mined_pvc  # noqa: F401  (fixture re-export)
+
+
+class TestKeyCanonicalization:
+    def test_order_insensitive_within_cap(self):
+        a = RecommendCache.key(3, ["x", "a", "m"], seed_cap=128)
+        b = RecommendCache.key(3, ["m", "x", "a"], seed_cap=128)
+        assert a == b == (3, ("a", "m", "x"))
+
+    def test_duplicates_are_kept(self):
+        # the static fallback's digest distinguishes ["a","a"] from ["a"]
+        assert RecommendCache.key(1, ["a", "a"], 128) != RecommendCache.key(
+            1, ["a"], 128
+        )
+
+    def test_epoch_is_part_of_the_key(self):
+        assert RecommendCache.key(1, ["a"], 128) != RecommendCache.key(
+            2, ["a"], 128
+        )
+
+    def test_oversized_seed_lists_keep_request_order(self):
+        # truncation to the kernel cap is positional: order changes the
+        # answer there, so the key must not canonicalize it away
+        seeds = [f"s{i}" for i in range(5)]
+        a = RecommendCache.key(1, seeds, seed_cap=3)
+        b = RecommendCache.key(1, list(reversed(seeds)), seed_cap=3)
+        assert a != b
+
+
+class TestLruAndCounters:
+    def test_hit_miss_eviction_accounting(self):
+        cache = RecommendCache(max_entries=2)
+        k1, k2, k3 = (1, ("a",)), (1, ("b",)), (1, ("c",))
+        assert cache.get(k1) is None
+        cache.put(k1, (["r1"], "rules"))
+        cache.put(k2, (["r2"], "rules"))
+        assert cache.get(k1) == (["r1"], "rules")
+        cache.put(k3, (["r3"], "rules"))  # evicts k2 (k1 was touched)
+        assert cache.get(k2) is None
+        assert cache.get(k1) is not None
+        assert cache.hits == 2 and cache.misses == 2
+        assert cache.evictions == 1
+        assert len(cache) == 2
+        assert cache.hit_ratio() == pytest.approx(0.5)
+
+    def test_singleflight_collapses_concurrent_identical_misses(self):
+        cache = RecommendCache()
+        key = (1, ("a",))
+        submissions = []
+
+        def submit():
+            fut = Future()
+            submissions.append(fut)
+            return fut
+
+        futures, joins = [], 0
+        for _ in range(5):
+            fut, joined = cache.join_or_lead(key, submit)
+            futures.append(fut)
+            joins += joined
+        assert len(submissions) == 1  # one real dispatch
+        assert joins == 4
+        assert cache.singleflight_joins == 4
+        assert all(f is submissions[0] for f in futures)
+        submissions[0].set_result((["r"], "rules"))
+        cache.finish(key, submissions[0])
+        assert cache.get(key) == (["r"], "rules")
+        # retired: the next miss leads a fresh flight
+        _, joined = cache.join_or_lead(key, submit)
+        assert not joined and len(submissions) == 2
+
+    def test_failed_flight_caches_nothing(self):
+        cache = RecommendCache()
+        key = (1, ("a",))
+        fut = Future()
+        cache.join_or_lead(key, lambda: fut)
+        fut.set_exception(RuntimeError("boom"))
+        cache.finish(key, fut)
+        cache.misses = cache.hits = 0
+        assert cache.get(key) is None
+
+    def test_submit_exception_installs_nothing(self):
+        cache = RecommendCache()
+
+        def submit():
+            raise RuntimeError("shed")
+
+        with pytest.raises(RuntimeError):
+            cache.join_or_lead((1, ("a",)), submit)
+        # the next caller leads, it doesn't join a phantom flight
+        fut = Future()
+        _, joined = cache.join_or_lead((1, ("a",)), lambda: fut)
+        assert not joined
+
+
+class TestAppCaching:
+    def test_hit_serves_identical_response_with_header(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:2]
+        body = json.dumps({"songs": seeds}).encode()
+        s1, h1, p1 = app.handle("POST", "/api/recommend/", body)
+        s2, h2, p2 = app.handle("POST", "/api/recommend/", body)
+        assert s1 == s2 == 200
+        assert p1 == p2
+        assert "X-KMLS-Cache" not in h1  # first answer was computed
+        assert h2.get("X-KMLS-Cache") == "hit"
+        assert app.cache.hits == 1
+        # permuted seeds share the entry (canonical key)
+        _, h3, p3 = app.handle(
+            "POST", "/api/recommend/",
+            json.dumps({"songs": list(reversed(seeds))}).encode(),
+        )
+        assert h3.get("X-KMLS-Cache") == "hit" and p3 == p1
+
+    def test_cache_disabled_by_config(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(dataclasses.replace(cfg, cache_enabled=False))
+        assert app.cache is None
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:1]
+        body = json.dumps({"songs": seeds}).encode()
+        _, h1, p1 = app.handle("POST", "/api/recommend/", body)
+        _, h2, p2 = app.handle("POST", "/api/recommend/", body)
+        assert p1 == p2 and "X-KMLS-Cache" not in h2
+
+    def test_hot_swap_never_serves_stale_epoch_answer(self, mined_pvc):
+        """THE cache-correctness contract: after a bundle hot swap, a
+        cached answer from the old epoch must be unreachable. Proven by
+        poisoning: plant a sentinel under the warm old-epoch key — if any
+        post-swap lookup could still construct that key, the sentinel
+        would surface."""
+        cfg, _, mining_cfg = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:2]
+        body = json.dumps({"songs": seeds}).encode()
+        app.handle("POST", "/api/recommend/", body)  # warm the entry
+        old_epoch = app.engine.bundle_epoch
+        old_key = app._cache_key(seeds)
+        assert old_key[0] == old_epoch
+        app.cache.put(old_key, (["STALE-SENTINEL"], "rules"))
+        # re-mine the same data → token flips → engine hot-swaps
+        run_mining_job(mining_cfg)
+        assert app.engine.is_data_stale()
+        assert app.engine.load()
+        assert app.engine.bundle_epoch == old_epoch + 1
+        status, headers, payload = app.handle(
+            "POST", "/api/recommend/", body
+        )
+        assert status == 200
+        answer = json.loads(payload)
+        assert "STALE-SENTINEL" not in answer["songs"]
+        assert "X-KMLS-Cache" not in headers  # computed fresh, new epoch
+        # and the re-computed answer matches the new engine directly
+        direct, _ = app.engine.recommend(seeds)
+        assert answer["songs"] == direct
+
+    def test_mid_flight_swap_requests_never_see_errors(self, mined_pvc):
+        """Concurrent cached traffic across a hot swap: every response is
+        a 200 and answers always match a live engine oracle (old or new
+        generation — the re-mine produces identical rules, so byte-equal
+        here)."""
+        cfg, _, mining_cfg = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:2]
+        body = json.dumps({"songs": seeds}).encode()
+        expected = json.loads(app.handle("POST", "/api/recommend/", body)[2])
+        errors = []
+        halt = threading.Event()
+
+        def hammer():
+            while not halt.is_set():
+                status, _, payload = app.handle(
+                    "POST", "/api/recommend/", body
+                )
+                got = json.loads(payload)
+                if status != 200 or got["songs"] != expected["songs"]:
+                    errors.append((status, got))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        run_mining_job(mining_cfg)
+        app.engine.load()
+        time.sleep(0.3)
+        halt.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert errors == []
+
+    def test_async_submit_path_singleflights(self, mined_pvc):
+        """The asyncio front end's entry point: concurrent identical
+        misses on the loop share ONE batcher future; hits answer
+        immediately."""
+        import asyncio
+
+        from kmlserver_tpu.serving.batcher import AsyncMicroBatcher
+
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg, defer_batcher=True)
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:2]
+        body = json.dumps({"songs": seeds}).encode()
+
+        async def scenario():
+            app.batcher = AsyncMicroBatcher(app.engine, max_size=8)
+            r1, f1, t1 = app.submit_recommend(body)
+            r2, f2, t2 = app.submit_recommend(body)
+            assert r1 is None and r2 is None
+            assert f1 is f2  # singleflight: same underlying future
+            await f1
+            resp1 = app.finish_recommend(f1, t1)
+            resp2 = app.finish_recommend(f2, t2)
+            assert resp1[0] == resp2[0] == 200
+            assert resp1[2] == resp2[2]
+            # let the loop run the leader's done-callback (cache.finish is
+            # loop-scheduled; awaiting an already-done future doesn't yield)
+            for _ in range(3):
+                await asyncio.sleep(0)
+            # now cached: immediate response, marked
+            r3, f3, _ = app.submit_recommend(body)
+            assert f3 is None and r3[0] == 200
+            assert r3[1].get("X-KMLS-Cache") == "hit"
+            assert r3[2] == resp1[2]
+
+        asyncio.run(scenario())
+        assert app.cache.singleflight_joins == 1
+        assert app.cache.hits == 1
+
+
+class TestMetricsExposition:
+    def test_cache_and_dispatch_lines_rendered(self):
+        m = ServingMetrics()
+        cache = RecommendCache(max_entries=8)
+        cache.put((1, ("a",)), (["r"], "rules"))
+        cache.get((1, ("a",)))
+        cache.get((1, ("b",)))
+        text = m.render(
+            reload_counter=1, finished_loading=True,
+            cache=cache, dispatch_counts=[5, 0, 3],
+        )
+        assert "kmls_cache_hits_total 1" in text
+        assert "kmls_cache_misses_total 1" in text
+        assert "kmls_cache_entries 1" in text
+        assert "kmls_cache_hit_ratio 0.5000" in text
+        assert 'kmls_device_dispatch_total{device="0"} 5' in text
+        assert 'kmls_device_dispatch_total{device="2"} 3' in text
+
+    def test_render_without_cache_is_unchanged(self):
+        m = ServingMetrics()
+        text = m.render(reload_counter=0, finished_loading=False)
+        assert "kmls_cache_" not in text
+        assert "kmls_device_dispatch_total" not in text
+
+    def test_app_metrics_route_carries_cache_and_dispatch(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:1]
+        body = json.dumps({"songs": seeds}).encode()
+        app.handle("POST", "/api/recommend/", body)
+        app.handle("POST", "/api/recommend/", body)
+        text = app.handle("GET", "/metrics", None)[2].decode()
+        assert "kmls_cache_hits_total 1" in text
+        assert "kmls_cache_hit_ratio" in text
+        assert 'kmls_device_dispatch_total{device="0"}' in text

@@ -3,6 +3,7 @@ percentiles, mixed known/unknown seed sampling, and an end-to-end replay
 against a real engine + micro-batcher on a tmpdir PVC."""
 
 import numpy as np
+import pytest
 
 from kmlserver_tpu.config import MiningConfig, ServingConfig
 from kmlserver_tpu.mining.pipeline import run_mining_job
@@ -36,6 +37,51 @@ def test_replay_reports_latency_and_sources():
     assert report.p50_ms <= report.p95_ms <= report.p99_ms
     assert 0 < report.achieved_qps
     assert '"p50_ms"' in report.to_json()
+
+
+def test_sample_seed_sets_zipf_mix_is_skewed_and_deterministic():
+    vocab = [f"t{i}" for i in range(200)]
+    payloads = sample_seed_sets(vocab, 5000, rng_seed=4, zipf_s=1.1)
+    assert len(payloads) == 5000
+    distinct = {tuple(p) for p in payloads}
+    # a 512-entry pool, heavily repeated — the shape a cache feeds on
+    assert len(distinct) <= 512
+    counts = sorted(
+        (sum(1 for p in payloads if tuple(p) == d) for d in distinct),
+        reverse=True,
+    )
+    # Zipf head: the hot payload dwarfs the median one
+    assert counts[0] > 20 * counts[len(counts) // 2]
+    assert payloads == sample_seed_sets(vocab, 5000, rng_seed=4, zipf_s=1.1)
+
+
+def test_zipf_off_preserves_legacy_mix_exactly():
+    # default off must reproduce the pre-Zipf sampler bit for bit — the
+    # bench's 1k-replay comparability depends on it
+    vocab = [f"t{i}" for i in range(50)]
+    legacy = sample_seed_sets(vocab, 300, rng_seed=9)
+    assert legacy == sample_seed_sets(vocab, 300, rng_seed=9, zipf_s=0.0)
+    assert len({tuple(p) for p in legacy}) > 250  # mostly distinct
+
+
+def test_replay_splits_cached_latency_when_send_reports_it():
+    def send(seeds):
+        return ("rules", seeds[0] == "hot")
+
+    payloads = ([["hot"]] * 60) + ([["cold"]] * 40)
+    report = replay(send, payloads, qps=2000.0)
+    assert report.n_errors == 0
+    assert report.cache_hit_ratio == 0.6
+    assert report.cached_p50_ms is not None
+    assert report.uncached_p50_ms is not None
+    parsed = __import__("json").loads(report.to_json())
+    assert parsed["cache_hit_ratio"] == 0.6
+
+
+def test_replay_legacy_send_reports_no_cache_split():
+    report = replay(lambda seeds: "rules", [["a"]] * 20, qps=1000.0)
+    assert report.cache_hit_ratio is None
+    assert report.cached_p50_ms is None
 
 
 def test_replay_counts_failures_as_errors():
@@ -82,3 +128,48 @@ def test_replay_end_to_end_against_engine(tmp_path):
     # known-seed requests should hit the rules path
     assert report.by_source.get("rules", 0) > 0
     assert np.isfinite(report.p99_ms)
+
+
+def test_zipf_replay_through_cached_app_reports_hit_ratio(tmp_path):
+    """The 10k-phase mechanics at test scale: a Zipf mix through the app's
+    cache → batcher → engine path must exceed a 50% hit ratio and report
+    cached latency separately (and faster at the p50)."""
+    rng = np.random.default_rng(12)
+    baskets = random_baskets(rng, n_playlists=60, n_tracks=30, mean_len=8)
+    from kmlserver_tpu.data.csv import write_tracks_csv
+
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    write_tracks_csv(
+        str(ds_dir / "2023_spotify_ds1.csv"), table_from_baskets(baskets)
+    )
+    run_mining_job(MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.05,
+        k_max_consequents=16,
+    ))
+    from kmlserver_tpu.serving.app import RecommendApp
+
+    app = RecommendApp(ServingConfig(
+        base_dir=str(tmp_path), polling_wait_in_minutes=60.0,
+    ))
+    assert app.engine.load()
+    assert app.cache is not None
+
+    def send(seeds):
+        _, source, cached = app.recommend_direct(seeds)
+        return source, cached
+
+    payloads = sample_seed_sets(
+        app.engine.bundle.vocab, 1500, rng_seed=5, zipf_s=1.1,
+        zipf_pool=128,
+    )
+    report = replay(send, payloads, qps=1500.0)
+    assert report.n_errors == 0
+    assert report.cache_hit_ratio is not None
+    assert report.cache_hit_ratio > 0.5
+    assert report.cached_p50_ms is not None
+    assert report.uncached_p50_ms is not None
+    assert report.cached_p50_ms <= report.uncached_p50_ms
+    assert report.cache_hit_ratio == pytest.approx(
+        app.cache.hit_ratio(), abs=0.05
+    )

@@ -306,20 +306,19 @@ class TestEngine:
         batcher = MicroBatcher(
             SlowLinkEngine(), max_size=256, window_ms=2.0, max_inflight=8
         )
+        # open-loop arrival via the non-blocking submit(): 300 spawned
+        # client threads used to carry the load here, but on a loaded
+        # 2-core host thread spawn is slow enough (~1 ms each) that the
+        # queue never out-filled the blocked dispatches — the test
+        # flaked on its own harness, not on the batcher. The property
+        # under test (a blocked dispatch grows the NEXT batch) only
+        # needs requests IN THE QUEUE while a dispatch blocks.
         n = 300
-        results: dict[int, tuple] = {}
-
-        def worker(i):
-            results[i] = batcher.recommend([f"s{i}"])
-
-        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        futures = [batcher.submit([f"s{i}"]) for i in range(n)]
+        results = [f.result(timeout=60.0) for f in futures]
         # pairing survives the self-sized batches
         assert len(results) == n
-        for i, (got, _) in results.items():
+        for i, (got, _) in enumerate(results):
             assert got == [f"s{i}"]
         # growth is the load-bearing assertion (wall-clock bounds flake on
         # loaded CI hosts): batches must grow well past the un-self-sized
