@@ -128,6 +128,11 @@ class MiningConfig:
     prune_vocab_threshold: int = 512
     # Write the tensor-native artifact (rules npz) alongside the pickles.
     write_tensor_artifact: bool = True
+    # Write the integrity manifest (artifacts.manifest.json: size + sha256
+    # per artifact) after each artifact set — the serving engine validates
+    # against it before publishing a bundle, so a torn/corrupt artifact is
+    # caught before it can poison a reload.
+    write_manifest: bool = True
     # On a CPU backend (no TPU reachable), count pair supports with the
     # native bit-packed POPCNT kernel (native/kmls_popcount.cpp) instead of
     # XLA:CPU's int8 matmul — exact, ~40x faster on the dominant phase.
@@ -169,6 +174,7 @@ class MiningConfig:
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
             prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 512),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
+            write_manifest=_getenv_bool("KMLS_WRITE_MANIFEST", True),
             native_cpu_pair_counts=_getenv_bool("KMLS_NATIVE_PAIR_COUNTS", True),
         )
 
@@ -245,6 +251,50 @@ class ServingConfig:
     # can't build. KMLS_NATIVE=0 also kills it.
     native_serve: bool = True
 
+    # --- robustness knobs (fault-tolerance layer) ---
+    # Validate artifacts against the mining job's integrity manifest
+    # (artifacts.manifest.json) before publishing a bundle; a mismatched
+    # best/recommendations pickle aborts the reload (last-good keeps
+    # serving), a mismatched npz falls back to the pickle. No manifest on
+    # the PVC (older miner, or the reference's) = no validation.
+    verify_manifest: bool = True
+    # Move an artifact that keeps failing to load/verify into
+    # pickles/quarantine/ after this many CONSECUTIVE failed reloads (a
+    # single mid-update mismatch resolves itself next poll and must not
+    # cost a good file). 0 disables quarantining.
+    quarantine_after_failures: int = 2
+    # Exponential backoff between FAILED reload attempts (corrupt
+    # artifacts, not merely-missing ones): base doubles per consecutive
+    # failure up to max. Keeps a poison artifact from turning the poller
+    # into a checksum-hashing busy loop; the invalidation token is never
+    # consumed, so the retry ladder always ends in a reload of whatever
+    # the miner writes next.
+    reload_backoff_base_s: float = 0.5
+    reload_backoff_max_s: float = 30.0
+    # Per-replica consecutive-failure circuit breaker in the batchers:
+    # after this many consecutive batch failures a replica is EJECTED from
+    # the least-loaded dispatcher (its in-flight requests re-dispatch to
+    # healthy replicas) and probed for re-admission every
+    # replica_probe_interval_s. 0 disables ejection.
+    replica_eject_threshold: int = 3
+    replica_probe_interval_s: float = 5.0
+    # Bounded re-dispatch: how many times one request may be re-queued
+    # after a batch failure before the failure propagates (and the HTTP
+    # layer degrades it). Keep >= replica_eject_threshold: a sick replica
+    # fails at most eject_threshold batches before the breaker takes it
+    # out, so a request that can retry that many times is GUARANTEED to
+    # outlive any single-replica failure burst.
+    redispatch_max_retries: int = 3
+    # Per-request deadline budget (milliseconds), propagated cache →
+    # batcher → device: on exhaustion the request degrades to the
+    # popularity-fallback answer with an X-KMLS-Degraded header instead
+    # of queueing forever or 500ing. 0 disables deadlines.
+    request_deadline_ms: float = 0.0
+    # Latency budget for the degraded popularity-fallback answer itself:
+    # past the request deadline the sampler is skipped for a head slice
+    # of the popularity ranking (cheapest possible answer).
+    fallback_budget_ms: float = 50.0
+
     @property
     def pickles_dir(self) -> str:
         return os.path.join(self.base_dir, self.pickle_dir)
@@ -278,4 +328,17 @@ class ServingConfig:
             cache_max_entries=_getenv_int("KMLS_CACHE_MAX_ENTRIES", 8192),
             prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),
             native_serve=_getenv_bool("KMLS_NATIVE_SERVE", True),
+            verify_manifest=_getenv_bool("KMLS_VERIFY_MANIFEST", True),
+            quarantine_after_failures=_getenv_int(
+                "KMLS_QUARANTINE_AFTER_FAILURES", 2
+            ),
+            reload_backoff_base_s=_getenv_float("KMLS_RELOAD_BACKOFF_BASE_S", 0.5),
+            reload_backoff_max_s=_getenv_float("KMLS_RELOAD_BACKOFF_MAX_S", 30.0),
+            replica_eject_threshold=_getenv_int("KMLS_REPLICA_EJECT_THRESHOLD", 3),
+            replica_probe_interval_s=_getenv_float(
+                "KMLS_REPLICA_PROBE_INTERVAL_S", 5.0
+            ),
+            redispatch_max_retries=_getenv_int("KMLS_REDISPATCH_MAX_RETRIES", 3),
+            request_deadline_ms=_getenv_float("KMLS_REQUEST_DEADLINE_MS", 0.0),
+            fallback_budget_ms=_getenv_float("KMLS_FALLBACK_BUDGET_MS", 50.0),
         )

@@ -1,0 +1,200 @@
+"""Deterministic fault injection — the chaos harness every recovery path
+is tested through.
+
+Production recommendation stacks treat fault tolerance as a first-class
+serving feature, which means every recovery path needs a way to FIRE
+deterministically in a test: a corrupt artifact at reload time, a sick
+replica's kernel, a device running slow past the request deadline. This
+module is that switchboard. Serving code calls :func:`fire` at named
+sites; nothing happens unless a fault has been armed for that site —
+the disarmed check is one module-global read, so the hooks cost nothing
+on the hot path.
+
+Sites currently wired:
+
+- ``"engine.load"`` — fired inside :meth:`RecommendEngine.load`'s
+  artifact-build block, BEFORE publication: a fail fault makes the whole
+  reload fail exactly like a torn artifact would (the engine must keep
+  the last-good bundle and must NOT consume the invalidation token).
+- ``"replica.kernel"`` (keyed by replica index) — fired inside the
+  ``finish()`` closure of :meth:`RecommendEngine.recommend_many_async`,
+  i.e. on the completion path where a real device failure or stall
+  surfaces: a fail fault raises (exercising the batcher's circuit
+  breaker + re-dispatch), a delay fault sleeps (exercising the
+  deadline-budgeted degradation path).
+
+Arming, two ways:
+
+- programmatic (tests): ``faults.inject("replica.kernel", replica=1,
+  times=3)`` / ``faults.inject("replica.kernel", replica=0,
+  delay_s=0.2, times=-1)``; ``faults.clear()`` in teardown.
+- env knobs (containers, bench, CI chaos job), parsed once at first
+  fire (or explicitly via :func:`load_env`):
+
+  - ``KMLS_FAULT_RELOAD_FAIL=N`` — fail the next N engine reloads;
+  - ``KMLS_FAULT_REPLICA_FAIL=idx[:N]`` — replica ``idx``'s kernel
+    raises on its next N completions (default 1; ``-1`` = forever);
+  - ``KMLS_FAULT_REPLICA_DELAY_MS=idx:ms[:N]`` — replica ``idx``'s
+    kernel sleeps ``ms`` per completion (default every completion).
+
+File corruption is a separate concern (faults happen to BYTES, not call
+sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
+chaos suite and the bench use to produce torn/corrupt artifacts on a
+real filesystem, so the integrity/quarantine machinery is tested against
+what an interrupted writer actually leaves behind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+# fast-path gate: fire() returns immediately while nothing is armed.
+# Benign race: a stale False read can only skip a fault armed
+# concurrently with the dispatch it would have hit — tests arm faults
+# before driving traffic.
+_armed = False
+_env_loaded = False
+_lock = threading.Lock()
+
+
+class FaultInjected(RuntimeError):
+    """Raised by :func:`fire` when a fail fault triggers."""
+
+
+@dataclasses.dataclass
+class _Fault:
+    remaining: int  # -1 = unlimited
+    delay_s: float = 0.0
+    fired: int = 0
+
+
+# (site, replica-or-None) -> _Fault; a replica-keyed lookup falls back to
+# the site-wide (replica=None) entry
+_faults: dict[tuple[str, int | None], _Fault] = {}
+
+
+def inject(
+    site: str,
+    *,
+    replica: int | None = None,
+    times: int = 1,
+    delay_s: float = 0.0,
+) -> None:
+    """Arm a fault at ``site``: ``delay_s > 0`` sleeps per fire (a slow
+    kernel), otherwise the fire raises :class:`FaultInjected` (a failing
+    kernel / reload). ``times=-1`` keeps firing until :func:`clear`."""
+    global _armed
+    with _lock:
+        _faults[(site, replica)] = _Fault(remaining=times, delay_s=delay_s)
+        _armed = True
+
+
+def clear() -> None:
+    """Disarm everything (test teardown). Also forgets the env parse so a
+    later :func:`load_env` re-reads the knobs."""
+    global _armed, _env_loaded
+    with _lock:
+        _faults.clear()
+        _armed = False
+        _env_loaded = False
+
+
+def active() -> dict[tuple[str, int | None], int]:
+    """Snapshot of armed faults → remaining counts (diagnostics)."""
+    with _lock:
+        return {k: f.remaining for k, f in _faults.items()}
+
+
+def fired_counts() -> dict[tuple[str, int | None], int]:
+    with _lock:
+        return {k: f.fired for k, f in _faults.items()}
+
+
+def fire(site: str, replica: int | None = None) -> None:
+    """Trigger point, called from serving code. No-op unless a fault is
+    armed for ``(site, replica)`` or ``(site, None)``. Delay faults
+    sleep; fail faults raise :class:`FaultInjected`."""
+    if not _armed and _env_loaded:
+        return
+    _ensure_env()
+    if not _armed:
+        return
+    with _lock:
+        fault = _faults.get((site, replica)) or _faults.get((site, None))
+        if fault is None or fault.remaining == 0:
+            return
+        if fault.remaining > 0:
+            fault.remaining -= 1
+        fault.fired += 1
+        delay = fault.delay_s
+    if delay > 0:
+        time.sleep(delay)
+        return
+    raise FaultInjected(f"injected fault at {site}"
+                        + (f" (replica {replica})" if replica is not None else ""))
+
+
+def load_env(force: bool = False) -> None:
+    """Parse the ``KMLS_FAULT_*`` env knobs into armed faults. Runs once
+    per process (lazily, at the first :func:`fire`); ``force=True``
+    re-reads after an env change."""
+    global _env_loaded
+    with _lock:
+        if _env_loaded and not force:
+            return
+        _env_loaded = True
+    raw = os.getenv("KMLS_FAULT_RELOAD_FAIL")
+    if raw:
+        inject("engine.load", times=int(raw))
+    raw = os.getenv("KMLS_FAULT_REPLICA_FAIL")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "replica.kernel", replica=int(parts[0]),
+            times=int(parts[1]) if len(parts) > 1 else 1,
+        )
+    raw = os.getenv("KMLS_FAULT_REPLICA_DELAY_MS")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "replica.kernel", replica=int(parts[0]),
+            delay_s=float(parts[1]) / 1e3,
+            times=int(parts[2]) if len(parts) > 2 else -1,
+        )
+
+
+def _ensure_env() -> None:
+    if not _env_loaded:
+        load_env()
+
+
+# ---------- artifact corruption helpers (bytes, not call sites) ----------
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Tear ``path`` the way an interrupted writer does: keep the leading
+    ``keep_fraction`` of its bytes, drop the rest. → bytes kept."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_fraction))
+    with open(path, "rb+") as fh:
+        fh.truncate(keep)
+    return keep
+
+
+def flip_byte(path: str, offset: int | None = None) -> int:
+    """Flip one byte in place (silent bit-rot / bad sector). ``offset``
+    defaults to the middle of the file. → the offset flipped."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty; nothing to corrupt")
+    if offset is None:
+        offset = size // 2
+    with open(path, "rb+") as fh:
+        fh.seek(offset)
+        byte = fh.read(1)
+        fh.seek(offset)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    return offset

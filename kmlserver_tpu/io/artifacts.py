@@ -8,23 +8,46 @@ reference could interoperate with this rebuild, and adds:
 
 - **atomic writes** (tmp file + ``os.replace``) — the reference rewrites
   artifacts in place, racing readers (acknowledged in its report); atomic
-  rename removes the torn-read window without changing the protocol;
+  rename removes the torn-read window without changing the protocol.
+  ``KMLS_REFERENCE_RACE_COMPAT=1`` restores the reference's in-place
+  ``pickle.dump`` for operators who need byte-compatible write behavior
+  (the race included) — see ROADMAP's artifact-pipeline item;
 - a **tensor-native artifact** (``.npz`` of the padded rule tensors) written
   alongside the pickle, so the serving engine can ``jax.device_put`` rule
-  tensors straight into HBM without re-deriving them from the dict.
+  tensors straight into HBM without re-deriving them from the dict;
+- an **integrity manifest** (``artifacts.manifest.json``, sizes + sha256
+  per artifact) written after each artifact set, validated by the engine
+  before a bundle publishes — a corrupt/torn artifact is detected BEFORE
+  it can poison a reload, and the last-good bundle keeps serving.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
+import json
 import os
 import pickle
 import tempfile
+import time
 from typing import Any
 
 import numpy as np
 
 TENSOR_ARTIFACT_SUFFIX = ".tensors.npz"
+MANIFEST_FILENAME = "artifacts.manifest.json"
+QUARANTINE_DIRNAME = "quarantine"
+
+
+class ArtifactIntegrityError(RuntimeError):
+    """An artifact's bytes disagree with the manifest that shipped it.
+
+    ``paths`` lists the offending files, so the engine can quarantine the
+    right bytes instead of guessing."""
+
+    def __init__(self, message: str, paths: list[str]):
+        super().__init__(message)
+        self.paths = paths
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
@@ -46,14 +69,31 @@ def _atomic_write_bytes(path: str, data: bytes) -> None:
         raise
 
 
+def _reference_race_compat() -> bool:
+    """``KMLS_REFERENCE_RACE_COMPAT=1`` restores the reference's in-place
+    pickle writes — byte-compatible with machine-learning/main.py:136-145
+    INCLUDING its acknowledged torn-read race. Read at call time (not
+    import) so a test or an operator can flip it without re-importing."""
+    from ..config import _getenv_bool
+
+    return _getenv_bool("KMLS_REFERENCE_RACE_COMPAT", False)
+
+
 def save_pickle(obj: Any, path: str) -> None:
     """Pickle ``obj`` to ``path`` atomically.
 
     Same role as the reference's ``save_pickle`` (machine-learning/main.py:136-145),
     which mkdirs the folder and ``pickle.dump``s in place; here the folder is
-    created and the write is atomic.
+    created and the write is atomic — unless KMLS_REFERENCE_RACE_COMPAT
+    opts back into the reference's in-place behavior.
     """
-    _atomic_write_bytes(path, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if _reference_race_compat():
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        return
+    _atomic_write_bytes(path, data)
 
 
 def load_pickle(path: str) -> Any:
@@ -73,6 +113,132 @@ def read_text(path: str) -> str:
 def tensor_artifact_path(recommendations_pickle_path: str) -> str:
     """Path of the npz rule-tensor artifact shadowing a recommendations pickle."""
     return recommendations_pickle_path + TENSOR_ARTIFACT_SUFFIX
+
+
+# ---------- integrity manifest + quarantine ----------
+
+
+def manifest_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, MANIFEST_FILENAME)
+
+
+def file_digest(path: str) -> dict[str, Any]:
+    """→ ``{"bytes": n, "sha256": hex}`` (streamed; artifacts can be GBs)."""
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+            n += len(chunk)
+    return {"bytes": n, "sha256": h.hexdigest()}
+
+
+def write_manifest(
+    pickles_dir: str, filenames: list[str], token: str | None = None
+) -> str:
+    """Write the integrity sidecar for an artifact set: size + sha256 per
+    file, atomically, AFTER the artifacts themselves (the mining job calls
+    this right before the invalidation-token rewrite, so any reader that
+    sees the new token also sees a manifest matching the new bytes; a
+    reader racing mid-update sees a mismatch, keeps its last-good bundle,
+    and retries next poll — fail-soft, eventually consistent).
+
+    ``token`` stamps the GENERATION this manifest describes (the
+    invalidation-token value the miner is about to publish). Readers pass
+    the current token to :func:`verify_files`, which validates only when
+    the generations match — so a manifest left behind by this miner can
+    never condemn fresh artifacts written by a manifest-less writer (the
+    reference's job, or KMLS_WRITE_MANIFEST=0): that writer rewrites the
+    token, the stale manifest stops matching, and validation steps aside
+    instead of quarantining good bytes.
+
+    Files that don't exist are skipped (e.g. the npz with
+    KMLS_WRITE_TENSOR_ARTIFACT off). → the manifest path."""
+    files: dict[str, Any] = {}
+    for name in filenames:
+        path = os.path.join(pickles_dir, name)
+        if os.path.exists(path):
+            files[name] = file_digest(path)
+    out = manifest_path(pickles_dir)
+    _atomic_write_bytes(
+        out,
+        json.dumps(
+            {
+                "version": 1, "written_at": time.time(),
+                "token": token, "files": files,
+            },
+            indent=1, sort_keys=True,
+        ).encode("utf-8"),
+    )
+    return out
+
+
+def load_manifest(pickles_dir: str) -> dict[str, Any] | None:
+    """The parsed manifest, or None when absent/unreadable — a PVC written
+    by an older miner (or the reference) has no manifest, and integrity
+    checking must degrade to the pre-manifest behavior there, not block."""
+    path = manifest_path(pickles_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data.get("files"), dict) else None
+
+
+def verify_files(
+    pickles_dir: str, filenames: list[str], token: str | None = None
+) -> list[str]:
+    """Check ``filenames`` (relative to ``pickles_dir``) against the
+    manifest → the list of paths whose on-disk bytes MISMATCH it (size or
+    sha256). Files absent from the manifest, or missing on disk, are not
+    mismatches (missing-on-disk surfaces as FileNotFoundError at load
+    time, which the engine already treats as not-ready).
+
+    ``token`` (the current invalidation-token value) gates validation to
+    the manifest's own generation: a manifest stamped for a DIFFERENT
+    token is stale — some other writer has published since — and
+    validating fresh bytes against it would condemn good artifacts, so
+    it is skipped entirely. ``token=None`` validates unconditionally
+    (tests, offline checks)."""
+    manifest = load_manifest(pickles_dir)
+    if manifest is None:
+        return []
+    if token is not None and manifest.get("token") != token:
+        return []
+    bad: list[str] = []
+    for name in filenames:
+        entry = manifest["files"].get(name)
+        path = os.path.join(pickles_dir, name)
+        if entry is None or not os.path.exists(path):
+            continue
+        if os.path.getsize(path) != entry.get("bytes"):
+            bad.append(path)
+            continue
+        if file_digest(path)["sha256"] != entry.get("sha256"):
+            bad.append(path)
+    return bad
+
+
+def quarantine_file(path: str) -> str | None:
+    """Move a corrupt artifact aside (``<pickles_dir>/quarantine/<name>.
+    <epoch>``) so the next mining run writes fresh bytes and the bad ones
+    stay inspectable. Never raises — a read-only volume must not turn a
+    fail-soft reload into a crash. → the quarantine path, or None."""
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        qdir = os.path.join(directory, QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(
+            qdir, f"{os.path.basename(path)}.{int(time.time())}"
+        )
+        os.replace(path, dest)
+        return dest
+    except OSError:
+        return None
 
 
 def save_rule_tensors(

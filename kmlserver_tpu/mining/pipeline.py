@@ -19,7 +19,7 @@ import jax
 from ..config import BASE_INDEX, MiningConfig
 from ..data.csv import read_tracks
 from ..io import artifacts, registry
-from ..utils.timeutil import get_current_time_str
+from ..utils.timeutil import get_current_time_str, get_current_time_str_precise
 from . import vocab as vocab_mod
 from .miner import MiningResult, mine
 
@@ -122,6 +122,10 @@ def run_mining_job(
     rules_dict = tensors.to_rules_dict(result.vocab_names)
     token = ""
     if is_writer:
+        # the token value is generated BEFORE the manifest so the manifest
+        # can be stamped with the generation it describes — readers
+        # validate only when the published token matches the stamp
+        token_value = get_current_time_str_precise()
         paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
         artifacts.save_pickle(rules_dict, paths["recommendations"])
         if cfg.write_tensor_artifact:
@@ -140,7 +144,30 @@ def run_mining_job(
                 min_confidence=tensors.min_confidence,
                 rule_confs64=tensors.rule_confs64,
             )
-        token = registry.append_history_and_invalidate(cfg, run_index, selected)
+        if cfg.write_manifest:
+            # integrity sidecar AFTER the artifact set, BEFORE the token:
+            # any reader that sees the new token sees a manifest matching
+            # the new bytes; a reader racing mid-update detects the
+            # mismatch and keeps serving its last-good bundle (engine.load
+            # validates before publishing). Stamped with the token value
+            # about to publish, so a LATER manifest-less writer (the
+            # reference job) retires this manifest just by rewriting the
+            # token — its fresh artifacts are never judged by stale sums.
+            paths["manifest"] = artifacts.write_manifest(
+                cfg.pickles_dir,
+                [
+                    cfg.best_tracks_file,
+                    cfg.recommendations_file,
+                    cfg.recommendations_file + artifacts.TENSOR_ARTIFACT_SUFFIX,
+                    cfg.artists_mapping_file,
+                    cfg.track_info_file,
+                    cfg.repeated_tracks_file,
+                ],
+                token=token_value,
+            )
+        token = registry.append_history_and_invalidate(
+            cfg, run_index, selected, timestamp=token_value
+        )
     print(f"Job finished at {get_current_time_str()}")
 
     return JobSummary(

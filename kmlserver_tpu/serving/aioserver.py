@@ -358,6 +358,9 @@ async def run_async(app: RecommendApp, port: int, ready=None) -> int:
             window_min_ms=cfg.batch_window_min_ms,
             shed_queue_budget_ms=cfg.shed_queue_budget_ms,
             shed_retry_after_s=cfg.shed_retry_after_s,
+            eject_threshold=cfg.replica_eject_threshold,
+            probe_interval_s=cfg.replica_probe_interval_s,
+            redispatch_max=cfg.redispatch_max_retries,
             metrics=app.metrics,
         )
     state = _ServerState(app)

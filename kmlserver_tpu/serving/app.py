@@ -29,10 +29,11 @@ import os
 import random
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config import ServingConfig
-from .batcher import Overloaded
+from .batcher import DeadlineExceeded, NoHealthyReplicas, Overloaded
 from .cache import RecommendCache
 from .engine import RecommendEngine
 from .metrics import ServingMetrics
@@ -103,6 +104,9 @@ class RecommendApp:
                 window_min_ms=cfg.batch_window_min_ms,
                 shed_queue_budget_ms=cfg.shed_queue_budget_ms,
                 shed_retry_after_s=cfg.shed_retry_after_s,
+                eject_threshold=cfg.replica_eject_threshold,
+                probe_interval_s=cfg.replica_probe_interval_s,
+                redispatch_max=cfg.redispatch_max_retries,
                 metrics=self.metrics,
             )
         # template/static roots honor APP_PATH_FROM_ROOT like the reference
@@ -163,6 +167,18 @@ class RecommendApp:
                 return _json_response(200, {"status": "alive"})
             if path == "/readyz":
                 if self.engine.finished_loading:
+                    # degraded = ready-but-flagged (HTTP 200): the pod
+                    # keeps taking traffic — it still answers every
+                    # request, some from the last-good bundle or the
+                    # fallback — so a bad artifact on the shared PVC can
+                    # never readiness-fail ALL replicas at once. A 503
+                    # here would restart-loop the whole fleet over data
+                    # no restart can fix.
+                    reasons = self.degraded_reasons()
+                    if reasons:
+                        return _json_response(
+                            200, {"status": "degraded", "reasons": reasons}
+                        )
                     return _json_response(200, {"status": "ready"})
                 return _json_response(
                     503, {"status": "awaiting first artifacts"}
@@ -174,11 +190,32 @@ class RecommendApp:
                     dispatch_counts=getattr(
                         self.engine, "dispatch_counts", None
                     ),
+                    robustness=self._robustness_state(),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
                 return self._get_static(path[len("/static/"):])
         return _json_response(404, {"detail": "Not Found"})
+
+    def _robustness_state(self) -> dict:
+        """Engine/batcher recovery-state snapshot for /metrics (names
+        ending in _total render as counters, the rest as gauges)."""
+        state = {
+            "artifact_quarantines_total": getattr(
+                self.engine, "artifact_quarantines", 0
+            ),
+            "reload_failures_total": getattr(
+                self.engine, "reload_failures", 0
+            ),
+            "reload_consecutive_failures": getattr(
+                self.engine, "consecutive_reload_failures", 0
+            ),
+        }
+        ejected_fn = getattr(self.batcher, "ejected_replicas", None)
+        state["replicas_ejected"] = (
+            len(ejected_fn()) if callable(ejected_fn) else 0
+        )
+        return state
 
     _STATIC_TYPES = {
         ".css": "text/css; charset=utf-8",
@@ -234,6 +271,68 @@ class RecommendApp:
             return _json_response(400, {"detail": "Request with no songs"}), None
         return None, songs
 
+    # ---------- degradation (the fault-tolerance contract) ----------
+
+    def _deadline_for(self, t0: float) -> float | None:
+        """Per-request perf_counter deadline from the configured budget
+        (KMLS_REQUEST_DEADLINE_MS), propagated cache → batcher → device.
+        None = deadlines off."""
+        budget_ms = self.cfg.request_deadline_ms
+        return t0 + budget_ms / 1e3 if budget_ms > 0 else None
+
+    @staticmethod
+    def _degrade_reason(exc: Exception) -> str | None:
+        """Exceptions that degrade to a fallback answer instead of an
+        error status: deadline exhaustion and total replica loss."""
+        if isinstance(exc, DeadlineExceeded):
+            return "deadline"
+        if isinstance(exc, NoHealthyReplicas):
+            return "replica-loss"
+        return None
+
+    def _degraded_response(
+        self, t0: float, songs: list[str], reason: str
+    ) -> Response:
+        """200 with the latency-budgeted popularity fallback and an
+        ``X-KMLS-Degraded: <reason>`` header — the degradation contract:
+        a slow device or a dead replica set costs answer QUALITY, never a
+        5xx. The fallback itself runs under the tighter of the request
+        deadline and its own budget (KMLS_FALLBACK_BUDGET_MS), so the
+        degraded path can't compound the overrun."""
+        budget = time.perf_counter() + self.cfg.fallback_budget_ms / 1e3
+        deadline = self._deadline_for(t0)
+        deadline = budget if deadline is None else min(deadline, budget)
+        recs = self.engine.static_recommendation(songs, deadline=deadline)
+        self.metrics.record_degraded(reason)
+        self.metrics.record("fallback", time.perf_counter() - t0)
+        status, headers, payload = _json_response(
+            200,
+            {
+                "songs": recs,
+                "model_date": self.engine.cache_value,
+                "version": self.cfg.version,
+            },
+        )
+        headers["X-KMLS-Degraded"] = reason
+        return status, headers, payload
+
+    def degraded_reasons(self) -> list[str]:
+        """Why /readyz says "degraded" (empty = fully healthy): reloads
+        failing while the last-good bundle keeps serving, and/or replicas
+        currently ejected by the batcher's circuit breaker."""
+        reasons: list[str] = []
+        consec = getattr(self.engine, "consecutive_reload_failures", 0)
+        if consec > 0:
+            reasons.append(
+                f"reload failing x{consec} (serving last-good bundle)"
+            )
+        ejected_fn = getattr(self.batcher, "ejected_replicas", None)
+        if callable(ejected_fn):
+            ejected = ejected_fn()
+            if ejected:
+                reasons.append(f"replicas ejected: {ejected}")
+        return reasons
+
     def _recommend_error_response(self, exc: Exception) -> Response:
         if isinstance(exc, Overloaded):
             # visible backpressure, not an error: the queue projection says
@@ -273,15 +372,17 @@ class RecommendApp:
             self.engine.bundle_epoch, songs, self.cfg.max_seed_tracks
         )
 
-    def _cache_lookup_or_lead(self, songs: list[str]):
+    def _cache_lookup_or_lead(self, songs: list[str], deadline: float | None = None):
         """The ONE copy of the cache front half, shared by both
         transports → ``("hit", (songs, source))`` | ``("flight",
         future)`` | ``("off", None)``. A miss joins the in-flight
         singleflight future for this key or leads a new batcher
         submission (the leader's done-callback stores the answer);
-        raises what ``batcher.submit`` raises (Overloaded included).
-        "off" covers: cache disabled, no batcher, or a batcher without
-        ``submit`` (test doubles) — callers compute inline there."""
+        raises what ``batcher.submit`` raises (Overloaded and
+        NoHealthyReplicas included). ``deadline`` rides into the batcher
+        only when set — test doubles keep their bare ``submit(seeds)``
+        signature. "off" covers: cache disabled, no batcher, or a batcher
+        without ``submit`` (test doubles) — callers compute inline there."""
         if (
             self.cache is None
             or self.batcher is None
@@ -292,12 +393,21 @@ class RecommendApp:
         hit = self.cache.get(key)
         if hit is not None:
             return "hit", hit
-        future, joined = self.cache.join_or_lead(
-            key, lambda: self.batcher.submit(songs)
-        )
+        if deadline is not None:
+            lead = lambda: self.batcher.submit(songs, deadline=deadline)  # noqa: E731
+        else:
+            lead = lambda: self.batcher.submit(songs)  # noqa: E731
+        future, joined = self.cache.join_or_lead(key, lead)
         if not joined:
             cache = self.cache
             future.add_done_callback(lambda f: cache.finish(key, f))
+        # the seeds travel WITH the future so the async transport can
+        # build a per-request degraded fallback when it resolves to a
+        # DeadlineExceeded/NoHealthyReplicas (finish_recommend has no
+        # other path back to the request body); the singleflight shares
+        # one future across IDENTICAL seed sets, so the attribute is
+        # consistent for every joiner
+        future._kmls_seeds = songs
         return "flight", future
 
     def recommend_direct(
@@ -305,15 +415,30 @@ class RecommendApp:
     ) -> tuple[list[str], str, bool]:
         """Blocking cached recommend → ``(songs, source, cache_hit)``.
         Used by the threaded POST path and the in-process replay harness;
-        raises (Overloaded included) like the underlying batcher/engine."""
-        state, payload = self._cache_lookup_or_lead(songs)
+        raises (Overloaded, DeadlineExceeded, NoHealthyReplicas included)
+        like the underlying batcher/engine."""
+        deadline = self._deadline_for(time.perf_counter())
+        state, payload = self._cache_lookup_or_lead(songs, deadline)
         if state == "hit":
             return payload[0], payload[1], True
         if state == "flight":
-            recs, source = payload.result(timeout=30.0)
+            timeout = 30.0
+            if deadline is not None:
+                timeout = max(deadline - time.perf_counter(), 0.0)
+            try:
+                recs, source = payload.result(timeout=timeout)
+            except FuturesTimeout:
+                if deadline is not None:
+                    raise DeadlineExceeded(
+                        "request exceeded its deadline budget in flight"
+                    ) from None
+                raise
             return recs, source, False
         if self.batcher is not None:
-            recs, source = self.batcher.recommend(songs)
+            if deadline is not None and hasattr(self.batcher, "submit"):
+                recs, source = self.batcher.recommend(songs, deadline=deadline)
+            else:
+                recs, source = self.batcher.recommend(songs)
         else:
             recs, source = self.engine.recommend(songs)
         if self.cache is not None:
@@ -328,6 +453,11 @@ class RecommendApp:
         try:
             recs, source, cached = self.recommend_direct(songs)
         except Exception as exc:
+            reason = self._degrade_reason(exc)
+            if reason is not None:
+                # deadline exhausted or every replica ejected: answer
+                # from the popularity fallback (X-KMLS-Degraded), not 5xx
+                return self._degraded_response(t0, songs, reason)
             return self._recommend_error_response(exc)
         return self._recommend_result_response(t0, recs, source, cached=cached)
 
@@ -350,20 +480,32 @@ class RecommendApp:
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err, None, t0
+        deadline = self._deadline_for(t0)
         if self.batcher is None:
             try:
                 recs, source, cached = self.recommend_direct(songs)
             except Exception as exc:
+                reason = self._degrade_reason(exc)
+                if reason is not None:
+                    return self._degraded_response(t0, songs, reason), None, t0
                 return self._recommend_error_response(exc), None, t0
             return (
                 self._recommend_result_response(t0, recs, source, cached=cached),
                 None, t0,
             )
         try:
-            state, payload = self._cache_lookup_or_lead(songs)
+            state, payload = self._cache_lookup_or_lead(songs, deadline)
             if state == "off":
-                return None, self.batcher.submit(songs), t0
-        except Exception as exc:  # Overloaded (shed) lands here
+                if deadline is not None:
+                    future = self.batcher.submit(songs, deadline=deadline)
+                else:
+                    future = self.batcher.submit(songs)
+                future._kmls_seeds = songs
+                return None, future, t0
+        except Exception as exc:  # Overloaded / NoHealthyReplicas land here
+            reason = self._degrade_reason(exc)
+            if reason is not None:
+                return self._degraded_response(t0, songs, reason), None, t0
             return self._recommend_error_response(exc), None, t0
         if state == "hit":
             return (
@@ -376,10 +518,16 @@ class RecommendApp:
 
     def finish_recommend(self, future, t0: float) -> Response:
         """Build the response for a completed :meth:`submit_recommend`
-        future (which is done — ``result()`` never blocks here)."""
+        future (which is done — ``result()`` never blocks here). A future
+        resolved to DeadlineExceeded/NoHealthyReplicas degrades to the
+        fallback answer for the seeds that rode in on the future."""
         try:
             recs, source = future.result()
         except Exception as exc:
+            reason = self._degrade_reason(exc)
+            if reason is not None:
+                songs = getattr(future, "_kmls_seeds", None) or []
+                return self._degraded_response(t0, songs, reason)
             return self._recommend_error_response(exc)
         return self._recommend_result_response(t0, recs, source)
 

@@ -56,18 +56,44 @@ N devices drain the same queue N times faster. Engines without a replica
 set (``n_replicas`` absent or 1) get the exact single-lane behavior the
 fakes and the native host kernel expect: the ``replica`` kwarg is only
 passed when there is a choice to make.
+
+**Replica health management** (``eject_threshold > 0``): a per-replica
+consecutive-failure circuit breaker. A replica whose batches keep failing
+is EJECTED from the least-loaded pick — its failed batch's requests are
+re-dispatched to the surviving replicas (bounded per-request retries),
+and the shed projection + idle fast path re-project against HEALTHY
+capacity, not nominal. An ejected replica is probed for re-admission
+every ``probe_interval_s``: one half-open trial batch; success re-admits,
+failure re-arms the timer. With every replica ejected and no probe due,
+admission raises :class:`NoHealthyReplicas` — the HTTP layer degrades
+those requests to the popularity fallback instead of 500ing. Default OFF
+(``eject_threshold=0``) so directly-constructed batchers (tests, replay
+harnesses) keep the exact propagate-the-error behavior they always had;
+the app layer wires KMLS_REPLICA_EJECT_THRESHOLD through.
+
+**Deadlines**: ``submit(seeds, deadline=...)`` carries a per-request
+perf_counter deadline through the pipeline. A request still queued at its
+deadline fails with :class:`DeadlineExceeded` instead of dispatching dead
+work to the device; in-flight overruns surface as the same exception from
+the blocking ``recommend()`` wait (threaded) or a loop timer (async), and
+the HTTP layer turns either into a degraded answer.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
+import logging
 import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 from .engine import RecommendEngine
+
+logger = logging.getLogger("kmlserver_tpu.serving")
 
 # EWMA smoothing for the device-batch-time estimate: new sample weighted
 # 0.2 — reactive enough to track a load swing within ~10 batches, smooth
@@ -88,11 +114,28 @@ class Overloaded(RuntimeError):
         self.projected_wait_ms = projected_wait_ms
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline budget ran out before (or while) the device
+    could answer it. The HTTP layer degrades this to the latency-budgeted
+    popularity fallback with an ``X-KMLS-Degraded`` header — never a 500."""
+
+
+class NoHealthyReplicas(RuntimeError):
+    """Every serving replica is currently ejected by the circuit breaker
+    (and no re-admission probe is due). Degraded like
+    :class:`DeadlineExceeded` — total replica loss serves fallbacks, not
+    errors."""
+
+
 @dataclasses.dataclass
 class _Pending:
     seeds: list[str]
     future: Future
     t_enqueue: float
+    # perf_counter deadline (None = no budget) and how many times this
+    # request has been re-dispatched after a replica failure
+    deadline: float | None = None
+    retries: int = 0
 
 
 class MicroBatcher:
@@ -107,6 +150,9 @@ class MicroBatcher:
         window_min_ms: float = 1.0,
         shed_queue_budget_ms: float = 0.0,
         shed_retry_after_s: float = 1.0,
+        eject_threshold: int = 0,
+        probe_interval_s: float = 5.0,
+        redispatch_max: int = 2,
         metrics=None,
     ):
         self.engine = engine
@@ -118,11 +164,31 @@ class MicroBatcher:
         self.shed_retry_after_s = shed_retry_after_s
         self.metrics = metrics
         self.shed_total = 0
+        # replica health: consecutive-failure circuit breaker (0 = off —
+        # the legacy propagate-the-error behavior, which fakes and
+        # single-replica harnesses rely on)
+        self.eject_threshold = eject_threshold
+        self.probe_interval_s = probe_interval_s
+        self.redispatch_max = max(0, redispatch_max)
+        self._consec_failures: dict[int, int] = {}
+        self._ejected: dict[int, float] = {}  # idx -> perf_counter at eject
+        self._probing: set[int] = set()  # half-open: one trial batch out
+        self.eject_total = 0
+        self.readmit_total = 0
+        self.redispatch_total = 0
         # pipeline depth PER REPLICA; the aggregate bound is this times
         # the engine's live replica count (clamped: depth 0 would deadlock
         # the collector — "no pipelining" is depth 1, not 0)
         self.max_inflight = max(1, max_inflight)
-        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        # priority queue of (priority, seq, pending): fresh arrivals ride
+        # at priority 1, re-dispatched requests at 0 — they have waited
+        # longest and must not starve behind new traffic (the async twin
+        # front-inserts for the same reason). seq keeps FIFO within a
+        # priority band and spares the heap from comparing _Pending.
+        self._queue: "queue.PriorityQueue[tuple[int, int, _Pending]]" = (
+            queue.PriorityQueue()
+        )
+        self._seq = itertools.count()
         # one completion lane PER REPLICA: (batch, finish_fn, t_dispatch)
         # triples awaiting their device results, FIFO within a lane — jax
         # executes dispatches in order per device, so completion order
@@ -179,17 +245,49 @@ class MicroBatcher:
     def _total_inflight_locked(self) -> int:
         return sum(self._inflight_by_replica.values())
 
+    def _n_healthy_locked(self, n: int) -> int:
+        if self.eject_threshold <= 0:
+            return n
+        return n - sum(1 for i in self._ejected if i < n)
+
+    def _probe_due_locked(self, n: int, now: float) -> bool:
+        return any(
+            i < n and i not in self._probing
+            and now - t >= self.probe_interval_s
+            for i, t in self._ejected.items()
+        )
+
+    def ejected_replicas(self) -> list[int]:
+        """Currently-ejected replica indices (readyz/metrics/tests)."""
+        with self._n_lock:
+            return sorted(self._ejected)
+
     def _pick_replica_locked(self, n: int) -> int:
-        """Least-loaded replica index; ties broken by a rotating start so
-        an idle fleet spreads consecutive batches instead of hammering
-        replica 0. Caller holds ``_n_lock``."""
-        best, best_load = 0, None
+        """Least-loaded HEALTHY replica index; ties broken by a rotating
+        start so an idle fleet spreads consecutive batches instead of
+        hammering replica 0. An ejected replica whose probe interval has
+        elapsed gets ONE half-open trial batch instead. → -1 when every
+        replica is ejected and no probe is due (total replica loss).
+        Caller holds ``_n_lock``."""
+        if self.eject_threshold > 0 and self._ejected:
+            now = time.perf_counter()
+            for i, t in self._ejected.items():
+                if (
+                    i < n and i not in self._probing
+                    and now - t >= self.probe_interval_s
+                ):
+                    self._probing.add(i)
+                    return i
+        best, best_load = -1, None
         for off in range(n):
             i = (self._rr + off) % n
+            if i in self._ejected:
+                continue
             load = self._inflight_by_replica.get(i, 0)
             if best_load is None or load < best_load:
                 best, best_load = i, load
-        self._rr = (best + 1) % n
+        if best >= 0:
+            self._rr = (best + 1) % n
         return best
 
     def _completion_lane(self, idx: int) -> "queue.Queue":
@@ -224,15 +322,20 @@ class MicroBatcher:
         not guesses."""
         now = time.perf_counter()
         device_s = self._device_s_ewma or 0.0
+        n = self._n_replicas()
         with self._n_lock:
             inflight = self._total_inflight_locked()
+            # ejected replicas aren't capacity: shed capacity re-projects
+            # against the SURVIVING replicas, so the budget tightens the
+            # moment the breaker takes a device out
+            healthy = max(1, self._n_healthy_locked(n))
             for lane in self._dispatch_times.values():
                 if lane:
                     device_s = max(device_s, now - lane[0])
         if device_s <= 0.0:
             return 0.0
         queued_batches = self._queue.qsize() / max(self.max_size, 1)
-        return (inflight + queued_batches) * device_s / self._n_replicas()
+        return (inflight + queued_batches) * device_s / healthy
 
     def _arrival_gap_s(self) -> float | None:
         """Mean inter-arrival gap over the sliding window, or None before
@@ -244,13 +347,32 @@ class MicroBatcher:
             span = self._arrivals[-1] - self._arrivals[0]
         return span / (n - 1)
 
-    def submit(self, seeds: list[str]) -> Future:
+    def submit(self, seeds: list[str], deadline: float | None = None) -> Future:
         """Non-blocking admission: shed-or-enqueue, → the request's
         Future. The async transport resolves it via a done-callback; the
-        threaded transport blocks on it in :meth:`recommend`."""
+        threaded transport blocks on it in :meth:`recommend`.
+        ``deadline`` (perf_counter seconds) rides the pending entry
+        through collection and dispatch."""
         now = time.perf_counter()
         with self._rate_lock:
             self._arrivals.append(now)
+        if self.eject_threshold > 0 and self._ejected:
+            # unlocked pre-check on _ejected: the healthy common case must
+            # not pay a contended _n_lock acquisition per request (same
+            # benign stale-read pattern as faults._armed — worst case one
+            # request's rejection shifts by a dispatch)
+            with self._n_lock:
+                n = self._n_replicas()
+                if (
+                    self._n_healthy_locked(n) == 0
+                    and not self._probe_due_locked(n, now)
+                ):
+                    # total replica loss, nothing to probe yet: degrade NOW
+                    # instead of letting the request rot in the queue
+                    raise NoHealthyReplicas(
+                        "all serving replicas ejected; next probe in "
+                        f"<= {self.probe_interval_s:.1f}s"
+                    )
         if self.shed_budget_s > 0:
             projected = self.projected_queue_wait_s()
             if projected > self.shed_budget_s:
@@ -259,12 +381,31 @@ class MicroBatcher:
                 if self.metrics is not None:
                     self.metrics.record_shed()
                 raise Overloaded(self.shed_retry_after_s, projected * 1e3)
-        pending = _Pending(seeds=seeds, future=Future(), t_enqueue=now)
-        self._queue.put(pending)
+        pending = _Pending(
+            seeds=seeds, future=Future(), t_enqueue=now, deadline=deadline
+        )
+        self._queue.put((1, next(self._seq), pending))
         return pending.future
 
-    def recommend(self, seeds: list[str], timeout: float = 30.0) -> tuple[list[str], str]:
-        return self.submit(seeds).result(timeout=timeout)
+    def recommend(
+        self, seeds: list[str], timeout: float = 30.0,
+        deadline: float | None = None,
+    ) -> tuple[list[str], str]:
+        future = self.submit(seeds, deadline=deadline)
+        if deadline is not None:
+            timeout = max(deadline - time.perf_counter(), 0.0)
+        try:
+            return future.result(timeout=timeout)
+        except FuturesTimeout:
+            if deadline is not None:
+                # in-flight overrun (a stalled device, a kernel delayed
+                # past the budget): same degradation contract as a
+                # queue-side expiry
+                raise DeadlineExceeded(
+                    f"request exceeded its deadline budget after "
+                    f"{timeout * 1e3:.0f}ms in flight"
+                ) from None
+            raise
 
     # ---------- collection ----------
 
@@ -287,19 +428,20 @@ class MicroBatcher:
 
     def _collect_loop(self) -> None:
         while True:
-            first = self._queue.get()  # block for the batch leader
+            _, _, first = self._queue.get()  # block for the batch leader
             batch = [first]
             # sweep everything already waiting, without blocking
             while len(batch) < self.max_size:
                 try:
-                    batch.append(self._queue.get_nowait())
+                    batch.append(self._queue.get_nowait()[2])
                 except queue.Empty:
                     break
             with self._n_lock:
-                # idle fast path fires while ANY replica sits idle: waiting
-                # only buys amortization when every device already has work
-                device_idle = (
-                    self._total_inflight_locked() < self._n_replicas()
+                # idle fast path fires while ANY HEALTHY replica sits idle:
+                # waiting only buys amortization when every live device
+                # already has work (an ejected replica isn't capacity)
+                device_idle = self._total_inflight_locked() < max(
+                    1, self._n_healthy_locked(self._n_replicas())
                 )
             if not device_idle:
                 # all replicas busy: the window buys amortization — keep
@@ -311,30 +453,55 @@ class MicroBatcher:
                     if remaining <= 0:
                         break
                     try:
-                        batch.append(self._queue.get(timeout=remaining))
+                        batch.append(self._queue.get(timeout=remaining)[2])
                     except queue.Empty:
                         break
             # bound the pipeline AGGREGATELY: past max_inflight
             # undispatched-but-queued device calls PER replica, block here
             # (requests keep queueing upstream and land in bigger batches
-            # — backpressure, not failure). Reserve the least-loaded
-            # replica under the same lock so the pick and the accounting
-            # can't race a concurrent completion.
+            # — backpressure, not failure).
             with self._pipe_cond:
                 while (
                     self._total_inflight_locked()
-                    >= self.max_inflight * self._n_replicas()
+                    >= self.max_inflight
+                    * max(1, self._n_healthy_locked(self._n_replicas()))
                 ):
                     self._pipe_cond.wait(timeout=1.0)
+            # deadline check AFTER the capacity wait (which can block for
+            # seconds under overload — exactly when deadlines matter): a
+            # request already past its budget must not burn device time.
+            # Outside the lock: expiry resolves futures, whose callbacks
+            # take the cache's lock. The freed capacity can't be stolen —
+            # this is the only dispatching thread; completions only add.
+            batch = self._expire_overdue(batch)
+            if not batch:
+                continue
+            # Reserve the least-loaded replica under the lock so the pick
+            # and the accounting can't race a concurrent completion.
+            with self._pipe_cond:
                 n = self._n_replicas()
-                idx = self._pick_replica_locked(n) if n > 1 else 0
-                self._inflight_by_replica[idx] = (
-                    self._inflight_by_replica.get(idx, 0) + 1
-                )
-                t_dispatch = time.perf_counter()
-                self._dispatch_times.setdefault(
-                    idx, collections.deque()
-                ).append(t_dispatch)
+                if n > 1 or self.eject_threshold > 0:
+                    idx = self._pick_replica_locked(n)
+                else:
+                    idx = 0
+                if idx >= 0:
+                    self._inflight_by_replica[idx] = (
+                        self._inflight_by_replica.get(idx, 0) + 1
+                    )
+                    t_dispatch = time.perf_counter()
+                    self._dispatch_times.setdefault(
+                        idx, collections.deque()
+                    ).append(t_dispatch)
+            if idx < 0:
+                # every replica ejected, no probe due: fail fast so the
+                # app degrades instead of queueing dead work. Futures are
+                # resolved OUTSIDE the lock — their done-callbacks (cache
+                # singleflight retirement) take locks of their own.
+                err = NoHealthyReplicas("all serving replicas ejected")
+                for pending in batch:
+                    if not pending.future.done():
+                        pending.future.set_exception(err)
+                continue
             try:
                 # the replica kwarg is passed only when there's a choice:
                 # single-replica engines (fakes, the native host kernel)
@@ -354,9 +521,7 @@ class MicroBatcher:
                     if lane:
                         lane.pop()
                     self._pipe_cond.notify_all()
-                for pending in batch:
-                    if not pending.future.done():
-                        pending.future.set_exception(exc)
+                self._on_replica_failure(idx, batch, exc)
                 continue
             self._completion_lane(idx).put((batch, finish, t_dispatch))
 
@@ -389,14 +554,14 @@ class MicroBatcher:
                         else (1 - _EWMA_ALPHA) * self._device_s_ewma
                         + _EWMA_ALPHA * device_s
                     )
+                    self._note_replica_ok_locked(idx)
                 self._pipe_cond.notify_all()
             if err is not None:
-                for pending in batch:
-                    if not pending.future.done():
-                        pending.future.set_exception(err)
+                self._on_replica_failure(idx, batch, err)
                 continue
             for pending, result in zip(batch, results):
-                pending.future.set_result(result)
+                if not pending.future.done():  # deadline may have expired it
+                    pending.future.set_result(result)
             if self.metrics is not None:
                 for pending in batch:
                     self.metrics.record_attribution(
@@ -404,6 +569,101 @@ class MicroBatcher:
                         device_s=device_s,
                         e2e_s=t_complete - pending.t_enqueue,
                     )
+
+    # ---------- replica health (threaded) ----------
+
+    def _expire_overdue(self, batch: list[_Pending]) -> list[_Pending]:
+        """Split out pendings whose deadline already passed; their futures
+        fail with DeadlineExceeded (degraded at the app layer) and the
+        survivors proceed to dispatch."""
+        now = time.perf_counter()
+        live: list[_Pending] = []
+        for pending in batch:
+            if pending.deadline is not None and now >= pending.deadline:
+                if not pending.future.done():
+                    pending.future.set_exception(DeadlineExceeded(
+                        "deadline expired before dispatch"
+                    ))
+            else:
+                live.append(pending)
+        return live
+
+    def _note_replica_ok_locked(self, idx: int) -> None:
+        """Successful completion on ``idx`` (caller holds the lock): reset
+        the breaker's consecutive-failure count; a succeeding half-open
+        probe re-admits the replica."""
+        if self.eject_threshold <= 0:
+            return
+        self._consec_failures[idx] = 0
+        if idx in self._probing:
+            self._probing.discard(idx)
+            if self._ejected.pop(idx, None) is not None:
+                self.readmit_total += 1
+                if self.metrics is not None:
+                    self.metrics.record_replica_readmitted()
+                logger.info(
+                    "replica %d re-admitted after successful probe", idx
+                )
+
+    def _on_replica_failure(
+        self, idx: int, batch: list[_Pending], err: Exception
+    ) -> None:
+        """A batch failed on replica ``idx``: advance the circuit breaker
+        (eject past the threshold; a failed half-open probe re-arms the
+        timer), then RE-DISPATCH the batch's requests to the surviving
+        replicas — bounded per-request retries — and only propagate the
+        error to requests that are out of retries or out of replicas.
+        Futures are resolved outside the lock (their done-callbacks take
+        the cache's lock)."""
+        healthy_other = False
+        with self._pipe_cond:
+            if self.eject_threshold > 0:
+                if idx in self._probing:
+                    # failed probe: stay ejected, timer re-armed
+                    self._probing.discard(idx)
+                    self._ejected[idx] = time.perf_counter()
+                else:
+                    fails = self._consec_failures.get(idx, 0) + 1
+                    self._consec_failures[idx] = fails
+                    if (
+                        fails >= self.eject_threshold
+                        and idx not in self._ejected
+                    ):
+                        self._ejected[idx] = time.perf_counter()
+                        self.eject_total += 1
+                        if self.metrics is not None:
+                            self.metrics.record_replica_ejected()
+                        logger.warning(
+                            "replica %d ejected after %d consecutive "
+                            "failures; re-admission probe every %.1fs",
+                            idx, fails, self.probe_interval_s,
+                        )
+            n = self._n_replicas()
+            # re-dispatch only with the breaker ON: disabled (threshold 0)
+            # means the documented legacy contract — errors propagate
+            # untouched, no silent retries tripling device work
+            healthy_other = self.eject_threshold > 0 and any(
+                i != idx and i not in self._ejected for i in range(n)
+            )
+            retriable: list[_Pending] = []
+            dead: list[_Pending] = []
+            for pending in batch:
+                if healthy_other and pending.retries < self.redispatch_max:
+                    pending.retries += 1
+                    retriable.append(pending)
+                else:
+                    dead.append(pending)
+            if retriable:
+                self.redispatch_total += len(retriable)
+                if self.metrics is not None:
+                    self.metrics.record_redispatch(len(retriable))
+        for pending in retriable:
+            # priority 0: ahead of fresh arrivals — these have waited
+            # longest (mirrors the async twin's front-insert)
+            self._queue.put((0, next(self._seq), pending))
+        for pending in dead:
+            if not pending.future.done():
+                pending.future.set_exception(err)
 
 
 class AsyncMicroBatcher:
@@ -438,6 +698,9 @@ class AsyncMicroBatcher:
         window_min_ms: float = 1.0,
         shed_queue_budget_ms: float = 0.0,
         shed_retry_after_s: float = 1.0,
+        eject_threshold: int = 0,
+        probe_interval_s: float = 5.0,
+        redispatch_max: int = 2,
         metrics=None,
     ):
         from concurrent.futures import ThreadPoolExecutor
@@ -452,6 +715,16 @@ class AsyncMicroBatcher:
         self.shed_retry_after_s = shed_retry_after_s
         self.metrics = metrics
         self.shed_total = 0
+        # replica health (mirrors MicroBatcher; loop-confined, no locks)
+        self.eject_threshold = eject_threshold
+        self.probe_interval_s = probe_interval_s
+        self.redispatch_max = max(0, redispatch_max)
+        self._consec_failures: dict[int, int] = {}
+        self._ejected: dict[int, float] = {}
+        self._probing: set[int] = set()
+        self.eject_total = 0
+        self.readmit_total = 0
+        self.redispatch_total = 0
         self._pending: list[_Pending] = []
         self._inflight_by_replica: dict[int, int] = {}
         self._rr = 0
@@ -482,14 +755,44 @@ class AsyncMicroBatcher:
     def _total_inflight(self) -> int:
         return sum(self._inflight_by_replica.values())
 
+    def _n_healthy(self, n: int) -> int:
+        if self.eject_threshold <= 0:
+            return n
+        return n - sum(1 for i in self._ejected if i < n)
+
+    def _probe_due(self, n: int, now: float) -> bool:
+        return any(
+            i < n and i not in self._probing
+            and now - t >= self.probe_interval_s
+            for i, t in self._ejected.items()
+        )
+
+    def ejected_replicas(self) -> list[int]:
+        return sorted(self._ejected)
+
     def _pick_replica(self, n: int) -> int:
-        best, best_load = 0, None
+        """Mirrors MicroBatcher._pick_replica_locked: half-open probe for
+        an ejected replica whose interval elapsed, else least-loaded
+        healthy, else -1 (total replica loss)."""
+        if self.eject_threshold > 0 and self._ejected:
+            now = time.perf_counter()
+            for i, t in self._ejected.items():
+                if (
+                    i < n and i not in self._probing
+                    and now - t >= self.probe_interval_s
+                ):
+                    self._probing.add(i)
+                    return i
+        best, best_load = -1, None
         for off in range(n):
             i = (self._rr + off) % n
+            if i in self._ejected:
+                continue
             load = self._inflight_by_replica.get(i, 0)
             if best_load is None or load < best_load:
                 best, best_load = i, load
-        self._rr = (best + 1) % n
+        if best >= 0:
+            self._rr = (best + 1) % n
         return best
 
     # ---------- policy (mirrors MicroBatcher, loop-confined) ----------
@@ -505,7 +808,7 @@ class AsyncMicroBatcher:
         queued_batches = len(self._pending) / max(self.max_size, 1)
         return (
             (self._total_inflight() + queued_batches)
-            * device_s / self._n_replicas()
+            * device_s / max(1, self._n_healthy(self._n_replicas()))
         )
 
     def _arrival_gap_s(self) -> float | None:
@@ -528,12 +831,21 @@ class AsyncMicroBatcher:
 
     # ---------- admission (loop thread only) ----------
 
-    def submit(self, seeds: list[str]) -> "asyncio.Future":
+    def submit(
+        self, seeds: list[str], deadline: float | None = None
+    ) -> "asyncio.Future":
         import asyncio
 
         loop = asyncio.get_running_loop()
         now = time.perf_counter()
         self._arrivals.append(now)
+        if self.eject_threshold > 0 and self._ejected:
+            n = self._n_replicas()
+            if self._n_healthy(n) == 0 and not self._probe_due(n, now):
+                raise NoHealthyReplicas(
+                    "all serving replicas ejected; next probe in "
+                    f"<= {self.probe_interval_s:.1f}s"
+                )
         if self.shed_budget_s > 0:
             projected = self.projected_queue_wait_s()
             if projected > self.shed_budget_s:
@@ -542,7 +854,21 @@ class AsyncMicroBatcher:
                     self.metrics.record_shed()
                 raise Overloaded(self.shed_retry_after_s, projected * 1e3)
         future = loop.create_future()
-        self._pending.append(_Pending(seeds=seeds, future=future, t_enqueue=now))
+        pending = _Pending(
+            seeds=seeds, future=future, t_enqueue=now, deadline=deadline
+        )
+        self._pending.append(pending)
+        if deadline is not None:
+            # in-flight overruns included: the timer fires regardless of
+            # where the request is stuck (queue, device, executor) and the
+            # app degrades the DeadlineExceeded to a fallback answer.
+            # Cancelled on completion — at QPS scale an uncancelled
+            # ~1s timer per sub-ms answer piles thousands of live handles
+            # (each pinning its pending) into the loop's heap.
+            handle = loop.call_later(
+                max(deadline - now, 0.0), self._expire, pending
+            )
+            future.add_done_callback(lambda _f: handle.cancel())
         if len(self._pending) >= self.max_size:
             self._flush(loop)  # full batch: dispatch now
         elif getattr(self.engine, "host_kernel_active", False):
@@ -560,8 +886,10 @@ class AsyncMicroBatcher:
                     self._flush_handle = loop.call_later(
                         window, self._flush, loop
                     )
-        elif self._total_inflight() < self._n_replicas():
-            self._flush(loop)  # idle fast path: some replica is free now
+        elif self._total_inflight() < max(
+            1, self._n_healthy(self._n_replicas())
+        ):
+            self._flush(loop)  # idle fast path: some healthy replica is free
         elif self._flush_handle is None:
             self._flush_handle = loop.call_later(
                 self._busy_window_s(now), self._flush, loop
@@ -570,15 +898,29 @@ class AsyncMicroBatcher:
 
     # ---------- dispatch / completion (loop thread only) ----------
 
+    def _expire(self, pending: _Pending) -> None:
+        """Deadline timer callback: fail the future (the app degrades it)
+        unless the answer already landed. A later set_result is guarded by
+        the done() checks in _flush/_resolve."""
+        if not pending.future.done():
+            pending.future.set_exception(
+                DeadlineExceeded("request exceeded its deadline budget")
+            )
+
     def _flush(self, loop) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
+        # expired/cancelled requests must not burn device time: their
+        # futures are already resolved (the _expire timer ran)
+        if any(p.future.done() for p in self._pending):
+            self._pending = [p for p in self._pending if not p.future.done()]
         if not self._pending:
             return
         n = self._n_replicas()
         if self._total_inflight() >= min(
-            self.max_inflight * n, self._executor_workers
+            self.max_inflight * max(1, self._n_healthy(n)),
+            self._executor_workers,
         ):
             # aggregate pipeline full — or past what the executor pool
             # can actually run concurrently: the next completion
@@ -587,7 +929,14 @@ class AsyncMicroBatcher:
             return
         batch = self._pending[: self.max_size]
         del self._pending[: len(batch)]
-        idx = self._pick_replica(n) if n > 1 else 0
+        idx = self._pick_replica(n) if (n > 1 or self.eject_threshold > 0) else 0
+        if idx < 0:
+            # total replica loss, no probe due: degrade, don't dispatch
+            err = NoHealthyReplicas("all serving replicas ejected")
+            for pending in batch:
+                if not pending.future.done():
+                    pending.future.set_exception(err)
+            return
         t_dispatch = time.perf_counter()
         try:
             # replica kwarg only when there's a choice — single-replica
@@ -601,9 +950,7 @@ class AsyncMicroBatcher:
                     [p.seeds for p in batch]
                 )
         except Exception as exc:  # propagate, don't die
-            for pending in batch:
-                if not pending.future.done():
-                    pending.future.set_exception(exc)
+            self._on_replica_failure(idx, batch, exc, loop)
             if self._pending:
                 loop.call_soon(self._flush, loop)
             return
@@ -613,10 +960,17 @@ class AsyncMicroBatcher:
         self._dispatch_times.setdefault(
             idx, collections.deque()
         ).append(t_dispatch)
-        if getattr(self.engine, "host_kernel_active", False):
+        if getattr(self.engine, "host_kernel_active", False) and not any(
+            p.deadline is not None for p in batch
+        ):
             # inline: the native kernel is a sub-ms GIL-releasing C call —
             # running it here costs less than one thread handoff, and the
-            # whole request lifecycle stays on a single thread
+            # whole request lifecycle stays on a single thread. NOT taken
+            # when any request carries a deadline: inline blocks the LOOP,
+            # so a genuinely stalled kernel would freeze the expiry timers
+            # (and every other connection) for exactly as long as the
+            # stall — the executor hop keeps the loop free to degrade
+            # on time.
             try:
                 outcome = (finish(), None)
             except Exception as exc:
@@ -651,10 +1005,9 @@ class AsyncMicroBatcher:
         if lane:
             lane.popleft()
         if err is not None:
-            for pending in batch:
-                if not pending.future.done():
-                    pending.future.set_exception(err)
+            self._on_replica_failure(idx, batch, err, loop)
         else:
+            self._note_replica_ok(idx)
             device_s = t_complete - t_dispatch
             self._device_s_ewma = (
                 device_s if self._device_s_ewma is None
@@ -675,3 +1028,66 @@ class AsyncMicroBatcher:
             # mirror the threaded collector waking on a completion: the
             # freed pipeline slot dispatches the waiting batch immediately
             self._flush(loop)
+
+    # ---------- replica health (loop-confined twin of the threaded
+    # helpers; no locks — all state is loop-owned) ----------
+
+    def _note_replica_ok(self, idx: int) -> None:
+        if self.eject_threshold <= 0:
+            return
+        self._consec_failures[idx] = 0
+        if idx in self._probing:
+            self._probing.discard(idx)
+            if self._ejected.pop(idx, None) is not None:
+                self.readmit_total += 1
+                if self.metrics is not None:
+                    self.metrics.record_replica_readmitted()
+                logger.info(
+                    "replica %d re-admitted after successful probe", idx
+                )
+
+    def _on_replica_failure(self, idx: int, batch, err, loop) -> None:
+        if self.eject_threshold > 0:
+            if idx in self._probing:
+                # failed probe: stay ejected, timer re-armed
+                self._probing.discard(idx)
+                self._ejected[idx] = time.perf_counter()
+            else:
+                fails = self._consec_failures.get(idx, 0) + 1
+                self._consec_failures[idx] = fails
+                if fails >= self.eject_threshold and idx not in self._ejected:
+                    self._ejected[idx] = time.perf_counter()
+                    self.eject_total += 1
+                    if self.metrics is not None:
+                        self.metrics.record_replica_ejected()
+                    logger.warning(
+                        "replica %d ejected after %d consecutive failures; "
+                        "re-admission probe every %.1fs",
+                        idx, fails, self.probe_interval_s,
+                    )
+        n = self._n_replicas()
+        # breaker off = legacy propagate-the-error contract (see the
+        # threaded twin)
+        healthy_other = self.eject_threshold > 0 and any(
+            i != idx and i not in self._ejected for i in range(n)
+        )
+        retriable: list[_Pending] = []
+        dead: list[_Pending] = []
+        for pending in batch:
+            if pending.future.done():  # deadline timer beat us to it
+                continue
+            if healthy_other and pending.retries < self.redispatch_max:
+                pending.retries += 1
+                retriable.append(pending)
+            else:
+                dead.append(pending)
+        if retriable:
+            self.redispatch_total += len(retriable)
+            if self.metrics is not None:
+                self.metrics.record_redispatch(len(retriable))
+            # front of the queue: re-dispatched requests have waited
+            # longest and must not starve behind fresh arrivals
+            self._pending[:0] = retriable
+            loop.call_soon(self._flush, loop)
+        for pending in dead:
+            pending.future.set_exception(err)

@@ -53,8 +53,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..config import ServingConfig
 from ..io import artifacts, registry
+from ..io.artifacts import ArtifactIntegrityError
 from ..ops.serve import recommend_batch, recommend_batch_donated
 
 logger = logging.getLogger("kmlserver_tpu.serving")
@@ -157,6 +159,18 @@ class RecommendEngine:
         self.finished_loading = False
         self.reload_counter = 0
         self._reload_lock = threading.Lock()
+        # ---- fault-tolerance bookkeeping (rendered into /metrics) ----
+        # total failed reloads: each one KEPT the last-good bundle serving
+        # (the rollback counter), vs consecutive failures driving the
+        # exponential retry backoff + the quarantine strike discipline
+        self.reload_failures = 0
+        self.consecutive_reload_failures = 0
+        self.artifact_quarantines = 0
+        self.last_load_error: str | None = None
+        # monotonic deadline before which reload_if_required() won't retry
+        # a FAILED load (direct load() calls always go through — tests and
+        # operator nudges must not be backoff-gated)
+        self._backoff_until = 0.0
         self._kernel = None  # resolved lazily: donation needs the backend
         # dispatches whose (batch, length) shape was never pre-warmed —
         # each one paid a jit compile on the serving path; must stay 0
@@ -212,8 +226,17 @@ class RecommendEngine:
             rec_path = os.path.join(cfg.pickles_dir, cfg.recommendations_file)
             npz_path = artifacts.tensor_artifact_path(rec_path)
             try:
+                # deterministic chaos hook: KMLS_FAULT_RELOAD_FAIL / a test's
+                # faults.inject("engine.load") fails the reload exactly like
+                # a torn artifact — same rollback, same retry ladder
+                faults.fire("engine.load")
+                use_npz = self._verify_before_load(
+                    best_path, rec_path, npz_path
+                )
                 best = artifacts.load_pickle(best_path)
-                replicas = self._build_replicas(rec_path, npz_path)
+                replicas = self._build_replicas(
+                    rec_path, npz_path, use_npz=use_npz
+                )
                 # warm the serving kernel for every seed-bucket shape on
                 # EVERY replica BEFORE publishing: the first jit compile
                 # costs seconds on TPU and must not land inside a request
@@ -226,12 +249,18 @@ class RecommendEngine:
             except FileNotFoundError as exc:
                 logger.warning("artifacts not ready: %s", exc)
                 return False
-            except Exception:
+            except Exception as exc:
                 # corrupt/torn artifact (the REFERENCE mining job writes
                 # non-atomically — its report acknowledges the race; this
                 # engine must serve either side's PVC): keep the current
-                # bundle, retry on the next poll
+                # bundle (last-good rollback), back off the retry, and
+                # quarantine persistent offenders. The invalidation token
+                # is NOT consumed (cache_value only moves on success), so
+                # every retry re-sees the staleness signal.
                 logger.exception("artifact load failed; keeping current bundle")
+                self._note_reload_failure(
+                    exc, best_path, rec_path, npz_path
+                )
                 return False
             # atomic publication: single reference assignments. Ordering
             # contract for the epoch-keyed cache: the bundle reference
@@ -254,6 +283,9 @@ class RecommendEngine:
             self.cache_value = replicas[0].model_token or self.cache_value
             self.finished_loading = True
             self.reload_counter += 1
+            self.consecutive_reload_failures = 0
+            self.last_load_error = None
+            self._backoff_until = 0.0
             logger.info(
                 "reload #%d complete (epoch %d): %d tracks, %d rule keys, "
                 "%d replica(s), token %r",
@@ -263,7 +295,104 @@ class RecommendEngine:
             )
             return True
 
-    def _build_replicas(self, rec_path: str, npz_path: str) -> list[RuleBundle]:
+    def _verify_before_load(
+        self, best_path: str, rec_path: str, npz_path: str
+    ) -> bool:
+        """Integrity gate before any bytes are trusted: validate the
+        artifact set against the mining job's manifest (sizes + sha256).
+        A mismatched best/recommendations pickle ABORTS the reload (raise
+        → last-good keeps serving); a mismatched npz is survivable — the
+        pickle carries the same generation — so it only disables the
+        tensor-artifact fast path for this reload. The CURRENT token gates
+        the check: a manifest stamped for another generation (a
+        manifest-less writer — the reference's job — has published since)
+        is stale and steps aside rather than condemning fresh bytes.
+        → use_npz."""
+        if not self.cfg.verify_manifest:
+            return True
+        bad = artifacts.verify_files(
+            self.cfg.pickles_dir,
+            [os.path.basename(p) for p in (best_path, rec_path, npz_path)],
+            token=self._read_token(),
+        )
+        use_npz = True
+        if npz_path in bad:
+            logger.warning(
+                "tensor artifact %s fails its manifest checksum; "
+                "falling back to the pickle", npz_path,
+            )
+            use_npz = False
+            bad = [p for p in bad if p != npz_path]
+        if bad:
+            raise ArtifactIntegrityError(
+                f"artifact checksum mismatch vs manifest: {bad}", bad
+            )
+        return use_npz
+
+    def _note_reload_failure(
+        self, exc: Exception, best_path: str, rec_path: str, npz_path: str
+    ) -> None:
+        """Failed-reload bookkeeping (caller holds ``_reload_lock``):
+        count the rollback, arm the exponential retry backoff, and — once
+        the SAME artifact set has failed ``quarantine_after_failures``
+        consecutive reloads — quarantine the files that are actually
+        corrupt (a single mid-update mismatch heals itself next poll and
+        must never cost a good file)."""
+        self.reload_failures += 1
+        self.consecutive_reload_failures += 1
+        self.last_load_error = f"{type(exc).__name__}: {exc}"
+        backoff = min(
+            self.cfg.reload_backoff_base_s
+            * (2 ** (self.consecutive_reload_failures - 1)),
+            self.cfg.reload_backoff_max_s,
+        )
+        self._backoff_until = time.monotonic() + backoff
+        logger.warning(
+            "reload failure #%d (consecutive); retrying in %.1fs",
+            self.consecutive_reload_failures, backoff,
+        )
+        threshold = self.cfg.quarantine_after_failures
+        if threshold > 0 and self.consecutive_reload_failures >= threshold:
+            self._quarantine_corrupt_artifacts(best_path, rec_path, npz_path)
+
+    def _quarantine_corrupt_artifacts(
+        self, best_path: str, rec_path: str, npz_path: str
+    ) -> None:
+        """Move persistently-corrupt artifacts into pickles/quarantine/ so
+        the next mining run writes fresh bytes and the bad ones stay
+        inspectable. Only a PARSE failure condemns a file — a manifest
+        mismatch alone never does: two polls can land inside one slow
+        publish window (new pickle on disk, manifest/token still the old
+        generation), and condemning on the mismatch would move a fresh,
+        valid artifact aside and wedge the pod until the next mining run.
+        A mismatched-but-parseable file keeps failing verification at
+        reload time instead — visible as the degraded state, costing no
+        good bytes."""
+        probes = (
+            (best_path, artifacts.load_pickle),
+            (rec_path, artifacts.load_pickle),
+            (npz_path, artifacts.load_rule_tensors),
+        )
+        for path, probe in probes:
+            if not os.path.exists(path):
+                continue
+            try:
+                probe(path)
+                continue  # parses fine: never quarantine on suspicion
+            except FileNotFoundError:
+                continue
+            except Exception:
+                pass
+            dest = artifacts.quarantine_file(path)
+            if dest is not None:
+                self.artifact_quarantines += 1
+                logger.warning(
+                    "quarantined corrupt artifact %s -> %s", path, dest
+                )
+
+    def _build_replicas(
+        self, rec_path: str, npz_path: str, use_npz: bool = True
+    ) -> list[RuleBundle]:
         """Load the rule tensors once, then replicate them onto every
         serving device (``device_put`` per device) — or onto the host when
         the native CPU kernel is active (one replica: the host kernel has
@@ -271,7 +400,11 @@ class RecommendEngine:
         index, known mask) is shared across the set."""
         token = self._read_token() or ""
         loaded = None
-        if self.cfg.prefer_tensor_artifact and os.path.exists(npz_path):
+        if (
+            self.cfg.prefer_tensor_artifact
+            and use_npz
+            and os.path.exists(npz_path)
+        ):
             try:
                 loaded = artifacts.load_rule_tensors(npz_path)
             except Exception:
@@ -408,7 +541,13 @@ class RecommendEngine:
 
     def reload_if_required(self) -> None:
         """Reference: reload when stale or never fully loaded
-        (rest_api/app/main.py:110-114)."""
+        (rest_api/app/main.py:110-114). After a FAILED reload this retries
+        on the exponential backoff ladder instead of every poll/nudge —
+        the staleness signal survives untouched (is_data_stale is pure),
+        so the retry always happens; it just stops being a busy loop
+        against a poison artifact."""
+        if time.monotonic() < self._backoff_until:
+            return
         if self.is_data_stale() or not self.finished_loading:
             self.load()
 
@@ -602,6 +741,10 @@ class RecommendEngine:
             def finish_native() -> list[tuple[list[str], str]]:
                 from . import native_serve
 
+                # chaos hook ON the completion path — where a real kernel
+                # failure or stall surfaces (delay faults sleep here, fail
+                # faults raise into the batcher's circuit breaker)
+                faults.fire("replica.kernel", replica=idx)
                 # the ctypes call releases the GIL for the whole batch
                 host_ids, _ = native_serve.serve_topk(
                     bundle.host_rule_ids, bundle.host_rule_confs, arr,
@@ -640,6 +783,8 @@ class RecommendEngine:
         self._note_dispatch(idx)
 
         def finish() -> list[tuple[list[str], str]]:
+            # chaos hook on the completion path (see finish_native)
+            faults.fire("replica.kernel", replica=idx)
             host_ids = np.asarray(top_ids)  # blocks on the device transfer
             out: list[tuple[list[str], str]] = []
             for r, seeds in enumerate(seed_sets):
@@ -659,15 +804,25 @@ class RecommendEngine:
         path): ONE kernel invocation serves the whole batch."""
         return self.recommend_many_async(seed_sets)()
 
-    def static_recommendation(self, seed_tracks: list[str]) -> list[str]:
+    def static_recommendation(
+        self, seed_tracks: list[str], deadline: float | None = None
+    ) -> list[str]:
         """Deterministic popular-tracks sample (reference:
-        rest_api/app/main.py:205-222), keyed by a stable hash of the seeds."""
+        rest_api/app/main.py:205-222), keyed by a stable hash of the seeds.
+
+        ``deadline`` (perf_counter seconds) latency-budgets the fallback
+        itself: a request that arrives here with its budget already spent
+        gets the cheapest legitimate answer — the head of the popularity
+        ranking, no hashing or sampling — so the degraded path can never
+        be the thing that blows the deadline further."""
         best = self.best_tracks
         if not best:
             return []
         names = [b["track_name"] for b in best]
-        rng = random.Random(stable_seed(seed_tracks))
         k = min(self.cfg.k_best_tracks, len(names))
+        if deadline is not None and time.perf_counter() >= deadline:
+            return names[:k]
+        rng = random.Random(stable_seed(seed_tracks))
         return rng.sample(names, k)
 
     # ---------- background polling ----------

@@ -56,6 +56,13 @@ class ServingMetrics:
         self.requests_by_source = {"rules": 0, "fallback": 0, "empty": 0}
         self.errors_total = 0
         self.shed_total = 0
+        # fault-tolerance counters: degraded answers by reason (deadline
+        # exhaustion vs total replica loss), plus the batcher's circuit-
+        # breaker events — every recovery event is visible, not just logged
+        self.degraded_by_reason: dict[str, int] = {}
+        self.replica_ejections_total = 0
+        self.replica_readmissions_total = 0
+        self.redispatch_total = 0
         self.latency = LatencyReservoir()
         # per-request latency attribution from the micro-batcher:
         # queue_wait = enqueue→dispatch, device = dispatch→result-on-host
@@ -78,6 +85,26 @@ class ServingMetrics:
     def record_shed(self) -> None:
         with self._lock:
             self.shed_total += 1
+
+    def record_degraded(self, reason: str) -> None:
+        """A request answered from the popularity fallback with an
+        X-KMLS-Degraded header instead of an error."""
+        with self._lock:
+            self.degraded_by_reason[reason] = (
+                self.degraded_by_reason.get(reason, 0) + 1
+            )
+
+    def record_replica_ejected(self) -> None:
+        with self._lock:
+            self.replica_ejections_total += 1
+
+    def record_replica_readmitted(self) -> None:
+        with self._lock:
+            self.replica_readmissions_total += 1
+
+    def record_redispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self.redispatch_total += n
 
     def record_attribution(
         self, queue_wait_s: float, device_s: float, e2e_s: float
@@ -110,12 +137,14 @@ class ServingMetrics:
 
     def render(
         self, reload_counter: int, finished_loading: bool,
-        cache=None, dispatch_counts=None,
+        cache=None, dispatch_counts=None, robustness=None,
     ) -> str:
-        """Prometheus text. ``cache`` (a serving.cache.RecommendCache) and
+        """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
         ``dispatch_counts`` (the engine's per-replica dispatch counters)
-        are optional — deployments without them render exactly the old
-        exposition."""
+        and ``robustness`` (a flat dict of engine/batcher recovery-state
+        values — names ending in ``_total`` render as counters, the rest
+        as gauges, all under a ``kmls_`` prefix) are optional —
+        deployments without them render exactly the old exposition."""
         p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)
         uptime = time.time() - self.started_at
         lines = [
@@ -165,6 +194,39 @@ class ServingMetrics:
                 f'kmls_device_dispatch_total{{device="{i}"}} {count}'
                 for i, count in enumerate(dispatch_counts)
             ]
+        # fault-tolerance exposition: degraded answers by reason + the
+        # circuit breaker's eject/readmit/redispatch counters — always
+        # present (zero-valued when nothing ever degraded), so dashboards
+        # and the chaos bench can rely on the series existing
+        with self._lock:
+            degraded = dict(self.degraded_by_reason)
+            ejections = self.replica_ejections_total
+            readmissions = self.replica_readmissions_total
+            redispatches = self.redispatch_total
+        lines += [
+            "# TYPE kmls_degraded_total counter",
+            f"kmls_degraded_total {sum(degraded.values())}",
+            "# TYPE kmls_degraded_by_reason counter",
+        ]
+        lines += [
+            f'kmls_degraded_by_reason{{reason="{reason}"}} {count}'
+            for reason, count in sorted(degraded.items())
+        ]
+        lines += [
+            "# TYPE kmls_replica_ejections_total counter",
+            f"kmls_replica_ejections_total {ejections}",
+            "# TYPE kmls_replica_readmissions_total counter",
+            f"kmls_replica_readmissions_total {readmissions}",
+            "# TYPE kmls_redispatch_total counter",
+            f"kmls_redispatch_total {redispatches}",
+        ]
+        if robustness:
+            for name, value in robustness.items():
+                mtype = "counter" if name.endswith("_total") else "gauge"
+                lines += [
+                    f"# TYPE kmls_{name} {mtype}",
+                    f"kmls_{name} {value}",
+                ]
         lines += [
             "# TYPE kmls_reloads_total counter",
             f"kmls_reloads_total {reload_counter}",

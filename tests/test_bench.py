@@ -353,6 +353,13 @@ class TestTpuSuiteWiring:
             "per_device_dispatch": [230, 243], "devices_active": 2,
             "n_replicas": 2, "platform": "cpu",
         },
+        "chaos": {
+            "qps": 1000.0, "offered_qps": 950.0, "achieved_qps": 948.0,
+            "p50_ms": 120.0, "p99_ms": 900.0, "errors": 0, "http_5xx": 0,
+            "degraded_answers": 3, "ok_answers": 7997, "redispatched": 4,
+            "ejections": 1, "eject_recovery_ms": 250.0, "zipf_s": 1.1,
+            "cache_hit_ratio": 0.94, "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -878,7 +885,7 @@ class TestBenchStateResume:
         assert set(banked) == {
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
-            "replay_cpu_supp", "replay10k_cpu",
+            "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()

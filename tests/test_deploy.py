@@ -56,7 +56,16 @@ def test_deployment_env_contract_probes_and_tpu():
     assert dep["spec"]["replicas"] == 3
     # the crash-loop fix: readiness gates on /readyz
     assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    # liveness must NOT be /readyz: a degraded pod (bad artifact on the
+    # shared PVC, replicas ejected) answers /readyz 200 ready-but-flagged
+    # and keeps serving — restart-looping it cannot fix on-disk data and
+    # would take all 3 API replicas down over one corrupt file
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    # the fault-tolerance knobs ride the env contract
+    assert {
+        "KMLS_REQUEST_DEADLINE_MS", "KMLS_REPLICA_EJECT_THRESHOLD",
+        "KMLS_REPLICA_PROBE_INTERVAL_S", "KMLS_REDISPATCH_MAX_RETRIES",
+    } <= _env_names(container)
     assert container["resources"]["requests"]["google.com/tpu"]
     assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "fast-api-claim"
 
