@@ -139,9 +139,57 @@ class MiningConfig:
     # Ignored on TPU; falls back automatically when the .so can't build.
     native_cpu_pair_counts: bool = True
 
+    # --- preemption-proofing knobs (checkpoint / lease / watchdog) ---
+    # Phase-level checkpointing: after each expensive phase (encode, mine,
+    # rules) the writer rank persists an atomic, sha256-manifested
+    # checkpoint keyed by a config+dataset fingerprint, so a preempted/
+    # evicted job resumes from the last completed phase instead of
+    # recomputing everything. Retired automatically after a successful
+    # publication (the next rotation run starts fresh).
+    checkpoint_enabled: bool = True
+    # Checkpoint directory; empty = <base_dir>/mining_checkpoint (on the
+    # PVC, so a replacement pod sees its predecessor's progress).
+    checkpoint_dir: str = ""
+    # A checkpoint whose bytes verify but fail to UNPICKLE this many
+    # consecutive loads is quarantined (pickles-style quarantine dir) and
+    # recomputed — one torn read must not cost a good checkpoint, but a
+    # poison one must not wedge every restart. 0 disables quarantining.
+    checkpoint_quarantine_after: int = 2
+    # Lease-fenced publication: the rank-0 writer takes a heartbeat lease
+    # (pickles/publish.lease.json) with a monotonically-increasing fencing
+    # token before mining and re-validates it before every publication
+    # step — a zombie job superseded by an ArgoCD Replace cannot tear
+    # artifacts a newer run already published.
+    lease_enabled: bool = True
+    # A lease whose heartbeat is older than this is expired (its writer
+    # died) and can be taken over by the next job.
+    lease_ttl_s: float = 60.0
+    # Heartbeat period; 0 = ttl/3.
+    lease_heartbeat_interval_s: float = 0.0
+    # Dead-rank watchdog (multi-host jobs only): every rank heartbeats a
+    # shared file every rank_heartbeat_interval_s; a peer silent for
+    # rank_timeout_s turns the would-be forever-hang into a bounded-time
+    # abort with the resumable EXIT_RANK_DEAD code (mining/job.py).
+    # 0 disables.
+    rank_timeout_s: float = 300.0
+    rank_heartbeat_interval_s: float = 5.0
+    # Deadline for one guarded COLLECTIVE section (the mine). Separate
+    # from — and much larger than — rank_timeout_s: the guard brackets
+    # real compute, and a legitimately long mine must not read as a hang
+    # (a shared timeout would livelock every restart into the same
+    # too-long recompute). Keep below the Job's activeDeadlineSeconds;
+    # 0 = 6 × rank_timeout_s.
+    collective_timeout_s: float = 1800.0
+
     @property
     def pickles_dir(self) -> str:
         return os.path.join(self.base_dir, self.pickles_folder)
+
+    @property
+    def checkpoint_path(self) -> str:
+        return self.checkpoint_dir or os.path.join(
+            self.base_dir, "mining_checkpoint"
+        )
 
     @staticmethod
     def from_env(dotenv_path: str | None = ".env") -> "MiningConfig":
@@ -176,6 +224,23 @@ class MiningConfig:
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
             write_manifest=_getenv_bool("KMLS_WRITE_MANIFEST", True),
             native_cpu_pair_counts=_getenv_bool("KMLS_NATIVE_PAIR_COUNTS", True),
+            checkpoint_enabled=_getenv_bool("KMLS_CKPT_ENABLED", True),
+            checkpoint_dir=os.getenv("KMLS_CKPT_DIR", ""),
+            checkpoint_quarantine_after=_getenv_int(
+                "KMLS_CKPT_QUARANTINE_AFTER", 2
+            ),
+            lease_enabled=_getenv_bool("KMLS_LEASE_ENABLED", True),
+            lease_ttl_s=_getenv_float("KMLS_LEASE_TTL_S", 60.0),
+            lease_heartbeat_interval_s=_getenv_float(
+                "KMLS_LEASE_HEARTBEAT_S", 0.0
+            ),
+            rank_timeout_s=_getenv_float("KMLS_RANK_TIMEOUT_S", 300.0),
+            rank_heartbeat_interval_s=_getenv_float(
+                "KMLS_RANK_HEARTBEAT_S", 5.0
+            ),
+            collective_timeout_s=_getenv_float(
+                "KMLS_COLLECTIVE_TIMEOUT_S", 1800.0
+            ),
         )
 
 

@@ -22,6 +22,19 @@ Sites currently wired:
   surfaces: a fail fault raises (exercising the batcher's circuit
   breaker + re-dispatch), a delay fault sleeps (exercising the
   deadline-budgeted degradation path).
+- ``"mine.crash.<phase>"`` — fired by the mining pipeline right AFTER the
+  named phase's checkpoint is persisted (``encode``/``mine``/``rules``):
+  a fail fault aborts the job exactly where a pod eviction or TPU
+  preemption would, so the restarted job must resume from the checkpoint
+  and reproduce bit-identical artifacts.
+- ``"ckpt.corrupt"`` — fired inside :meth:`CheckpointStore.save`: instead
+  of raising, the store corrupts the checkpoint bytes it just wrote
+  (digest recorded over the corrupt bytes, so the next load passes the
+  integrity check but fails to PARSE — the two-strike quarantine path).
+- ``"rank.heartbeat"`` (keyed by rank) — fired in the dead-rank
+  watchdog's heartbeat loop: a fail fault silences that rank's
+  heartbeats from then on, simulating a dead process so peers' watchdogs
+  must convert the would-be forever-hang into a bounded-time abort.
 
 Arming, two ways:
 
@@ -35,7 +48,13 @@ Arming, two ways:
   - ``KMLS_FAULT_REPLICA_FAIL=idx[:N]`` — replica ``idx``'s kernel
     raises on its next N completions (default 1; ``-1`` = forever);
   - ``KMLS_FAULT_REPLICA_DELAY_MS=idx:ms[:N]`` — replica ``idx``'s
-    kernel sleeps ``ms`` per completion (default every completion).
+    kernel sleeps ``ms`` per completion (default every completion);
+  - ``KMLS_FAULT_MINE_CRASH_PHASE=phase[:N]`` — crash the mining job
+    right after checkpointing ``phase`` (N jobs; default 1);
+  - ``KMLS_FAULT_CKPT_CORRUPT=N`` — corrupt the next N checkpoint
+    payloads at save time;
+  - ``KMLS_FAULT_RANK_DEAD=rank`` — silence rank ``rank``'s watchdog
+    heartbeats permanently (a dead multi-host process).
 
 File corruption is a separate concern (faults happen to BYTES, not call
 sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
@@ -164,6 +183,19 @@ def load_env(force: bool = False) -> None:
             delay_s=float(parts[1]) / 1e3,
             times=int(parts[2]) if len(parts) > 2 else -1,
         )
+    raw = os.getenv("KMLS_FAULT_MINE_CRASH_PHASE")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            f"mine.crash.{parts[0]}",
+            times=int(parts[1]) if len(parts) > 1 else 1,
+        )
+    raw = os.getenv("KMLS_FAULT_CKPT_CORRUPT")
+    if raw:
+        inject("ckpt.corrupt", times=int(raw))
+    raw = os.getenv("KMLS_FAULT_RANK_DEAD")
+    if raw:
+        inject("rank.heartbeat", replica=int(raw), times=-1)
 
 
 def _ensure_env() -> None:

@@ -18,7 +18,12 @@ reference could interoperate with this rebuild, and adds:
 - an **integrity manifest** (``artifacts.manifest.json``, sizes + sha256
   per artifact) written after each artifact set, validated by the engine
   before a bundle publishes — a corrupt/torn artifact is detected BEFORE
-  it can poison a reload, and the last-good bundle keeps serving.
+  it can poison a reload, and the last-good bundle keeps serving;
+- a **publication lease** (``publish.lease.json``: heartbeat + monotonic
+  fencing token, :class:`PublicationLease`) so a zombie mining job left
+  behind by the GitOps ``Replace`` resync cannot tear artifacts a newer
+  run already published — the manifest records the fencing token of the
+  generation that wrote it.
 """
 
 from __future__ import annotations
@@ -28,7 +33,9 @@ import io
 import json
 import os
 import pickle
+import socket
 import tempfile
+import threading
 import time
 from typing import Any
 
@@ -137,7 +144,10 @@ def file_digest(path: str) -> dict[str, Any]:
 
 
 def write_manifest(
-    pickles_dir: str, filenames: list[str], token: str | None = None
+    pickles_dir: str,
+    filenames: list[str],
+    token: str | None = None,
+    fencing_token: int | None = None,
 ) -> str:
     """Write the integrity sidecar for an artifact set: size + sha256 per
     file, atomically, AFTER the artifacts themselves (the mining job calls
@@ -155,6 +165,11 @@ def write_manifest(
     token, the stale manifest stops matching, and validation steps aside
     instead of quarantining good bytes.
 
+    ``fencing_token`` records the publication lease's monotonic fencing
+    token (see :class:`PublicationLease`): which WRITER GENERATION
+    produced this artifact set, so engine-side tooling and post-mortems
+    can tell a zombie's manifest from the current writer's.
+
     Files that don't exist are skipped (e.g. the npz with
     KMLS_WRITE_TENSOR_ARTIFACT off). → the manifest path."""
     files: dict[str, Any] = {}
@@ -163,15 +178,15 @@ def write_manifest(
         if os.path.exists(path):
             files[name] = file_digest(path)
     out = manifest_path(pickles_dir)
+    payload: dict[str, Any] = {
+        "version": 1, "written_at": time.time(),
+        "token": token, "files": files,
+    }
+    if fencing_token is not None:
+        payload["fencing_token"] = fencing_token
     _atomic_write_bytes(
         out,
-        json.dumps(
-            {
-                "version": 1, "written_at": time.time(),
-                "token": token, "files": files,
-            },
-            indent=1, sort_keys=True,
-        ).encode("utf-8"),
+        json.dumps(payload, indent=1, sort_keys=True).encode("utf-8"),
     )
     return out
 
@@ -239,6 +254,206 @@ def quarantine_file(path: str) -> str | None:
         return dest
     except OSError:
         return None
+
+
+# ---------- lease-fenced publication ----------
+
+
+LEASE_FILENAME = "publish.lease.json"
+
+
+class LeaseHeldError(RuntimeError):
+    """Another writer holds a live publication lease. Resumable: the k8s
+    Job retries after backoff, and wins once the holder finishes or its
+    heartbeat expires."""
+
+
+class LeaseLostError(RuntimeError):
+    """This writer's lease was superseded (a newer fencing token is on
+    disk) — it is a ZOMBIE and must not publish."""
+
+
+def lease_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, LEASE_FILENAME)
+
+
+def _read_lease(pickles_dir: str) -> dict[str, Any] | None:
+    try:
+        with open(lease_path(pickles_dir), "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+class PublicationLease:
+    """Heartbeat lease + monotonic fencing token over the artifact set.
+
+    The reference's GitOps loop recreates the mining Job with ArgoCD
+    ``Force=true,Replace=true`` — which can leave a ZOMBIE of the previous
+    run alive (slow termination, a hung TPU host) while its replacement is
+    already mining. Without fencing, the zombie's late artifact writes
+    would tear or roll back what the newer run published. The fix is the
+    classic fencing-token protocol:
+
+    - :meth:`acquire` reads the lease file; a live lease (not released,
+      heartbeat younger than its TTL) → :class:`LeaseHeldError` (the
+      caller exits resumable and retries under k8s backoff). A dead or
+      released lease is taken over with ``fencing_token = previous + 1``
+      — the token only ever increases, across arbitrarily many writer
+      generations.
+    - a background heartbeat (:meth:`start_heartbeat`) refreshes
+      ``heartbeat_at`` every ``ttl/3`` so a LIVE writer is never
+      expropriated mid-mine, no matter how long the mine takes.
+    - :meth:`check` re-reads the file and raises :class:`LeaseLostError`
+      the moment a newer (owner, token) is on disk. The pipeline calls it
+      immediately before its first artifact write AND immediately before
+      the invalidation-token rewrite, so a fenced zombie aborts without
+      having torn anything.
+
+    The lease file lives on the same PVC as the artifacts it guards
+    (atomic tmp+rename writes). Acquisition is read-modify-write with a
+    read-back confirmation — not a true CAS, which a shared POSIX FS
+    cannot provide — so two same-instant acquirers may both think they
+    won briefly; the loser's next :meth:`check`/heartbeat sees the other
+    (owner, token) on disk and self-fences. That is exactly the fail-safe
+    direction: over-fencing costs a retry, under-fencing would cost data.
+    """
+
+    def __init__(
+        self,
+        pickles_dir: str,
+        owner: str,
+        fencing_token: int,
+        ttl_s: float,
+        heartbeat_interval_s: float | None = None,
+    ):
+        self.pickles_dir = pickles_dir
+        self.owner = owner
+        self.fencing_token = fencing_token
+        self.ttl_s = ttl_s
+        self.heartbeat_interval_s = heartbeat_interval_s or max(ttl_s / 3, 0.05)
+        self.lost = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @classmethod
+    def acquire(
+        cls,
+        pickles_dir: str,
+        ttl_s: float = 60.0,
+        owner: str | None = None,
+        heartbeat_interval_s: float | None = None,
+    ) -> "PublicationLease":
+        """Take the publication lease or raise :class:`LeaseHeldError`."""
+        owner = owner or (
+            f"{socket.gethostname()}:{os.getpid()}:{os.urandom(4).hex()}"
+        )
+        current = _read_lease(pickles_dir)
+        prev_token = 0
+        if current is not None:
+            prev_token = int(current.get("fencing_token", 0))
+            age = time.time() - float(current.get("heartbeat_at", 0.0))
+            live = not current.get("released") and age < float(
+                current.get("ttl_s", ttl_s)
+            )
+            if live and current.get("owner") != owner:
+                raise LeaseHeldError(
+                    f"publication lease held by {current.get('owner')!r} "
+                    f"(token {prev_token}, heartbeat {age:.1f}s ago, ttl "
+                    f"{current.get('ttl_s')}s)"
+                )
+        lease = cls(
+            pickles_dir, owner, prev_token + 1, ttl_s, heartbeat_interval_s
+        )
+        lease._write()
+        # read-back: in a same-instant race the later rename wins; the
+        # loser must find out NOW, not at publication time
+        lease.check()
+        return lease
+
+    def _write(self, released: bool = False) -> None:
+        _atomic_write_bytes(
+            lease_path(self.pickles_dir),
+            json.dumps(
+                {
+                    "version": 1,
+                    "owner": self.owner,
+                    "fencing_token": self.fencing_token,
+                    "ttl_s": self.ttl_s,
+                    "heartbeat_at": time.time(),
+                    "released": released,
+                },
+                indent=1, sort_keys=True,
+            ).encode("utf-8"),
+        )
+
+    def check(self) -> None:
+        """Raise :class:`LeaseLostError` unless the on-disk lease is still
+        (our owner, our token) and unreleased. Sticky: once lost, always
+        lost — a released lease is lost too (this handle gave it up; any
+        later write through it would race the next acquirer)."""
+        if not self.lost:
+            current = _read_lease(self.pickles_dir)
+            if (
+                current is not None
+                and current.get("owner") == self.owner
+                and int(current.get("fencing_token", -1)) == self.fencing_token
+                and not current.get("released")
+            ):
+                return
+            self.lost = True
+        raise LeaseLostError(
+            f"publication lease (token {self.fencing_token}) superseded — "
+            "this writer is a zombie and must not publish"
+        )
+
+    def heartbeat(self) -> None:
+        """One ownership-checked heartbeat (raises when fenced)."""
+        self.check()
+        self._write()
+
+    def start_heartbeat(self) -> None:
+        """Refresh the lease every ``heartbeat_interval_s`` until
+        :meth:`stop_heartbeat` — or until fenced, which stops silently
+        (the publication-path :meth:`check` raises the loud error)."""
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.heartbeat_interval_s):
+                try:
+                    self.heartbeat()
+                except (LeaseLostError, OSError):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="kmls-lease-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def release(self) -> None:
+        """Mark the lease released (token RETAINED — the next acquirer
+        still increments past it; monotonicity is the whole point).
+
+        Called on BOTH the success path and a Python-level abort (the
+        pipeline's except block): an exiting process provably writes
+        nothing more, so handing the lease back immediately beats making
+        its own k8s-restarted successor wait out the TTL. Only a hard
+        kill (SIGKILL preemption) leaves the lease to expiry.
+
+        Stops the heartbeat thread FIRST: a beat racing the release could
+        land after ``released: true`` and resurrect the lease, making the
+        next acquirer wait out the TTL against a dead owner."""
+        self.stop_heartbeat()
+        self.check()
+        self._write(released=True)
 
 
 def save_rule_tensors(

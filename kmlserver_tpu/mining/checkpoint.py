@@ -1,0 +1,333 @@
+"""Phase-level mining checkpoints — preemption-proofing the batch job.
+
+The reference's GitOps loop literally KILLS the mining Job on every resync
+(``Force=true,Replace=true`` pseudo-CronJob), and on TPU node pools the
+scheduler preempts batch pods at will — so before this module, any eviction
+mid-mine lost all progress (config 4 mines for 78 s; a dead rank hung the
+multi-host job forever). The fix is the standard training-stack recipe
+(preemption-safe restart is table stakes in ALX / ads-training
+infrastructure — PAPERS.md): after each expensive phase the writer rank
+persists the phase's host-side payload to the PVC, and a restarted job
+resumes from the last completed phase, producing bit-identical final
+artifacts.
+
+Correctness is guarded on three axes:
+
+- **fingerprint**: the store is keyed by a sha256 over the mining-relevant
+  config fields + the selected dataset's bytes + the rotation index. A
+  checkpoint written for a different config or dataset NEVER resumes — the
+  whole store self-retires to full recompute (it is stale state, not
+  evidence of corruption, so it is deleted rather than quarantined).
+- **integrity**: each payload is pickled, written atomically
+  (tmp + ``os.replace``), and manifested with size + sha256 in the store's
+  ``state.json``. Bytes that disagree with the manifest (a torn write, bit
+  rot) retire that phase to recompute on the spot.
+- **parse strikes**: bytes that VERIFY but fail to unpickle are a poison
+  payload (e.g. written corrupt — ``KMLS_FAULT_CKPT_CORRUPT`` fires
+  exactly this). One failure could be bad luck; after
+  ``quarantine_after`` consecutive failures the file moves to the same
+  quarantine dir the serving artifacts use (``io.artifacts
+  .quarantine_file``) so restarts stop re-tripping on it and the bytes
+  stay inspectable.
+
+Multi-host discipline: every rank READS the store (the completed-phase set
+is snapshotted once at job start, so all ranks make the same skip
+decisions and the collectives stay aligned); only the writer rank SAVES.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any
+
+from .. import faults
+from ..config import MiningConfig
+from ..io.artifacts import _atomic_write_bytes, file_digest, quarantine_file
+
+# ordered checkpoint phases of the mining pipeline (mining/pipeline.py):
+# encode  — CSV read + vocab/aux artifacts + basket encoding
+# mine    — frequent-itemset mining + rule-tensor extraction (the device
+#           compute; by far the dominant cost at scale)
+# rules   — expansion of the rule tensors into the reference's pickle dict
+PHASES = ("encode", "mine", "rules")
+
+STATE_FILENAME = "state.json"
+CKPT_VERSION = 1
+
+# MiningConfig fields that can change the bytes of the final artifacts (or
+# of any phase payload). Anything NOT listed — dispatch/backend knobs like
+# bitpack_threshold_elems, sharded_impl, native_cpu_pair_counts — selects a
+# different route to the SAME exact result (the miner's dominance/exactness
+# guarantees), so a checkpoint survives e.g. a TPU-to-CPU restart.
+_FINGERPRINT_FIELDS = (
+    "min_support",
+    "sample_ratio",
+    "top_tracks_save_percentile",
+    "max_itemset_len",
+    "k_max_consequents",
+    "confidence_mode",
+    "min_confidence",
+    "prune_vocab_threshold",
+)
+
+
+def compute_fingerprint(
+    cfg: MiningConfig, dataset_path: str, run_index: int
+) -> str:
+    """The config+dataset identity a checkpoint is keyed by."""
+    ident: dict[str, Any] = {
+        "version": CKPT_VERSION,
+        "run_index": run_index,
+        "dataset": os.path.basename(dataset_path),
+        "dataset_digest": file_digest(dataset_path),
+    }
+    for field in _FINGERPRINT_FIELDS:
+        ident[field] = getattr(cfg, field)
+    blob = json.dumps(ident, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+@dataclasses.dataclass
+class ResumeInfo:
+    """What :meth:`CheckpointStore.load` actually did, for the job log."""
+
+    phase: str
+    age_s: float
+
+
+class CheckpointStore:
+    """One mining run's phase checkpoints under ``directory``.
+
+    ``writer=False`` (non-zero ranks of a multi-host job) reads but never
+    mutates the shared store — no saves, no retires, no strike counting.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        fingerprint: str,
+        quarantine_after: int = 2,
+        writer: bool = True,
+    ):
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.quarantine_after = quarantine_after
+        self.writer = writer
+        self._state = self._load_state()
+        # snapshotted ONCE: phases completed by a PREVIOUS incarnation.
+        # Mid-run saves are deliberately not re-read — on a multi-host job
+        # every rank must make identical skip decisions from identical
+        # state, or the collectives desynchronize.
+        self.completed: frozenset[str] = frozenset(self._state["phases"])
+
+    # ---------- state file ----------
+
+    def _state_path(self) -> str:
+        return os.path.join(self.directory, STATE_FILENAME)
+
+    def _phase_path(self, phase: str) -> str:
+        return os.path.join(self.directory, f"{phase}.ckpt")
+
+    def _load_state(self) -> dict[str, Any]:
+        empty: dict[str, Any] = {
+            "version": CKPT_VERSION,
+            "fingerprint": self.fingerprint,
+            "phases": {},
+        }
+        try:
+            with open(self._state_path(), "r", encoding="utf-8") as fh:
+                state = json.load(fh)
+            if not isinstance(state.get("phases"), dict):
+                raise ValueError("malformed checkpoint state")
+        except FileNotFoundError:
+            return empty
+        except (OSError, ValueError):
+            # unreadable state: nothing in the store can be trusted
+            print("Mining checkpoint state unreadable — retiring to full recompute")
+            self._retire_all()
+            return empty
+        if state.get("fingerprint") != self.fingerprint or state.get(
+            "version"
+        ) != CKPT_VERSION:
+            # a different config/dataset/format wrote this: STALE, not
+            # corrupt — delete rather than quarantine, recompute fully
+            print(
+                "Mining checkpoint fingerprint mismatch (config or dataset "
+                "changed) — ignoring and retiring the stale checkpoint"
+            )
+            self._retire_all()
+            return empty
+        return state
+
+    def _write_state(self) -> None:
+        _atomic_write_bytes(
+            self._state_path(),
+            json.dumps(self._state, indent=1, sort_keys=True).encode("utf-8"),
+        )
+
+    def _retire_all(self) -> None:
+        if not self.writer:
+            return
+        try:
+            for name in os.listdir(self.directory):
+                if name == STATE_FILENAME or name.endswith(".ckpt"):
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+    def _drop_phase(self, phase: str) -> None:
+        """Retire one phase to recompute (torn/rotted bytes). Writer only —
+        a reader rank must not mutate the shared store."""
+        if not self.writer:
+            return
+        try:
+            os.unlink(self._phase_path(phase))
+        except OSError:
+            pass
+        if self._state["phases"].pop(phase, None) is not None:
+            self._write_state()
+
+    # ---------- the phase API ----------
+
+    def load(self, phase: str) -> Any | None:
+        """The phase's verified payload, or None → recompute.
+
+        None paths: never completed; digest mismatch (torn/rotted bytes —
+        phase retires immediately); unpickle failure (strike; quarantined
+        after ``quarantine_after`` consecutive strikes)."""
+        if phase not in self.completed:
+            return None
+        entry = self._state["phases"].get(phase)
+        path = self._phase_path(phase)
+        if entry is None or not os.path.exists(path):
+            return None
+        try:
+            digest = file_digest(path)
+        except OSError:
+            return None
+        if (
+            digest["bytes"] != entry.get("bytes")
+            or digest["sha256"] != entry.get("sha256")
+        ):
+            print(
+                f"Checkpoint phase {phase!r} fails its sha256 manifest — "
+                "retiring to recompute"
+            )
+            self._drop_phase(phase)
+            return None
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+        except Exception:
+            strikes = int(entry.get("load_failures", 0)) + 1
+            if self.writer:
+                entry["load_failures"] = strikes
+                if self.quarantine_after and strikes >= self.quarantine_after:
+                    dest = quarantine_file(path)
+                    print(
+                        f"Checkpoint phase {phase!r} failed parsing "
+                        f"{strikes}x — quarantined to {dest}"
+                    )
+                    self._state["phases"].pop(phase, None)
+                else:
+                    print(
+                        f"Checkpoint phase {phase!r} failed parsing "
+                        f"(strike {strikes}/{self.quarantine_after}) — "
+                        "recomputing"
+                    )
+                self._write_state()
+            return None
+        return payload
+
+    def age_s(self, phase: str) -> float:
+        entry = self._state["phases"].get(phase) or {}
+        saved = float(entry.get("saved_at", 0.0))
+        return max(time.time() - saved, 0.0) if saved else 0.0
+
+    def save(self, phase: str, payload: Any) -> str | None:
+        """Persist the phase payload atomically + manifest it. Writer rank
+        only (no-op otherwise). The ``ckpt.corrupt`` fault site corrupts
+        the BYTES here (digest recorded over the corrupt bytes), modeling
+        a writer that silently produced garbage — the next load then
+        passes integrity but fails parsing, the two-strike path."""
+        if not self.writer:
+            return None
+        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            faults.fire("ckpt.corrupt")
+        except faults.FaultInjected:
+            # truncation, not a bit flip: a flipped byte inside a pickled
+            # string still parses (to wrong data); a truncated stream
+            # deterministically fails to UNPICKLE while its digest —
+            # recorded below over the corrupt bytes — still verifies.
+            # That is the poison-payload shape the strike path exists for.
+            data = data[: max(len(data) // 2, 1)]
+        path = self._phase_path(phase)
+        _atomic_write_bytes(path, data)
+        self._state["phases"][phase] = {
+            "bytes": len(data),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "saved_at": time.time(),
+            "load_failures": 0,
+        }
+        self._write_state()
+        return path
+
+    def clear(self) -> None:
+        """Retire the whole store after a successful publication — the next
+        rotation run mines a different dataset and must start fresh (and a
+        SAME-dataset re-run re-mining to a fresh token should re-pay its
+        compute rather than silently replaying this run's)."""
+        if not self.writer:
+            return
+        self._retire_all()
+        self._state = {
+            "version": CKPT_VERSION,
+            "fingerprint": self.fingerprint,
+            "phases": {},
+        }
+        self.completed = frozenset()
+
+
+def open_store(
+    cfg: MiningConfig, dataset_path: str, run_index: int, writer: bool
+) -> CheckpointStore | None:
+    """The pipeline's one constructor: None when checkpointing is off."""
+    if not cfg.checkpoint_enabled:
+        return None
+    directory = cfg.checkpoint_path
+    if writer:
+        os.makedirs(directory, exist_ok=True)
+    elif not os.path.isdir(directory):
+        # non-writer before the writer ever created the dir: nothing to
+        # resume, and creating it isn't this rank's job
+        return None
+    return CheckpointStore(
+        directory,
+        compute_fingerprint(cfg, dataset_path, run_index),
+        quarantine_after=cfg.checkpoint_quarantine_after,
+        writer=writer,
+    )
+
+
+def heartbeat_dir(cfg: MiningConfig) -> str:
+    """Where the dead-rank watchdog's per-rank heartbeat files live —
+    under the checkpoint dir so one PVC path owns all resume state."""
+    return os.path.join(cfg.checkpoint_path, "heartbeats")
+
+
+__all__ = [
+    "PHASES",
+    "CheckpointStore",
+    "compute_fingerprint",
+    "open_store",
+    "heartbeat_dir",
+]

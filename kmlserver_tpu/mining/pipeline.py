@@ -7,6 +7,28 @@ invalidation-token rewrite — with the same printed progress/timing lines the
 reference's report reads off the pod logs (Sao Paulo timestamps at :423,431;
 "Time elapsed in rule generation" from :306-308; missing-songs counter
 from :298-305).
+
+Preemption-proofing (ISSUE 4) restructures the run into three checkpointed
+phases (``mining/checkpoint.py``):
+
+- **encode** — CSV read, vocab validation/aux maps, basket encoding;
+- **mine**   — frequent-itemset mining + rule-tensor extraction (the
+  device compute, the dominant cost at scale);
+- **rules**  — expansion into the reference's pickle dict.
+
+After each phase the writer rank persists an atomic sha256-manifested
+checkpoint keyed by a config+dataset fingerprint; a restarted job resumes
+from the last completed phase and publishes bit-identical pickles, while a
+stale or corrupt checkpoint self-retires to recompute. ALL artifact writes
+now happen in one publication step AFTER the phases — a job that dies
+mid-phase leaves the PVC's served artifact set untouched (the reference
+wrote vocab artifacts early, so an eviction could strand a half-new set;
+the READ contract — filenames, object shapes, token polling — is
+unchanged). Publication itself is fenced by a heartbeat lease with a
+monotonic fencing token (``io/artifacts.py PublicationLease``): a zombie
+job superseded by the GitOps ``Replace`` resync aborts instead of tearing
+what the newer run published; the manifest records the token. The
+checkpoint store is retired after a successful publication.
 """
 
 from __future__ import annotations
@@ -16,10 +38,12 @@ import os
 
 import jax
 
+from .. import faults
 from ..config import BASE_INDEX, MiningConfig
 from ..data.csv import read_tracks
 from ..io import artifacts, registry
 from ..utils.timeutil import get_current_time_str, get_current_time_str_precise
+from . import checkpoint as ckpt_mod
 from . import vocab as vocab_mod
 from .miner import MiningResult, mine
 
@@ -35,63 +59,46 @@ class JobSummary:
     rule_generation_s: float
     token: str
     artifact_paths: dict[str, str]
+    # phases skipped because a verified checkpoint covered them
+    resumed_phases: tuple[str, ...] = ()
+    # the publication lease's fencing token (None: lease disabled / reader)
+    fencing_token: int | None = None
 
 
 def _pickle_path(cfg: MiningConfig, filename: str) -> str:
     return os.path.join(cfg.pickles_dir, filename)
 
 
-def run_mining_job(
-    cfg: MiningConfig, mesh: "jax.sharding.Mesh | None" = None
-) -> JobSummary:
-    print(f"Job starting at {get_current_time_str()}")
+def _crash_site(phase: str) -> None:
+    """Deterministic preemption stand-in: ``KMLS_FAULT_MINE_CRASH_PHASE``
+    aborts the job right AFTER ``phase``'s checkpoint persisted — the
+    restarted job must resume from it (chaos-tested at every phase)."""
+    faults.fire(f"mine.crash.{phase}")
 
-    # Multi-host: every rank participates in the sharded compute (the
-    # collectives need all processes), but only rank 0 touches the shared
-    # PVC — duplicate history appends would corrupt the dataset rotation,
-    # and concurrent artifact writes could tear what the API replicas read.
-    is_writer = jax.process_index() == 0
 
-    datasets = registry.get_dataset_list(cfg, persist=is_writer)
-    run_index = registry.get_next_run_index(cfg, datasets)
-    selected = datasets[run_index - BASE_INDEX]
-    print(f"Selected dataset {run_index}/{len(datasets)}: {selected}")
-
+def _run_encode_phase(cfg: MiningConfig, selected: str) -> dict:
+    """CSV read + vocab validation/aux maps + basket encoding."""
     table = read_tracks(selected, cfg.sample_ratio)
     print(
         f"Loaded {len(table)} rows, {table.n_playlists} playlists, "
         f"{table.n_tracks} unique tracks"
     )
-
-    paths: dict[str, str] = {}
-
-    # auxiliary vocab artifacts (reference M5-M8: main.py:438-446)
     artists = vocab_mod.validate_and_map_artists(table)
-    if is_writer:
-        paths["artists_mapping"] = _pickle_path(cfg, cfg.artists_mapping_file)
-        artifacts.save_pickle(artists, paths["artists_mapping"])
-
     repeated = vocab_mod.extract_repeated_track_names(table)
-    if repeated and is_writer:
-        # the reference saves this one conditionally (main.py:86-109)
-        paths["repeated_tracks"] = _pickle_path(cfg, cfg.repeated_tracks_file)
-        artifacts.save_pickle(repeated, paths["repeated_tracks"])
-
     info = vocab_mod.map_track_ids_to_info(table)
     best = vocab_mod.most_frequent_tracks(table, cfg.top_tracks_save_percentile)
-    if is_writer:
-        paths["track_info"] = _pickle_path(cfg, cfg.track_info_file)
-        artifacts.save_pickle(info, paths["track_info"])
-        paths["best_tracks"] = _pickle_path(cfg, cfg.best_tracks_file)
-        artifacts.save_pickle(best, paths["best_tracks"])
-        print(
-            f"Saved {len(best)} best tracks "
-            f"(top {cfg.top_tracks_save_percentile:.0%})"
-        )
-
-    # the compute core
     baskets = vocab_mod.build_baskets(table)
-    result: MiningResult = mine(baskets, cfg, mesh=mesh)
+    return {
+        "n_rows": len(table),
+        "artists": artists,
+        "repeated": repeated,
+        "info": info,
+        "best": best,
+        "baskets": baskets,
+    }
+
+
+def _report_mining(result: MiningResult, cfg: MiningConfig) -> None:
     tensors = result.tensors
     if result.pruned_vocab is not None:
         print(
@@ -119,65 +126,190 @@ def run_mining_job(
             f"to the highest-support rules)"
         )
 
-    rules_dict = tensors.to_rules_dict(result.vocab_names)
-    token = ""
-    if is_writer:
-        # the token value is generated BEFORE the manifest so the manifest
-        # can be stamped with the generation it describes — readers
-        # validate only when the published token matches the stamp
-        token_value = get_current_time_str_precise()
-        paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
-        artifacts.save_pickle(rules_dict, paths["recommendations"])
-        if cfg.write_tensor_artifact:
-            paths["rule_tensors"] = artifacts.tensor_artifact_path(
-                paths["recommendations"]
+
+def run_mining_job(
+    cfg: MiningConfig,
+    mesh: "jax.sharding.Mesh | None" = None,
+    watchdog=None,
+) -> JobSummary:
+    print(f"Job starting at {get_current_time_str()}")
+
+    # Multi-host: every rank participates in the sharded compute (the
+    # collectives need all processes), but only rank 0 touches the shared
+    # PVC — duplicate history appends would corrupt the dataset rotation,
+    # and concurrent artifact writes could tear what the API replicas read.
+    is_writer = jax.process_index() == 0
+
+    datasets = registry.get_dataset_list(cfg, persist=is_writer)
+    run_index = registry.get_next_run_index(cfg, datasets)
+    selected = datasets[run_index - BASE_INDEX]
+    print(f"Selected dataset {run_index}/{len(datasets)}: {selected}")
+
+    # checkpoint store keyed by config+dataset fingerprint; every rank
+    # reads (identical skip decisions keep the collectives aligned), the
+    # writer saves. The completed-phase set is snapshotted at open time.
+    store = ckpt_mod.open_store(cfg, selected, run_index, writer=is_writer)
+    resumed: list[str] = []
+
+    def phase(name: str, compute):
+        """Resume ``name`` from its checkpoint or compute + persist it.
+        The crash fault site fires AFTER the save — exactly where a
+        preemption that already banked the phase would land."""
+        payload = store.load(name) if store is not None else None
+        if payload is not None:
+            resumed.append(name)
+            print(
+                f"Resumed phase {name!r} from checkpoint "
+                f"({store.age_s(name):.0f}s old)"
             )
-            artifacts.save_rule_tensors(
-                paths["rule_tensors"],
-                vocab=result.vocab_names,
-                rule_ids=tensors.rule_ids,
-                rule_counts=tensors.rule_counts,
-                item_counts=tensors.item_counts,
-                n_playlists=result.n_playlists,
-                min_support=cfg.min_support,
-                mode=tensors.mode,
-                min_confidence=tensors.min_confidence,
-                rule_confs64=tensors.rule_confs64,
-            )
-        if cfg.write_manifest:
-            # integrity sidecar AFTER the artifact set, BEFORE the token:
-            # any reader that sees the new token sees a manifest matching
-            # the new bytes; a reader racing mid-update detects the
-            # mismatch and keeps serving its last-good bundle (engine.load
-            # validates before publishing). Stamped with the token value
-            # about to publish, so a LATER manifest-less writer (the
-            # reference job) retires this manifest just by rewriting the
-            # token — its fresh artifacts are never judged by stale sums.
-            paths["manifest"] = artifacts.write_manifest(
-                cfg.pickles_dir,
-                [
-                    cfg.best_tracks_file,
-                    cfg.recommendations_file,
-                    cfg.recommendations_file + artifacts.TENSOR_ARTIFACT_SUFFIX,
-                    cfg.artists_mapping_file,
-                    cfg.track_info_file,
-                    cfg.repeated_tracks_file,
-                ],
-                token=token_value,
-            )
-        token = registry.append_history_and_invalidate(
-            cfg, run_index, selected, timestamp=token_value
+            return payload
+        payload = compute()
+        if store is not None:
+            store.save(name, payload)
+        _crash_site(name)
+        return payload
+
+    # the writer takes the publication lease BEFORE the expensive phases:
+    # its heartbeats prove liveness for the whole mine, and a superseding
+    # run (GitOps Replace) fences this one out before it can publish.
+    lease = None
+    if is_writer and cfg.lease_enabled:
+        lease = artifacts.PublicationLease.acquire(
+            cfg.pickles_dir,
+            ttl_s=cfg.lease_ttl_s,
+            heartbeat_interval_s=cfg.lease_heartbeat_interval_s or None,
         )
+        lease.start_heartbeat()
+        print(f"Publication lease acquired (fencing token {lease.fencing_token})")
+
+    try:
+        encoded = phase("encode", lambda: _run_encode_phase(cfg, selected))
+        baskets = encoded["baskets"]
+
+        def _mine() -> MiningResult:
+            if watchdog is not None:
+                # collective guard: a dead/hung peer rank turns the mine's
+                # collectives into a forever-hang — bound it
+                with watchdog.guard("mine"):
+                    return mine(baskets, cfg, mesh=mesh)
+            return mine(baskets, cfg, mesh=mesh)
+
+        result: MiningResult = phase("mine", _mine)
+        _report_mining(result, cfg)
+        tensors = result.tensors
+
+        rules_dict = phase(
+            "rules", lambda: tensors.to_rules_dict(result.vocab_names)
+        )
+
+        # ---------- publication (writer only, lease-fenced) ----------
+        paths: dict[str, str] = {}
+        token = ""
+        if is_writer:
+            if lease is not None:
+                # fence point 1: a zombie aborts BEFORE its first write
+                lease.check()
+            paths["artists_mapping"] = _pickle_path(cfg, cfg.artists_mapping_file)
+            artifacts.save_pickle(encoded["artists"], paths["artists_mapping"])
+            if encoded["repeated"]:
+                # the reference saves this one conditionally (main.py:86-109)
+                paths["repeated_tracks"] = _pickle_path(
+                    cfg, cfg.repeated_tracks_file
+                )
+                artifacts.save_pickle(
+                    encoded["repeated"], paths["repeated_tracks"]
+                )
+            paths["track_info"] = _pickle_path(cfg, cfg.track_info_file)
+            artifacts.save_pickle(encoded["info"], paths["track_info"])
+            paths["best_tracks"] = _pickle_path(cfg, cfg.best_tracks_file)
+            artifacts.save_pickle(encoded["best"], paths["best_tracks"])
+            print(
+                f"Saved {len(encoded['best'])} best tracks "
+                f"(top {cfg.top_tracks_save_percentile:.0%})"
+            )
+
+            # the token value is generated BEFORE the manifest so the
+            # manifest can be stamped with the generation it describes —
+            # readers validate only when the published token matches
+            token_value = get_current_time_str_precise()
+            paths["recommendations"] = _pickle_path(cfg, cfg.recommendations_file)
+            artifacts.save_pickle(rules_dict, paths["recommendations"])
+            if cfg.write_tensor_artifact:
+                paths["rule_tensors"] = artifacts.tensor_artifact_path(
+                    paths["recommendations"]
+                )
+                artifacts.save_rule_tensors(
+                    paths["rule_tensors"],
+                    vocab=result.vocab_names,
+                    rule_ids=tensors.rule_ids,
+                    rule_counts=tensors.rule_counts,
+                    item_counts=tensors.item_counts,
+                    n_playlists=result.n_playlists,
+                    min_support=cfg.min_support,
+                    mode=tensors.mode,
+                    min_confidence=tensors.min_confidence,
+                    rule_confs64=tensors.rule_confs64,
+                )
+            if cfg.write_manifest:
+                # integrity sidecar AFTER the artifact set, BEFORE the token:
+                # any reader that sees the new token sees a manifest matching
+                # the new bytes; a reader racing mid-update detects the
+                # mismatch and keeps serving its last-good bundle (engine.load
+                # validates before publishing). Stamped with the token value
+                # about to publish, so a LATER manifest-less writer (the
+                # reference job) retires this manifest just by rewriting the
+                # token — its fresh artifacts are never judged by stale sums.
+                paths["manifest"] = artifacts.write_manifest(
+                    cfg.pickles_dir,
+                    [
+                        cfg.best_tracks_file,
+                        cfg.recommendations_file,
+                        cfg.recommendations_file + artifacts.TENSOR_ARTIFACT_SUFFIX,
+                        cfg.artists_mapping_file,
+                        cfg.track_info_file,
+                        cfg.repeated_tracks_file,
+                    ],
+                    token=token_value,
+                    fencing_token=lease.fencing_token if lease else None,
+                )
+            if lease is not None:
+                # fence point 2: the last instant a zombie can be stopped
+                # before the token rewrite makes its stale set authoritative
+                lease.check()
+            token = registry.append_history_and_invalidate(
+                cfg, run_index, selected, timestamp=token_value
+            )
+            if store is not None:
+                # published: the next rotation run must start fresh
+                store.clear()
+            if lease is not None:
+                lease.release()
+    except BaseException:
+        if lease is not None:
+            # a Python-level abort releases: this process writes nothing
+            # more, and the replacement pod must not wait out the TTL.
+            # Hard kills (SIGKILL preemption) skip this and expire instead.
+            lease.stop_heartbeat()
+            try:
+                lease.release()
+            except (artifacts.LeaseLostError, OSError):
+                pass  # already fenced/unwritable: nothing to hand back
+        raise
+    finally:
+        if lease is not None:
+            lease.stop_heartbeat()
     print(f"Job finished at {get_current_time_str()}")
 
     return JobSummary(
         dataset=selected,
         run_index=run_index,
-        n_rows=len(table),
+        n_rows=encoded["n_rows"],
         n_playlists=result.n_playlists,
         n_tracks=result.n_tracks,
         n_songs_missing=tensors.n_songs_missing,
         rule_generation_s=result.duration_s,
         token=token,
         artifact_paths=paths,
+        resumed_phases=tuple(resumed),
+        fencing_token=lease.fencing_token if lease else None,
     )

@@ -33,13 +33,17 @@ host) dimension. ``make_hybrid_mesh`` encodes exactly that.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
+import time
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import faults
 from .mesh import AXIS_DP, AXIS_TP
 
 logger = logging.getLogger("kmlserver_tpu.distributed")
@@ -91,6 +95,222 @@ def maybe_initialize() -> bool:
     )
     _initialized = True
     return True
+
+
+# ---------- dead-rank watchdog ----------
+
+
+class RankWatchdog:
+    """Bounded-time abort for the multi-host forever-hang.
+
+    XLA collectives have no application-level timeout: when one rank of a
+    multi-host mining job dies (TPU preemption, pod eviction, OOM-kill),
+    every surviving rank blocks in the next collective FOREVER — the Job
+    never fails, never retries, and holds its TPU slice until a human
+    notices. This watchdog turns that into a bounded-time, *retryable*
+    failure with two independent detectors:
+
+    - **peer heartbeats**: every rank's writer thread touches
+      ``<dir>/rank<N>.hb`` (a shared-PVC file carrying ``time.time()``)
+      every ``heartbeat_interval_s``; the monitor thread aborts when any
+      peer's heartbeat is older than ``timeout_s``. Catches a DEAD
+      process (its heartbeat thread died with it).
+    - **collective guard**: :meth:`guard` brackets a collective section
+      with a deadline (``collective_timeout_s``, default 6× the
+      staleness timeout); the monitor aborts when the section is still
+      open past it. Catches a HUNG peer whose process (and heartbeat
+      thread) is still alive — stale heartbeats can't, because heartbeats
+      come from a side thread, not the blocked main thread. The guard
+      deadline is deliberately SEPARATE from (and much larger than) the
+      staleness timeout: the guard brackets real compute, and a
+      legitimately long mine must not read as a hang — with a shared
+      timeout, every restarted gang would recompute the same too-long
+      mine and abort identically, a retry livelock.
+
+    Abort = ``on_abort(reason)``, default ``os._exit(exit_code)`` —
+    ``sys.exit`` would only raise in the monitor thread while the main
+    thread stays wedged in the C++ collective. The exit code is the
+    mining job's resumable EXIT_RANK_DEAD (mining/job.py), which k8s
+    converts into a clean retry-from-checkpoint.
+
+    Heartbeat freshness compares the WRITER's ``time.time()`` (stored in
+    the file) against the READER's — cross-pod wall clocks, NTP-bounded
+    skew; timeouts are minutes, skew is milliseconds. A peer that never
+    wrote at all is aged from this watchdog's start, so a slow-scheduling
+    pod gets the full ``timeout_s`` to appear before it is declared dead —
+    and a heartbeat file STAMPED BEFORE this watchdog started (a leftover
+    from the previous gang incarnation on the PVC) gets the same grace,
+    not an instant stale verdict against a pod that simply hasn't booted
+    yet. ``stop`` best-effort unlinks this rank's own file so clean exits
+    leave nothing behind; hard kills rely on the stamp comparison.
+
+    The ``rank.heartbeat`` fault site (``KMLS_FAULT_RANK_DEAD=rank``)
+    silences a rank's writer thread permanently — the deterministic
+    dead-process stand-in the chaos suite kills multi-host jobs with.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        rank: int,
+        num_processes: int,
+        heartbeat_interval_s: float = 5.0,
+        timeout_s: float = 300.0,
+        collective_timeout_s: float | None = None,
+        exit_code: int = 76,
+        on_abort=None,
+    ):
+        self.directory = directory
+        self.rank = rank
+        self.num_processes = num_processes
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.timeout_s = timeout_s
+        self.collective_timeout_s = (
+            collective_timeout_s
+            if collective_timeout_s is not None
+            else 6 * timeout_s
+        )
+        self.exit_code = exit_code
+        self.on_abort = on_abort or self._default_abort
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._t0 = 0.0
+        self._t0_wall = 0.0
+        self._guard_lock = threading.Lock()
+        self._guard_name: str | None = None
+        self._guard_deadline: float | None = None
+        self.aborted_reason: str | None = None
+
+    def _default_abort(self, reason: str) -> None:
+        # visible in the pod log right before the process dies
+        print(
+            f"RANK WATCHDOG ABORT (rank {self.rank}): {reason} — exiting "
+            f"{self.exit_code} (resumable; k8s retries from the checkpoint)",
+            flush=True,
+        )
+        os._exit(self.exit_code)
+
+    def _beat_path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"rank{rank}.hb")
+
+    def beat_once(self) -> bool:
+        """Write this rank's heartbeat; False once the rank is fault-dead."""
+        try:
+            faults.fire("rank.heartbeat", replica=self.rank)
+        except faults.FaultInjected:
+            logger.warning(
+                "rank %d heartbeat silenced by injected fault", self.rank
+            )
+            return False
+        from ..io.artifacts import atomic_write_text
+
+        try:
+            atomic_write_text(self._beat_path(self.rank), repr(time.time()))
+        except OSError as exc:
+            # a full/unwritable PVC must not kill the job via its own
+            # watchdog; peers will age this rank out if it persists
+            logger.warning("heartbeat write failed: %s", exc)
+        return True
+
+    def peer_ages(self) -> dict[int, float]:
+        """Seconds since each PEER rank's last heartbeat. Never-seen peers
+        — no file, an unreadable file, or a file stamped BEFORE this
+        watchdog started (the previous gang's leftover on the PVC) — are
+        aged from watchdog start instead, so a pod that hasn't booted yet
+        gets the full ``timeout_s`` grace rather than being condemned by
+        its predecessor's stale heartbeat."""
+        now = time.time()
+        since_start = time.monotonic() - self._t0
+        ages: dict[int, float] = {}
+        for rank in range(self.num_processes):
+            if rank == self.rank:
+                continue
+            try:
+                with open(self._beat_path(rank), "r", encoding="utf-8") as fh:
+                    stamp = float(fh.read().strip())
+            except (OSError, ValueError):
+                ages[rank] = since_start
+                continue
+            ages[rank] = now - stamp if stamp >= self._t0_wall else since_start
+        return ages
+
+    def stale_peers(self) -> list[int]:
+        return sorted(
+            r for r, age in self.peer_ages().items() if age > self.timeout_s
+        )
+
+    @contextlib.contextmanager
+    def guard(self, name: str):
+        """Deadline-bracket a collective section: still open after
+        ``collective_timeout_s`` → abort. One section at a time (mining
+        is serial)."""
+        with self._guard_lock:
+            self._guard_name = name
+            self._guard_deadline = time.monotonic() + self.collective_timeout_s
+        try:
+            yield
+        finally:
+            with self._guard_lock:
+                self._guard_name = None
+                self._guard_deadline = None
+
+    def _abort(self, reason: str) -> None:
+        if self.aborted_reason is None:
+            self.aborted_reason = reason
+            self.on_abort(reason)
+
+    def _beat_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.beat_once():
+                return  # fault-dead: silence forever, thread exits
+            self._stop.wait(self.heartbeat_interval_s)
+
+    def _monitor_loop(self) -> None:
+        poll = min(self.heartbeat_interval_s, max(self.timeout_s / 10, 0.05))
+        while not self._stop.wait(poll):
+            with self._guard_lock:
+                g_name, g_deadline = self._guard_name, self._guard_deadline
+            if g_deadline is not None and time.monotonic() > g_deadline:
+                self._abort(
+                    f"collective section {g_name!r} exceeded "
+                    f"{self.collective_timeout_s:.0f}s — a peer rank is "
+                    "hung or dead"
+                )
+                return
+            stale = self.stale_peers()
+            if stale:
+                ages = self.peer_ages()
+                detail = ", ".join(f"rank {r}: {ages[r]:.0f}s" for r in stale)
+                self._abort(
+                    f"peer heartbeat(s) stale past {self.timeout_s:.0f}s "
+                    f"({detail}) — dead rank(s), collectives would hang"
+                )
+                return
+
+    def start(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        self._t0 = time.monotonic()
+        self._t0_wall = time.time()
+        self.beat_once()  # first beat before any peer could judge us stale
+        for target, name in (
+            (self._beat_loop, "kmls-rank-heartbeat"),
+            (self._monitor_loop, "kmls-rank-monitor"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        try:
+            # clean exits leave no stale stamp for the next gang to read;
+            # hard kills rely on peer_ages' stamped-before-start grace
+            os.unlink(self._beat_path(self.rank))
+        except OSError:
+            pass
 
 
 def make_hybrid_mesh(

@@ -16,14 +16,43 @@ def _load(name):
 
 def test_all_manifests_parse():
     paths = glob.glob(os.path.join(REPO, "kubernetes", "*.yaml"))
-    assert len(paths) == 4
+    assert len(paths) == 5
     for p in paths + [os.path.join(REPO, "argocd_manifest.yaml")]:
         with open(p) as fh:
-            assert yaml.safe_load(fh) is not None, p
+            # multi-doc manifests (job-multihost.yaml: Service + Job)
+            docs = list(yaml.safe_load_all(fh))
+            assert docs and all(d is not None for d in docs), p
 
 
 def _env_names(container):
     return {e["name"] for e in container["env"]}
+
+
+def _assert_exit_code_policy(job):
+    """The podFailurePolicy must encode mining/job.py's exit-code
+    contract: fail fast on fatal-config, never burn backoffLimit on a
+    resumable (checkpoint-resume) abort or an eviction."""
+    from kmlserver_tpu.mining.job import (
+        EXIT_FATAL_CONFIG,
+        RETRYABLE_EXIT_CODES,
+    )
+
+    spec = job["spec"]
+    assert spec["template"]["spec"]["restartPolicy"] == "Never"  # required
+    assert spec["activeDeadlineSeconds"] > 0  # a hang is reaped, not held
+    rules = spec["podFailurePolicy"]["rules"]
+    by_action = {}
+    for rule in rules:
+        if "onExitCodes" in rule:
+            by_action[rule["action"]] = rule["onExitCodes"]["values"]
+    assert by_action["FailJob"] == [EXIT_FATAL_CONFIG]
+    assert by_action["Ignore"] == sorted(RETRYABLE_EXIT_CODES)
+    # pod disruptions (node drain, preemption) are not crashes either
+    assert any(
+        c.get("type") == "DisruptionTarget"
+        for rule in rules
+        for c in rule.get("onPodConditions", [])
+    )
 
 
 def test_job_env_contract_and_volume():
@@ -36,10 +65,70 @@ def test_job_env_contract_and_volume():
         "RECOMMENDATIONS_FILE", "BEST_TRACKS_FILE", "DATA_INVALIDATION_FILE",
         "TOP_TRACKS_SAVE_PERCENTILE",
     } <= _env_names(container)
+    # the preemption-proofing knobs ride the env contract
+    assert {
+        "KMLS_CKPT_ENABLED", "KMLS_CKPT_DIR", "KMLS_LEASE_TTL_S",
+    } <= _env_names(container)
     assert job["spec"]["ttlSecondsAfterFinished"] == 1200  # pseudo-cron TTL
     assert "Force=true" in job["metadata"]["annotations"][
         "argocd.argoproj.io/sync-options"]
+    _assert_exit_code_policy(job)
     claims = [v["persistentVolumeClaim"]["claimName"] for v in spec["volumes"]]
+    assert claims == ["fast-api-claim"]
+    assert container["resources"]["requests"]["google.com/tpu"]
+
+
+def _load_multihost():
+    with open(os.path.join(REPO, "kubernetes", "job-multihost.yaml")) as fh:
+        docs = list(yaml.safe_load_all(fh))
+    service = next(d for d in docs if d["kind"] == "Service")
+    job = next(d for d in docs if d["kind"] == "Job")
+    return service, job
+
+
+def test_job_multihost_topology_and_bootstrap():
+    """The two-pod mining Job's wiring must be internally consistent:
+    indexed ranks, headless coordinator DNS, world size = completions."""
+    service, job = _load_multihost()
+    spec = job["spec"]
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == spec["parallelism"] == 2
+
+    pod = spec["template"]["spec"]
+    container = pod["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+
+    # rank from the pod index (downward API on the completion-index
+    # annotation), never hardcoded
+    rank_ref = env["KMLS_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+    assert "job-completion-index" in rank_ref
+    # world size must equal the Job's completion count (distributed.py
+    # fails fast on rank >= world, but the manifest must not rely on that)
+    assert int(env["KMLS_NUM_PROCESSES"]["value"]) == spec["completions"]
+
+    # coordinator address: pod 0 of THIS job, through THIS headless Service
+    coordinator = env["KMLS_COORDINATOR_ADDRESS"]["value"]
+    host, port = coordinator.rsplit(":", 1)
+    assert host == f"{job['metadata']['name']}-0.{service['metadata']['name']}"
+    assert pod["subdomain"] == service["metadata"]["name"]
+    # headless: the k8s API takes the literal string "None" here
+    assert service["spec"]["clusterIP"] == "None"
+    assert service["spec"]["publishNotReadyAddresses"] is True
+    assert int(port) == service["spec"]["ports"][0]["port"]
+    # the Service must actually select the Job's pods
+    assert service["spec"]["selector"].items() <= spec["template"][
+        "metadata"]["labels"].items()
+
+    # multi-host hybrid mesh + the watchdog knobs that bound a dead-rank
+    # hang (the whole point of a two-pod Job)
+    assert env["KMLS_MESH_SHAPE"]["value"] == "hybrid"
+    assert float(env["KMLS_RANK_TIMEOUT_S"]["value"]) > 0
+    assert float(env["KMLS_RANK_HEARTBEAT_S"]["value"]) > 0
+    assert {"KMLS_CKPT_ENABLED", "KMLS_CKPT_DIR", "KMLS_LEASE_TTL_S"} <= set(env)
+
+    _assert_exit_code_policy(job)
+    # shared PVC: rank-gated writes land where the API replicas read
+    claims = [v["persistentVolumeClaim"]["claimName"] for v in pod["volumes"]]
     assert claims == ["fast-api-claim"]
     assert container["resources"]["requests"]["google.com/tpu"]
 

@@ -119,6 +119,94 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# Dead-rank watchdog acceptance (ISSUE 4): rank 1 joins the distributed
+# runtime, then goes silent — KMLS_FAULT_RANK_DEAD stops its heartbeats and
+# it never enters the collective. Without the watchdog rank 0 would block in
+# sync_global_devices FOREVER (the multi-host failure mode the reference's
+# stack shares with any XLA collective). With it, rank 0 must exit
+# EXIT_RANK_DEAD within the configured timeout (+ scheduling slack).
+_WORKER_DEADRANK = r"""
+import os, sys, time
+
+rank, port, base = sys.argv[1], sys.argv[2], sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["KMLS_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+os.environ["KMLS_NUM_PROCESSES"] = "2"
+os.environ["KMLS_PROCESS_ID"] = rank
+if rank == "1":
+    os.environ["KMLS_FAULT_RANK_DEAD"] = "1"
+
+from kmlserver_tpu.parallel.distributed import RankWatchdog, maybe_initialize
+from kmlserver_tpu.mining.job import EXIT_RANK_DEAD
+
+assert maybe_initialize() is True
+import jax
+
+wd = RankWatchdog(
+    os.path.join(base, "heartbeats"), rank=int(rank), num_processes=2,
+    heartbeat_interval_s=0.25, timeout_s=6.0, collective_timeout_s=12.0,
+    exit_code=EXIT_RANK_DEAD,
+)
+wd.start()
+print(f"RANK {rank} WATCHDOG UP", flush=True)
+
+if rank == "1":
+    # dead rank: heartbeats silenced by the fault, never joins the
+    # collective. Sleep far past rank 0's timeout — if rank 0's watchdog
+    # fails, the TEST times out instead of passing.
+    time.sleep(120)
+    sys.exit(0)
+
+from jax.experimental import multihost_utils
+
+with wd.guard("sync"):
+    # blocks forever on the silent peer; only the watchdog can end this
+    multihost_utils.sync_global_devices("deadrank-test")
+print("RANK 0 UNEXPECTEDLY PASSED THE BARRIER", flush=True)
+sys.exit(1)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_dead_rank_aborts_within_timeout(tmp_path):
+    import time as _time
+
+    from kmlserver_tpu.mining.job import EXIT_RANK_DEAD
+
+    port = _free_port()
+    env = os.environ.copy()
+    for var in ("XLA_FLAGS", "JAX_PLATFORMS", "KMLS_COORDINATOR_ADDRESS",
+                "KMLS_NUM_PROCESSES", "KMLS_PROCESS_ID",
+                "KMLS_FAULT_RANK_DEAD"):
+        env.pop(var, None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_DEADRANK,
+             str(rank), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    try:
+        t0 = _time.monotonic()
+        # rank 0 must die with the documented code, BOUNDED: its 6 s
+        # timeout + distributed bootstrap + jax import slack
+        out0, _ = procs[0].communicate(timeout=120)
+        elapsed = _time.monotonic() - t0
+        assert procs[0].returncode == EXIT_RANK_DEAD, out0
+        assert "RANK WATCHDOG ABORT" in out0, out0
+        assert elapsed < 110, f"abort took {elapsed:.0f}s — not bounded"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+
+
 @pytest.mark.slow
 def test_two_process_mining_job(tmp_path):
     from kmlserver_tpu.config import MiningConfig

@@ -1,0 +1,520 @@
+"""Batch-side chaos suite (ISSUE 4): every preemption-recovery path fired
+deterministically through the fault harness (kmlserver_tpu/faults.py).
+
+The acceptance bar: with ``KMLS_FAULT_MINE_CRASH_PHASE`` killing the
+mining job at EACH checkpointed phase in turn, the restarted job resumes
+from the checkpoint and its final pickles + manifest are bit-identical to
+an uninterrupted run; a corrupt checkpoint self-retires (and a poison one
+quarantines after two parse strikes); a zombie writer is fenced out of
+publication by the lease's monotonic token; a dead rank aborts the
+multi-host job within the configured timeout instead of hanging (watchdog
+unit coverage here; the real two-process abort rides
+tests/test_distributed_multiproc.py).
+
+All tests carry the ``chaos`` marker (the dedicated CI job runs
+``-m chaos``); except where noted they are fast enough to ride tier-1 too.
+"""
+
+import dataclasses
+import hashlib
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu import faults
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.io import artifacts
+from kmlserver_tpu.mining import checkpoint as ckpt_mod
+from kmlserver_tpu.mining.job import (
+    EXIT_FATAL_CONFIG,
+    EXIT_OK,
+    EXIT_RANK_DEAD,
+    EXIT_RESUMABLE,
+    classify_exception,
+)
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.parallel.distributed import RankWatchdog
+
+from .oracle import random_baskets
+from .test_pipeline import table_with_metadata
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_pvc(base, rng_seed=0):
+    """A fake PVC with one dataset; returns its MiningConfig."""
+    rng = np.random.default_rng(rng_seed)
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir, exist_ok=True)
+    baskets = random_baskets(rng, n_playlists=40, n_tracks=16, mean_len=5)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds1.csv"),
+        table_with_metadata(baskets),
+    )
+    return MiningConfig(
+        base_dir=base, datasets_dir=ds_dir, min_support=0.1,
+        k_max_consequents=32, top_tracks_save_percentile=0.25,
+        lease_ttl_s=5.0,
+    )
+
+
+def _artifact_bytes(cfg) -> dict[str, bytes]:
+    out = {}
+    for name in (cfg.recommendations_file, cfg.best_tracks_file,
+                 cfg.artists_mapping_file, cfg.track_info_file):
+        with open(os.path.join(cfg.pickles_dir, name), "rb") as fh:
+            out[name] = fh.read()
+    return out
+
+
+def _manifest_files(cfg) -> dict:
+    manifest = artifacts.load_manifest(cfg.pickles_dir)
+    assert manifest is not None
+    return manifest["files"]
+
+
+class TestResumeEquivalence:
+    @pytest.mark.parametrize("crash_phase", ckpt_mod.PHASES)
+    def test_kill_at_phase_then_resume_bit_identical(
+        self, tmp_path, crash_phase
+    ):
+        """THE tentpole acceptance: kill after each phase's checkpoint in
+        turn; the restart resumes from it and publishes bit-identical
+        pickles + manifest vs an uninterrupted run."""
+        # uninterrupted reference run
+        ref_cfg = _make_pvc(str(tmp_path / "ref"))
+        run_mining_job(ref_cfg)
+        ref_bytes = _artifact_bytes(ref_cfg)
+        ref_manifest = _manifest_files(ref_cfg)
+
+        # interrupted run: crash right after crash_phase's checkpoint
+        cfg = _make_pvc(str(tmp_path / "int"))
+        faults.inject(f"mine.crash.{crash_phase}", times=1)
+        with pytest.raises(faults.FaultInjected):
+            run_mining_job(cfg)
+        # nothing published: the artifact set is written AFTER the phases
+        assert not os.path.exists(
+            os.path.join(cfg.pickles_dir, cfg.recommendations_file)
+        )
+        faults.clear()
+
+        # the restart resumes every phase up to and including the crash
+        summary = run_mining_job(cfg)
+        want = ckpt_mod.PHASES[: ckpt_mod.PHASES.index(crash_phase) + 1]
+        assert summary.resumed_phases == want
+        assert _artifact_bytes(cfg) == ref_bytes
+        assert _manifest_files(cfg) == ref_manifest
+
+    def test_checkpoint_retired_after_publication(self, tmp_path):
+        cfg = _make_pvc(str(tmp_path))
+        run_mining_job(cfg)
+        store = ckpt_mod.open_store(
+            cfg, os.path.join(cfg.datasets_dir, "2023_spotify_ds1.csv"), 1,
+            writer=True,
+        )
+        assert store.completed == frozenset()  # cleared, nothing to resume
+        # and a back-to-back re-run re-pays its compute (no silent replay)
+        summary = run_mining_job(cfg)
+        assert summary.resumed_phases == ()
+
+
+class TestCheckpointHygiene:
+    def _crashed_run(self, base, phase="mine"):
+        cfg = _make_pvc(base)
+        faults.inject(f"mine.crash.{phase}", times=1)
+        with pytest.raises(faults.FaultInjected):
+            run_mining_job(cfg)
+        faults.clear()
+        return cfg
+
+    def test_torn_checkpoint_self_retires_to_recompute(self, tmp_path):
+        """Bytes disagreeing with the sha256 manifest (torn write, bit
+        rot) retire the phase on the spot — and the result is still
+        correct, just recomputed."""
+        ref_cfg = _make_pvc(str(tmp_path / "ref"))
+        run_mining_job(ref_cfg)
+
+        cfg = self._crashed_run(str(tmp_path / "int"))
+        faults.flip_byte(os.path.join(cfg.checkpoint_path, "mine.ckpt"))
+        summary = run_mining_job(cfg)
+        assert "mine" not in summary.resumed_phases  # recomputed
+        assert "encode" in summary.resumed_phases  # untouched phase resumes
+        assert _artifact_bytes(cfg) == _artifact_bytes(ref_cfg)
+
+    def test_fingerprint_mismatch_ignores_checkpoint(self, tmp_path):
+        """A checkpoint written under a different config must never
+        resume — changed min_support changes the rules."""
+        cfg = self._crashed_run(str(tmp_path))
+        changed = dataclasses.replace(cfg, min_support=0.2)
+        summary = run_mining_job(changed)
+        assert summary.resumed_phases == ()  # stale store retired
+
+    def test_changed_dataset_ignores_checkpoint(self, tmp_path):
+        cfg = self._crashed_run(str(tmp_path))
+        # the same file regenerated with different content
+        rng = np.random.default_rng(99)
+        write_tracks_csv(
+            os.path.join(cfg.datasets_dir, "2023_spotify_ds1.csv"),
+            table_with_metadata(
+                random_baskets(rng, n_playlists=40, n_tracks=16, mean_len=5)
+            ),
+        )
+        summary = run_mining_job(cfg)
+        assert summary.resumed_phases == ()
+
+    def test_poison_checkpoint_quarantined_after_two_strikes(self, tmp_path):
+        """KMLS_FAULT_CKPT_CORRUPT writes garbage WITH a matching digest:
+        integrity passes, unpickling fails. Strike one recomputes; strike
+        two quarantines the file (PR 3's quarantine helper) so restarts
+        stop re-tripping on it."""
+        cfg = _make_pvc(str(tmp_path))
+        # crash after 'encode', whose checkpoint bytes were corrupted
+        faults.inject("ckpt.corrupt", times=1)
+        faults.inject("mine.crash.encode", times=1)
+        with pytest.raises(faults.FaultInjected):
+            run_mining_job(cfg)
+        faults.clear()
+
+        ckpt_path = os.path.join(cfg.checkpoint_path, "encode.ckpt")
+        store = ckpt_mod.CheckpointStore(
+            cfg.checkpoint_path,
+            ckpt_mod.compute_fingerprint(
+                cfg, os.path.join(cfg.datasets_dir, "2023_spotify_ds1.csv"), 1
+            ),
+            quarantine_after=2,
+        )
+        assert "encode" in store.completed
+        assert store.load("encode") is None  # strike 1: recompute
+        assert os.path.exists(ckpt_path)  # not yet condemned
+        store2 = ckpt_mod.CheckpointStore(
+            cfg.checkpoint_path, store.fingerprint, quarantine_after=2
+        )
+        assert store2.load("encode") is None  # strike 2: quarantine
+        assert not os.path.exists(ckpt_path)
+        qdir = os.path.join(cfg.checkpoint_path, artifacts.QUARANTINE_DIRNAME)
+        assert any(n.startswith("encode.ckpt") for n in os.listdir(qdir))
+
+        # and the job itself recovers end to end
+        summary = run_mining_job(cfg)
+        assert summary.token
+
+    def test_fingerprint_sensitivity(self, tmp_path):
+        cfg = _make_pvc(str(tmp_path))
+        ds = os.path.join(cfg.datasets_dir, "2023_spotify_ds1.csv")
+        base = ckpt_mod.compute_fingerprint(cfg, ds, 1)
+        assert base == ckpt_mod.compute_fingerprint(cfg, ds, 1)  # stable
+        assert base != ckpt_mod.compute_fingerprint(cfg, ds, 2)  # run index
+        assert base != ckpt_mod.compute_fingerprint(
+            dataclasses.replace(cfg, min_support=0.2), ds, 1
+        )
+        # dispatch knobs deliberately EXCLUDED: a TPU→CPU restart resumes
+        assert base == ckpt_mod.compute_fingerprint(
+            dataclasses.replace(cfg, native_cpu_pair_counts=False), ds, 1
+        )
+
+
+class TestLeaseFencing:
+    def test_live_lease_blocks_second_writer(self, tmp_path):
+        d = str(tmp_path)
+        lease = artifacts.PublicationLease.acquire(d, ttl_s=30.0)
+        with pytest.raises(artifacts.LeaseHeldError):
+            artifacts.PublicationLease.acquire(d, ttl_s=30.0)
+        lease.release()
+        # released: next writer takes over immediately, token increments
+        nxt = artifacts.PublicationLease.acquire(d, ttl_s=30.0)
+        assert nxt.fencing_token == lease.fencing_token + 1
+
+    def test_lease_expires_after_writer_death(self, tmp_path):
+        """A writer that died without releasing (pod kill) only blocks
+        until its heartbeat ages past the TTL."""
+        d = str(tmp_path)
+        dead = artifacts.PublicationLease.acquire(d, ttl_s=0.2)
+        # no heartbeat thread: the writer is dead
+        time.sleep(0.3)
+        nxt = artifacts.PublicationLease.acquire(d, ttl_s=30.0)
+        assert nxt.fencing_token == dead.fencing_token + 1
+        # the zombie is fenced the moment it checks
+        with pytest.raises(artifacts.LeaseLostError):
+            dead.check()
+        with pytest.raises(artifacts.LeaseLostError):
+            dead.heartbeat()  # and cannot resurrect itself
+        nxt.check()  # the live writer is unaffected
+
+    def test_heartbeat_keeps_lease_past_ttl(self, tmp_path):
+        d = str(tmp_path)
+        lease = artifacts.PublicationLease.acquire(
+            d, ttl_s=0.3, heartbeat_interval_s=0.05
+        )
+        lease.start_heartbeat()
+        try:
+            time.sleep(0.5)  # > ttl: only the heartbeat keeps it alive
+            with pytest.raises(artifacts.LeaseHeldError):
+                artifacts.PublicationLease.acquire(d, ttl_s=0.3)
+        finally:
+            lease.stop_heartbeat()
+
+    def test_release_outlives_a_racing_heartbeat(self, tmp_path):
+        """release() must stop the heartbeat thread FIRST — a beat landing
+        after `released: true` would resurrect the lease and make the
+        next writer wait out the TTL against a dead owner."""
+        d = str(tmp_path)
+        lease = artifacts.PublicationLease.acquire(
+            d, ttl_s=30.0, heartbeat_interval_s=0.02
+        )
+        lease.start_heartbeat()
+        time.sleep(0.1)  # the beat loop is definitely running
+        lease.release()
+        time.sleep(0.2)  # any live beat would have overwritten by now
+        assert artifacts._read_lease(d)["released"] is True
+        # and the next writer takes over with no TTL wait
+        nxt = artifacts.PublicationLease.acquire(d, ttl_s=30.0)
+        assert nxt.fencing_token == lease.fencing_token + 1
+
+    def test_zombie_mining_job_cannot_publish_over_newer_run(self, tmp_path):
+        """End to end: run 1 crashes before publication — the abort path
+        RELEASES its lease (a Python-level exit writes nothing more), so
+        the replacement acquires immediately with token+1 and stamps the
+        manifest; any handle to run 1's generation is fenced forever."""
+        cfg = _make_pvc(str(tmp_path))
+        faults.inject("mine.crash.rules", times=1)
+        with pytest.raises(faults.FaultInjected):
+            run_mining_job(cfg)
+        faults.clear()
+        crashed = artifacts._read_lease(cfg.pickles_dir)
+        assert crashed is not None and crashed["released"]
+
+        summary = run_mining_job(cfg)  # no TTL wait: released hands over
+        assert summary.fencing_token == crashed["fencing_token"] + 1
+        manifest = artifacts.load_manifest(cfg.pickles_dir)
+        assert manifest["fencing_token"] == summary.fencing_token
+
+        # a zombie holding run 1's generation is fenced at the first check
+        stale = artifacts.PublicationLease(
+            cfg.pickles_dir, crashed["owner"], crashed["fencing_token"],
+            ttl_s=5.0,
+        )
+        with pytest.raises(artifacts.LeaseLostError):
+            stale.check()
+
+    def test_held_lease_aborts_job_as_resumable(self, tmp_path):
+        cfg = _make_pvc(str(tmp_path))
+        holder = artifacts.PublicationLease.acquire(
+            cfg.pickles_dir, ttl_s=30.0
+        )
+        with pytest.raises(artifacts.LeaseHeldError) as exc_info:
+            run_mining_job(cfg)
+        assert classify_exception(exc_info.value) == EXIT_RESUMABLE
+        holder.release()
+        assert run_mining_job(cfg).token  # next attempt wins
+
+
+class TestRankWatchdog:
+    def _watchdog(self, directory, rank, num=2, timeout_s=0.5,
+                  collective_timeout_s=None, aborts=None):
+        return RankWatchdog(
+            directory, rank=rank, num_processes=num,
+            heartbeat_interval_s=0.05, timeout_s=timeout_s,
+            collective_timeout_s=collective_timeout_s,
+            on_abort=(aborts.append if aborts is not None else None),
+        )
+
+    def test_dead_peer_aborts_within_bounded_time(self, tmp_path):
+        """The forever-hang killer: rank 1's heartbeats stop (the
+        KMLS_FAULT_RANK_DEAD site) and rank 0 must abort within the
+        timeout instead of waiting on the collective forever."""
+        aborts: list[str] = []
+        w0 = self._watchdog(str(tmp_path), 0, aborts=aborts)
+        w1 = self._watchdog(str(tmp_path), 1, aborts=[])
+        w0.start()
+        w1.start()
+        try:
+            time.sleep(0.2)
+            assert not aborts  # both alive: no false positive
+            faults.inject("rank.heartbeat", replica=1, times=-1)
+            deadline = time.monotonic() + 5.0
+            while not aborts and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert aborts and "rank 1" in aborts[0]
+        finally:
+            w0.stop()
+            w1.stop()
+
+    def test_collective_guard_bounds_a_hang(self, tmp_path):
+        """A peer whose PROCESS lives but whose main thread is wedged
+        keeps heartbeating — only the guard catches that."""
+        aborts: list[str] = []
+        w0 = self._watchdog(str(tmp_path), 0, num=1, timeout_s=0.3,
+                            collective_timeout_s=0.3, aborts=aborts)
+        w0.start()
+        try:
+            with w0.guard("mine"):
+                deadline = time.monotonic() + 5.0
+                while not aborts and time.monotonic() < deadline:
+                    time.sleep(0.02)
+            assert aborts and "'mine'" in aborts[0]
+        finally:
+            w0.stop()
+
+    def test_long_collective_outlives_staleness_timeout(self, tmp_path):
+        """A legitimately long mine with LIVE peers must not abort at the
+        staleness timeout — the guard has its own (much larger) deadline,
+        else every restarted gang would recompute the same too-long mine
+        and livelock."""
+        aborts: list[str] = []
+        w0 = self._watchdog(str(tmp_path), 0, num=1, timeout_s=0.1,
+                            collective_timeout_s=30.0, aborts=aborts)
+        w0.start()
+        try:
+            with w0.guard("mine"):
+                time.sleep(0.5)  # 5x the staleness timeout, still computing
+            assert not aborts
+        finally:
+            w0.stop()
+
+    def test_guard_defaults_to_multiple_of_staleness_timeout(self, tmp_path):
+        w0 = self._watchdog(str(tmp_path), 0, num=1, timeout_s=0.5)
+        assert w0.collective_timeout_s == pytest.approx(3.0)  # 6x
+
+    def test_completed_guard_never_aborts(self, tmp_path):
+        aborts: list[str] = []
+        w0 = self._watchdog(str(tmp_path), 0, num=1, timeout_s=0.3,
+                            collective_timeout_s=0.3, aborts=aborts)
+        w0.start()
+        try:
+            for _ in range(3):
+                with w0.guard("fast-collective"):
+                    time.sleep(0.02)
+            time.sleep(0.4)
+            assert not aborts
+        finally:
+            w0.stop()
+
+    def test_predecessor_heartbeat_file_gets_startup_grace(self, tmp_path):
+        """A rank1.hb left on the PVC by the PREVIOUS gang (hard-killed,
+        so never unlinked) must not condemn the new gang's still-booting
+        rank 1 at the first monitor poll."""
+        stale = os.path.join(str(tmp_path), "rank1.hb")
+        with open(stale, "w", encoding="utf-8") as fh:
+            fh.write(repr(time.time() - 3600.0))  # an hour-old stamp
+        aborts: list[str] = []
+        w0 = self._watchdog(str(tmp_path), 0, timeout_s=0.5, aborts=aborts)
+        w0.start()
+        try:
+            time.sleep(0.25)  # > first poll, < timeout: grace must hold
+            assert not aborts
+            # the peer never boots: after the FULL timeout it is dead
+            deadline = time.monotonic() + 5.0
+            while not aborts and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert aborts and "rank 1" in aborts[0]
+        finally:
+            w0.stop()
+
+    def test_clean_stop_unlinks_own_heartbeat_file(self, tmp_path):
+        w0 = self._watchdog(str(tmp_path), 0)
+        w0.start()
+        assert os.path.exists(os.path.join(str(tmp_path), "rank0.hb"))
+        w0.stop()
+        assert not os.path.exists(os.path.join(str(tmp_path), "rank0.hb"))
+
+
+class TestExitCodeContract:
+    def test_classification_policy(self):
+        from kmlserver_tpu.mining.vocab import DuplicateArtistURIError
+
+        assert classify_exception(faults.FaultInjected("x")) == EXIT_RESUMABLE
+        assert classify_exception(
+            artifacts.LeaseHeldError("x")) == EXIT_RESUMABLE
+        assert classify_exception(
+            artifacts.LeaseLostError("x")) == EXIT_RESUMABLE
+        assert classify_exception(ValueError("x")) == EXIT_FATAL_CONFIG
+        assert classify_exception(
+            FileNotFoundError("x")) == EXIT_FATAL_CONFIG
+        assert classify_exception(
+            DuplicateArtistURIError("x")) == EXIT_FATAL_CONFIG
+        assert classify_exception(RuntimeError("x")) == 1
+        # the k8s manifests key off these exact values — frozen contract
+        assert (EXIT_OK, EXIT_FATAL_CONFIG, EXIT_RESUMABLE, EXIT_RANK_DEAD) \
+            == (0, 64, 75, 76)
+
+    @pytest.mark.slow
+    def test_job_module_exit_codes_end_to_end(self, tmp_path):
+        """The contract as k8s sees it: real `python -m ...mining.job`
+        processes returning the documented codes — fatal config (64, no
+        datasets), injected preemption (75), then resume to success (0)."""
+        base = str(tmp_path / "pvc")
+        ds_dir = os.path.join(base, "datasets")
+        os.makedirs(ds_dir)
+        rng = np.random.default_rng(3)
+        write_tracks_csv(
+            os.path.join(ds_dir, "2023_spotify_ds1.csv"),
+            table_with_metadata(
+                random_baskets(rng, n_playlists=40, n_tracks=16, mean_len=5)
+            ),
+        )
+
+        def run_job(extra_env=None):
+            env = os.environ.copy()
+            env.update({
+                "BASE_DIR": base, "DATASETS_DIR": ds_dir,
+                "MIN_SUPPORT": "0.1", "JAX_PLATFORMS": "cpu",
+            })
+            env.update(extra_env or {})
+            return subprocess.run(
+                [sys.executable, "-m", "kmlserver_tpu.mining.job"],
+                capture_output=True, text=True, env=env, cwd=_REPO,
+                timeout=180,
+            )
+
+        # fatal config: a dataset dir that cannot ever match
+        proc = run_job({"DATASETS_DIR": os.path.join(base, "nope")})
+        assert proc.returncode == EXIT_FATAL_CONFIG, proc.stdout + proc.stderr
+
+        # preemption stand-in: crash after the mine phase checkpoint
+        proc = run_job({"KMLS_FAULT_MINE_CRASH_PHASE": "mine"})
+        assert proc.returncode == EXIT_RESUMABLE, proc.stdout + proc.stderr
+
+        # the retry resumes and succeeds
+        proc = run_job()
+        assert proc.returncode == EXIT_OK, proc.stdout + proc.stderr
+        assert "Resumed phase 'mine' from checkpoint" in proc.stdout
+
+
+class TestManifestFencingToken:
+    def test_manifest_records_fencing_token_and_engine_still_validates(
+        self, tmp_path
+    ):
+        """The fencing token rides the manifest the serving engine already
+        validates (PR 3) — the extra key must not break verify_files."""
+        cfg = _make_pvc(str(tmp_path))
+        summary = run_mining_job(cfg)
+        manifest = artifacts.load_manifest(cfg.pickles_dir)
+        assert manifest["fencing_token"] == summary.fencing_token == 1
+        assert artifacts.verify_files(
+            cfg.pickles_dir,
+            [cfg.recommendations_file, cfg.best_tracks_file],
+            token=summary.token,
+        ) == []
+
+    def test_lease_disabled_keeps_reference_behavior(self, tmp_path):
+        cfg = dataclasses.replace(_make_pvc(str(tmp_path)),
+                                  lease_enabled=False)
+        summary = run_mining_job(cfg)
+        assert summary.fencing_token is None
+        assert not os.path.exists(artifacts.lease_path(cfg.pickles_dir))
+        manifest = artifacts.load_manifest(cfg.pickles_dir)
+        assert "fencing_token" not in manifest
