@@ -1,0 +1,57 @@
+"""kmls-verify — project-invariant static analysis.
+
+PRs 1–4 made the serving+mining stack fast and fault-tolerant; this
+package makes the invariants that correctness now rests on MACHINE-
+CHECKED instead of reviewer-remembered. Six checkers, each a pure-AST
+pass (stdlib only — the analyzer must run in a bare CI job without jax):
+
+- ``hotpath``      — no host-sync constructs reachable from the serving
+                     dispatch entry points (PR 1's zero-compile/zero-sync
+                     contract);
+- ``locks``        — no lock-acquisition-order cycles, no blocking calls
+                     while a hot-path lock is held (PR 2/3's batcher/
+                     cache/metrics locking discipline);
+- ``atomic-write`` — every artifact write flows through io/artifacts.py's
+                     tmp+``os.replace`` writer (PR 3's torn-read fix);
+- ``knobs``        — every ``KMLS_*`` env knob referenced in code is
+                     declared in config.KNOB_REGISTRY, documented in the
+                     README, and (runtime scopes) bound or documented in
+                     the k8s manifests — no orphans in either direction;
+- ``fault-sites``  — every ``KMLS_FAULT_*`` knob maps to a registered
+                     faults.py site that is wired into the code and
+                     exercised by at least one chaos test;
+- ``exit-codes``   — the 0/64/75/76 contract in mining/job.py exactly
+                     matches the ``podFailurePolicy`` rules in both Job
+                     manifests (PR 4's preemption contract).
+
+Findings carry ``file:line``, a severity, an explanation, and a stable
+fingerprint; pre-existing accepted findings live in
+``analysis/baseline.json`` so the CI gate is zero-NEW-findings. One-off
+intentional sites can instead carry an inline pragma on (or immediately
+above) the flagged line::
+
+    x = np.asarray(probe)  # kmls-verify: allow[hotpath] one-time probe
+
+Run locally: ``python scripts/kmls_verify.py`` (see README "Static
+invariants").
+"""
+
+from __future__ import annotations
+
+from .core import (
+    AnalysisConfig,
+    Finding,
+    ProjectIndex,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+__all__ = [
+    "AnalysisConfig",
+    "Finding",
+    "ProjectIndex",
+    "load_baseline",
+    "run_analysis",
+    "write_baseline",
+]

@@ -1,0 +1,182 @@
+"""Best-effort project call graph.
+
+Resolution is deliberately CONSERVATIVE — an edge exists only when the
+callee is identifiable without type inference:
+
+- ``name(...)``            → function in the same module, or a
+                             ``from X import name`` project import, or a
+                             project class (edge to ``Class.__init__``);
+- ``self.m(...)``          → method ``m`` of the enclosing class;
+- ``self.attr.m(...)``     → method ``m`` of ``attr``'s class, when
+                             ``__init__`` annotated/constructed it
+                             (:attr:`ProjectIndex.attr_types`);
+- ``mod.f(...)``           → function ``f`` in the project module bound
+                             to local name ``mod``;
+- ``Class.m(...)``         → that class's method.
+
+Anything else (calls through locals, parameters, callbacks, returned
+closures) produces NO edge: a missed edge can hide a violation, but a
+fabricated edge would fabricate a violation, and a CI gate must not cry
+wolf. The nested-closure rule in :func:`core.iter_nodes_shallow` is part
+of the same stance — a closure's body joins the graph only where the
+closure itself is visibly invoked.
+
+For forbidden-construct matching, every call site also gets a DOTTED
+NAME (``"time.sleep"``, ``"np.asarray"``, ``"open"``) resolved through
+the module's import aliases, plus the bare method name for
+receiver-independent rules (``.item()``, ``.result()``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from .core import FunctionInfo, ProjectIndex, iter_nodes_shallow
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One call expression inside a function body (nested scopes
+    excluded)."""
+
+    line: int
+    dotted: str | None  # "time.sleep", "open", … None when unresolvable
+    method: str | None  # bare attr name for ".item()"-style rules
+    target: str | None  # project function ref "relpath::qualname"
+
+
+def _dotted_name(node: ast.AST) -> str | None:
+    """Flatten Name/Attribute chains → "a.b.c" (None on anything else)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(
+    index: ProjectIndex, caller: FunctionInfo, call: ast.Call
+) -> CallSite:
+    func = call.func
+    line = call.lineno
+    mod = index.modules[caller.relpath]
+    dotted = _dotted_name(func)
+    method = func.attr if isinstance(func, ast.Attribute) else None
+    target: FunctionInfo | None = None
+
+    if isinstance(func, ast.Name):
+        name = func.id
+        target = index.functions.get((caller.relpath, name))
+        if target is None and name in mod.name_imports:
+            src_rel, src_name = mod.name_imports[name]
+            target = index.functions.get((src_rel, src_name))
+            if target is None:
+                # imported project CLASS: constructor edge
+                if index.classes.get(src_name) is not None:
+                    target = index.class_method(src_name, "__init__")
+        if target is None and index.classes.get(name) == caller.relpath:
+            target = index.class_method(name, "__init__")
+
+    elif isinstance(func, ast.Attribute):
+        value = func.value
+        # self.m(...)
+        if isinstance(value, ast.Name) and value.id == "self":
+            if caller.class_name:
+                target = index.class_method(caller.class_name, func.attr)
+        # self.attr.m(...)
+        elif (
+            isinstance(value, ast.Attribute)
+            and isinstance(value.value, ast.Name)
+            and value.value.id == "self"
+            and caller.class_name
+        ):
+            attr_cls = index.attr_types.get(
+                (caller.class_name, value.attr)
+            )
+            if attr_cls:
+                target = index.class_method(attr_cls, func.attr)
+        # mod.f(...) / Class.m(...)
+        elif isinstance(value, ast.Name):
+            name = value.id
+            if name in mod.module_imports:
+                target = index.functions.get(
+                    (mod.module_imports[name], func.attr)
+                )
+            elif index.classes.get(name) is not None:
+                target = index.class_method(name, func.attr)
+            elif name in mod.external_imports:
+                # canonicalize through the alias so "import numpy as np"
+                # and "import numpy" both match "numpy.*" rules; the
+                # local alias spelling is kept too via `dotted`
+                root = mod.external_imports[name].split(".")[0]
+                dotted = f"{root}.{func.attr}"
+
+    return CallSite(
+        line=line,
+        dotted=dotted,
+        method=method,
+        target=target.ref if target is not None else None,
+    )
+
+
+def function_calls(
+    index: ProjectIndex, info: FunctionInfo
+) -> list[CallSite]:
+    """Every call site in ``info``'s own scope (closures excluded)."""
+    out: list[CallSite] = []
+    for node in iter_nodes_shallow(info.node):
+        if isinstance(node, ast.Call):
+            out.append(resolve_call(index, info, node))
+    return out
+
+
+class CallGraph:
+    """Edges + memoized per-function call sites over a ProjectIndex."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self._sites: dict[str, list[CallSite]] = {}
+
+    def sites(self, ref: str) -> list[CallSite]:
+        if ref not in self._sites:
+            info = self.index.function(ref)
+            self._sites[ref] = (
+                function_calls(self.index, info) if info else []
+            )
+        return self._sites[ref]
+
+    def reachable(self, entries: Iterable[str]) -> dict[str, list[str]]:
+        """BFS from ``entries`` → ``{ref: call path from an entry}``.
+        The path (entry → … → ref) makes findings explainable."""
+        paths: dict[str, list[str]] = {}
+        queue: list[str] = []
+        for entry in entries:
+            if self.index.function(entry) and entry not in paths:
+                paths[entry] = [entry]
+                queue.append(entry)
+        while queue:
+            ref = queue.pop(0)
+            for site in self.sites(ref):
+                tgt = site.target
+                if tgt is not None and tgt not in paths:
+                    paths[tgt] = paths[ref] + [tgt]
+                    queue.append(tgt)
+        return paths
+
+
+def match_forbidden(
+    site: CallSite,
+    forbidden_calls: Iterable[str],
+    forbidden_methods: Iterable[str],
+) -> str | None:
+    """→ the matched construct name, or None."""
+    if site.dotted is not None and site.dotted in forbidden_calls:
+        return site.dotted
+    if site.method is not None and site.method in forbidden_methods:
+        return f".{site.method}()"
+    return None

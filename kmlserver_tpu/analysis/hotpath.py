@@ -1,0 +1,62 @@
+"""Checker 1 — hot-path purity.
+
+PR 1's serving contract: the dispatch path (batcher admission/collection
+→ engine replica dispatch) never compiles, never host-syncs, never does
+file I/O, never sleeps. A compile or a blocking transfer on this path is
+a multi-millisecond tail landing inside every request of a batch — the
+exact regression class PR 1's bucketed pre-warming eliminated (p99 51.2
+→ 7.2 ms). Runtime evidence exists (the compile-counter test, the
+unwarmed-dispatch counter) but only fires AFTER a bad diff ships; this
+checker rejects the diff.
+
+Mechanics: BFS the call graph from the configured dispatch entry points
+(``AnalysisConfig.hotpath_entries``) and flag every forbidden construct
+(``time.sleep``, ``open``, ``np.asarray``, ``jax.jit``,
+``block_until_ready``, ``.item()``, ``.result()``, pickle/json file I/O,
+…) in any reachable function body. Completion-side closures — the
+``finish()`` callables, which block on the device BY DESIGN — never join
+the graph because nested defs are only traversed where they are visibly
+called (see callgraph module docstring).
+"""
+
+from __future__ import annotations
+
+from .callgraph import CallGraph, match_forbidden
+from .core import SEVERITY_ERROR, AnalysisConfig, Finding, ProjectIndex
+
+
+def run(index: ProjectIndex, cfg: AnalysisConfig) -> list[Finding]:
+    graph = CallGraph(index)
+    paths = graph.reachable(cfg.hotpath_entries)
+    findings: list[Finding] = []
+    for ref, path in paths.items():
+        info = index.function(ref)
+        if info is None:
+            continue
+        for site in graph.sites(ref):
+            construct = match_forbidden(
+                site,
+                cfg.hotpath_forbidden_calls,
+                cfg.hotpath_forbidden_methods,
+            )
+            if construct is None:
+                continue
+            via = " -> ".join(p.split("::", 1)[1] for p in path)
+            findings.append(
+                Finding(
+                    checker="hotpath",
+                    severity=SEVERITY_ERROR,
+                    file=info.relpath,
+                    line=site.line,
+                    key=f"{construct}@{info.qualname}",
+                    message=(
+                        f"host-sync/blocking construct `{construct}` in "
+                        f"`{info.qualname}`, reachable from the serving "
+                        f"dispatch path ({via}); compiles, host syncs, "
+                        "file I/O and sleeps are forbidden here — move it "
+                        "off the dispatch path (publication/completion "
+                        "side) or justify with a pragma/baseline entry"
+                    ),
+                )
+            )
+    return findings

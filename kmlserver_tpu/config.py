@@ -50,6 +50,128 @@ def _getenv_bitpack_threshold() -> int | str | None:
     return int(raw)
 
 
+# ---------------------------------------------------------------------------
+# The env-knob registry — THE declaration point for every KMLS_* knob.
+#
+# kmls-verify's `knobs` checker (kmlserver_tpu/analysis/registries.py)
+# enforces, in CI: every knob read anywhere in the code is declared here;
+# every entry here is still read somewhere (no dead docs); every entry has a
+# README row; and runtime scopes are bound or documented in the Kubernetes
+# manifest that deploys them. Scopes:
+#
+#   "serving" — read by the API pod           (kubernetes/deployment.yaml)
+#   "mining"  — read by the batch mining job  (kubernetes/job*.yaml)
+#   "both"    — read by both workloads        (all three manifests)
+#   "tool"    — bench/sweep/dev harness only  (never shipped in manifests)
+#   "fault"   — fault injection (faults.py)   (chaos tests must exercise it)
+#
+# Adding a knob = add the os.getenv read, an entry here, and a README row
+# (+ a manifest line for runtime scopes) — or CI's verify job rejects the
+# diff, naming exactly what is missing.
+# ---------------------------------------------------------------------------
+KNOB_REGISTRY: dict[str, str] = {
+    # --- serving: request path / transport ---
+    "KMLS_PORT": "serving",
+    "KMLS_HTTP_IMPL": "serving",
+    "KMLS_MAX_SEED_TRACKS": "serving",
+    "KMLS_BATCH_WINDOW_MS": "serving",
+    "KMLS_BATCH_MAX_SIZE": "serving",
+    "KMLS_BATCH_ADAPTIVE": "serving",
+    "KMLS_BATCH_WINDOW_MIN_MS": "serving",
+    "KMLS_BATCH_MAX_INFLIGHT": "serving",
+    "KMLS_SHED_QUEUE_BUDGET_MS": "serving",
+    "KMLS_SHED_RETRY_AFTER_S": "serving",
+    "KMLS_SERVE_DEVICES": "serving",
+    "KMLS_CACHE_ENABLED": "serving",
+    "KMLS_CACHE_MAX_ENTRIES": "serving",
+    "KMLS_PREFER_TENSOR_ARTIFACT": "serving",
+    "KMLS_NATIVE_SERVE": "serving",
+    "KMLS_DRAIN_SETTLE_S": "serving",
+    "KMLS_GIL_SWITCH_S": "serving",
+    # --- serving: fault tolerance ---
+    "KMLS_VERIFY_MANIFEST": "serving",
+    "KMLS_QUARANTINE_AFTER_FAILURES": "serving",
+    "KMLS_RELOAD_BACKOFF_BASE_S": "serving",
+    "KMLS_RELOAD_BACKOFF_MAX_S": "serving",
+    "KMLS_REPLICA_EJECT_THRESHOLD": "serving",
+    "KMLS_REPLICA_PROBE_INTERVAL_S": "serving",
+    "KMLS_REDISPATCH_MAX_RETRIES": "serving",
+    "KMLS_REQUEST_DEADLINE_MS": "serving",
+    "KMLS_FALLBACK_BUDGET_MS": "serving",
+    # --- mining: semantics / device dispatch ---
+    "KMLS_MAX_ITEMSET_LEN": "mining",
+    "KMLS_K_MAX_CONSEQUENTS": "mining",
+    "KMLS_CONFIDENCE_MODE": "mining",
+    "KMLS_MIN_CONFIDENCE": "mining",
+    "KMLS_MESH_SHAPE": "mining",
+    "KMLS_BITPACK_THRESHOLD_ELEMS": "mining",
+    "KMLS_BITPACK_IMPL": "mining",
+    "KMLS_HBM_BUDGET_BYTES": "mining",
+    "KMLS_SHARDED_IMPL": "mining",
+    "KMLS_PRUNE_VOCAB_THRESHOLD": "mining",
+    "KMLS_WRITE_TENSOR_ARTIFACT": "mining",
+    "KMLS_WRITE_MANIFEST": "mining",
+    "KMLS_REFERENCE_RACE_COMPAT": "mining",
+    "KMLS_NATIVE_PAIR_COUNTS": "mining",
+    "KMLS_NATIVE_PAIR_METHOD": "mining",
+    "KMLS_NATIVE_THREADS": "mining",
+    "KMLS_POPCOUNT_VARIANT": "mining",
+    "KMLS_POPCOUNT_SWAR": "mining",
+    "KMLS_POPCOUNT_TILE_I": "mining",
+    "KMLS_POPCOUNT_TILE_J": "mining",
+    "KMLS_POPCOUNT_WORD_CHUNK": "mining",
+    "KMLS_PROFILE_DIR": "mining",
+    # --- mining: preemption-proofing / multi-host ---
+    "KMLS_CKPT_ENABLED": "mining",
+    "KMLS_CKPT_DIR": "mining",
+    "KMLS_CKPT_QUARANTINE_AFTER": "mining",
+    "KMLS_LEASE_ENABLED": "mining",
+    "KMLS_LEASE_TTL_S": "mining",
+    "KMLS_LEASE_HEARTBEAT_S": "mining",
+    "KMLS_RANK_TIMEOUT_S": "mining",
+    "KMLS_RANK_HEARTBEAT_S": "mining",
+    "KMLS_COLLECTIVE_TIMEOUT_S": "mining",
+    "KMLS_COORDINATOR_ADDRESS": "mining",
+    "KMLS_NUM_PROCESSES": "mining",
+    "KMLS_PROCESS_ID": "mining",
+    # --- both workloads ---
+    "KMLS_NATIVE": "both",
+    "KMLS_JAX_CACHE_DIR": "both",
+    # --- bench / sweep / dev harness ---
+    "KMLS_BENCH_CPU": "tool",
+    "KMLS_BENCH_DEADLINE_S": "tool",
+    "KMLS_BENCH_SIDECAR": "tool",
+    "KMLS_BENCH_STATE": "tool",
+    "KMLS_BENCH_STATE_MAX_AGE_S": "tool",
+    "KMLS_BENCH_STARTUP_GRACE_S": "tool",
+    "KMLS_BENCH_PROBE_INTERVAL_S": "tool",
+    "KMLS_BENCH_PROBE_TIMEOUT_S": "tool",
+    "KMLS_BENCH_PROBE_TIMEOUT_DECAY_S": "tool",
+    "KMLS_BENCH_REPLAY_QPS": "tool",
+    "KMLS_BENCH_REPLAY_REQUESTS": "tool",
+    "KMLS_BENCH_REPLAY_RUNS": "tool",
+    "KMLS_BENCH_REPLAY_WARMUP": "tool",
+    "KMLS_BENCH_REPLAY_WORKERS": "tool",
+    "KMLS_BENCH_REPLAY_QUEUE": "tool",
+    "KMLS_BENCH_REPLAY10K_QPS": "tool",
+    "KMLS_BENCH_REPLAY10K_REQUESTS": "tool",
+    "KMLS_BENCH_REPLAY10K_ZIPF_S": "tool",
+    "KMLS_BENCH_CHAOS_QPS": "tool",
+    "KMLS_BENCH_CHAOS_REQUESTS": "tool",
+    "KMLS_BENCH_CHAOS_ZIPF_S": "tool",
+    "KMLS_BENCH_RESUME_PHASE": "tool",
+    "KMLS_SWEEP_START": "tool",
+    "KMLS_SWEEP_STOP": "tool",
+    "KMLS_SWEEP_STEP": "tool",
+    # --- fault injection (faults.py switchboard) ---
+    "KMLS_FAULT_RELOAD_FAIL": "fault",
+    "KMLS_FAULT_REPLICA_FAIL": "fault",
+    "KMLS_FAULT_REPLICA_DELAY_MS": "fault",
+    "KMLS_FAULT_MINE_CRASH_PHASE": "fault",
+    "KMLS_FAULT_CKPT_CORRUPT": "fault",
+    "KMLS_FAULT_RANK_DEAD": "fault",
+}
+
 # Columns dropped from the raw CSV before any processing
 # (reference: machine-learning/main.py:42).
 DROP_COLUMNS = ("duration_ms",)

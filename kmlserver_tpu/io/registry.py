@@ -57,7 +57,9 @@ def write_dataset_list(cfg: MiningConfig, datasets: list[str]) -> None:
 def read_dataset_list(cfg: MiningConfig) -> list[str]:
     """Read the persisted dataset list (reference: main.py:322-327)."""
     text = read_text(_datasets_list_path(cfg))
-    return [line for line in (l.strip() for l in text.splitlines()) if line]
+    return [
+        line for line in (raw.strip() for raw in text.splitlines()) if line
+    ]
 
 
 def get_dataset_list(cfg: MiningConfig, persist: bool = True) -> list[str]:

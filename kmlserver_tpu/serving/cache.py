@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from typing import Any, Callable
 
 
 class RecommendCache:
@@ -94,7 +95,9 @@ class RecommendCache:
 
     # ---------- singleflight ----------
 
-    def join_or_lead(self, key: tuple, submit):
+    def join_or_lead(
+        self, key: tuple, submit: Callable[[], Any]
+    ) -> tuple[Any, bool]:
         """→ ``(future, joined)``. Atomically joins the in-flight future
         for ``key``, or installs ``submit()``'s future as the new leader.
         ``submit`` may raise (e.g. the batcher's Overloaded shed) — then
@@ -112,7 +115,7 @@ class RecommendCache:
             self._inflight[key] = future
             return future, False
 
-    def finish(self, key: tuple, future) -> None:
+    def finish(self, key: tuple, future: Any) -> None:
         """Leader's done-callback: retire the in-flight entry and store
         the answer on success (failures — sheds included — cache nothing)."""
         with self._lock:

@@ -85,6 +85,8 @@ def _staging_is_safe() -> bool:
         probe = np.full((2, 2), -1, dtype=np.int32)
         on_device = jax.device_put(probe)
         probe[0, 0] = 123
+        # kmls-verify: allow[hotpath] — one 2x2 probe, cached for the
+        # process lifetime; steady-state dispatches never reach this sync
         _HOST_STAGING_SAFE = int(np.asarray(on_device)[0, 0]) == -1
         if not _HOST_STAGING_SAFE:
             logger.warning(

@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""kmls-verify CLI — run the project-invariant static analyzer.
+
+Usage (from the repo root)::
+
+    python scripts/kmls_verify.py                 # all six checkers
+    python scripts/kmls_verify.py --checker knobs --checker locks
+    python scripts/kmls_verify.py --json          # machine-readable
+    python scripts/kmls_verify.py --write-baseline  # accept current findings
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings, 2 =
+usage/internal error. CI runs this as the `verify` job gate; see README
+"Static invariants" for what each checker enforces and how suppressions
+work (inline `# kmls-verify: allow[<checker>]` pragma, or a pinned entry
+in kmlserver_tpu/analysis/baseline.json — prefer fixing the finding).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from kmlserver_tpu.analysis import (  # noqa: E402  (path bootstrap above)
+    AnalysisConfig,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from kmlserver_tpu.analysis.core import (  # noqa: E402
+    all_checkers,
+    load_baseline_entries,
+)
+
+DEFAULT_BASELINE = os.path.join(
+    "kmlserver_tpu", "analysis", "baseline.json"
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kmls_verify", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--root", default=_REPO_ROOT, help="repo root (default: auto)"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help=f"suppression file (default: <root>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--checker",
+        action="append",
+        choices=sorted(all_checkers()),
+        help="run only these checkers (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept every current finding into the baseline file",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="JSON output instead of text"
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    baseline = load_baseline(baseline_path)
+    try:
+        result = run_analysis(
+            root,
+            AnalysisConfig(),
+            checkers=args.checker,
+            baseline=baseline,
+        )
+    except ValueError as exc:
+        print(f"kmls-verify: {exc}", file=sys.stderr)
+        return 2
+
+    new = result["findings"]
+    if args.write_baseline:
+        # with a --checker subset, carry the UNSELECTED checkers' pins
+        # over verbatim — a partial run must not un-pin what it didn't
+        # even look at
+        keep = []
+        if args.checker:
+            selected = set(args.checker)
+            keep = [
+                e
+                for e in load_baseline_entries(baseline_path)
+                if e["fingerprint"].split("::", 1)[0] not in selected
+            ]
+        write_baseline(
+            baseline_path, new + result["baselined"], keep_entries=keep
+        )
+        print(
+            f"kmls-verify: baseline written to {baseline_path} "
+            f"({len(new) + len(result['baselined']) + len(keep)} pinned "
+            "finding(s))"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    key: [f.__dict__ for f in result[key]]
+                    for key in ("findings", "baselined", "suppressed")
+                },
+                indent=1,
+            )
+        )
+    else:
+        for finding in new:
+            print(finding.render())
+        summary = (
+            f"kmls-verify: {len(new)} new finding(s), "
+            f"{len(result['baselined'])} baselined, "
+            f"{len(result['suppressed'])} pragma-suppressed"
+        )
+        print(summary)
+        if new:
+            print(
+                "Fix the findings, or (rarely) suppress: inline "
+                "`# kmls-verify: allow[<checker>]` on the flagged line, "
+                "or pin in kmlserver_tpu/analysis/baseline.json "
+                "(see README 'Static invariants').",
+                file=sys.stderr,
+            )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,786 @@
+"""kmls-verify static analyzer: per-checker fixture proofs + the
+real-tree acceptance gate.
+
+Every checker gets one KNOWN-BAD fixture (a seeded violation it must
+flag) and one KNOWN-GOOD fixture (the compliant twin it must stay quiet
+on) — the analyzer parses trees rather than importing them, so fixtures
+are tiny synthetic repos written into tmp_path. The acceptance test then
+runs the full default configuration against the REAL repository and
+requires zero non-baselined findings: the CI `verify` job is this test,
+twice (once here, once as the CLI gate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kmlserver_tpu.analysis import (
+    AnalysisConfig,
+    ProjectIndex,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(
+    REPO_ROOT, "kmlserver_tpu", "analysis", "baseline.json"
+)
+
+
+# ---------------------------------------------------------------------------
+# fixture scaffolding
+# ---------------------------------------------------------------------------
+
+
+def write_tree(root, files: dict[str, str]) -> None:
+    for relpath, content in files.items():
+        path = os.path.join(root, relpath)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(textwrap.dedent(content))
+
+
+def run_fixture(
+    root, cfg: AnalysisConfig, checkers: list[str], baseline=None
+):
+    index = ProjectIndex.from_config(str(root), cfg)
+    return run_analysis(
+        str(root), cfg, checkers=checkers, baseline=baseline, index=index
+    )
+
+
+def fixture_cfg(**overrides) -> AnalysisConfig:
+    cfg = AnalysisConfig(
+        package_dir="pkg",
+        extra_code=(),
+        tests_dir="tests",
+        readme="README.md",
+        manifest_files=("k8s/deploy.yaml", "k8s/job.yaml"),
+        config_file="pkg/config.py",
+        faults_file="pkg/faults.py",
+        job_file="pkg/job.py",
+        job_manifests=("k8s/job.yaml",),
+        atomic_allowed_modules=("pkg/writer.py",),
+        atomic_allowed_functions=(),
+        hotpath_entries=("pkg/serve.py::Batcher.dispatch",),
+        hot_locks=("Cache._lock",),
+    )
+    for key, value in overrides.items():
+        setattr(cfg, key, value)
+    cfg.knob_scope_manifests = {
+        "serving": ("k8s/deploy.yaml",),
+        "mining": ("k8s/job.yaml",),
+        "both": ("k8s/deploy.yaml", "k8s/job.yaml"),
+        "tool": (),
+        "fault": (),
+    }
+    return cfg
+
+
+def keys(result, checker=None):
+    return {
+        f.key
+        for f in result["findings"]
+        if checker is None or f.checker == checker
+    }
+
+
+# ---------------------------------------------------------------------------
+# checker 1: hot-path purity
+# ---------------------------------------------------------------------------
+
+_HOTPATH_BAD = """
+    import time
+    import numpy as np
+
+    def helper(x):
+        time.sleep(0.1)
+        return np.asarray(x)
+
+    class Batcher:
+        def dispatch(self, batch):
+            return helper(batch)
+    """
+
+_HOTPATH_GOOD = """
+    import numpy as np
+
+    def helper(x):
+        return [len(s) for s in x]
+
+    class Batcher:
+        def dispatch(self, batch):
+            # defining (not calling) a blocking closure is fine: the
+            # completion side blocks BY DESIGN and must not be flagged
+            def finish():
+                return np.asarray(batch)
+
+            helper(batch)
+            return finish
+    """
+
+
+def test_hotpath_flags_seeded_violation(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _HOTPATH_BAD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["hotpath"])
+    got = keys(result, "hotpath")
+    assert "time.sleep@helper" in got
+    assert any(k.startswith("numpy.asarray@helper") for k in got), got
+
+
+def test_hotpath_quiet_on_good_tree_and_closures(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _HOTPATH_GOOD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["hotpath"])
+    assert result["findings"] == []
+
+
+def test_hotpath_pragma_suppresses(tmp_path):
+    bad = _HOTPATH_BAD.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # kmls-verify: allow[hotpath] fixture",
+    )
+    write_tree(tmp_path, {"pkg/serve.py": bad})
+    result = run_fixture(tmp_path, fixture_cfg(), ["hotpath"])
+    assert "time.sleep@helper" not in keys(result)
+    assert any(
+        f.key == "time.sleep@helper" for f in result["suppressed"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# checker 2: lock order + blocking under lock
+# ---------------------------------------------------------------------------
+
+_LOCKS_BAD = """
+    import threading
+    import time
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._other = threading.Lock()
+
+        def slow_get(self):
+            with self._lock:
+                time.sleep(0.5)
+
+        def ab(self):
+            with self._lock:
+                with self._other:
+                    pass
+
+        def ba(self):
+            with self._other:
+                with self._lock:
+                    pass
+    """
+
+_LOCKS_GOOD = """
+    import threading
+    import time
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._other = threading.Lock()
+
+        def fast_get(self):
+            with self._lock:
+                value = 1
+            time.sleep(0.0)  # outside the critical section: fine
+            return value
+
+        def ordered_a(self):
+            with self._lock:
+                with self._other:
+                    pass
+
+        def ordered_b(self):
+            # same global order as ordered_a: no cycle
+            with self._lock:
+                with self._other:
+                    pass
+    """
+
+_LOCKS_INTERPROC_BAD = """
+    import threading
+
+    def do_io(path):
+        with open(path, "r") as fh:
+            return fh.read()
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def get(self, path):
+            with self._lock:
+                return do_io(path)
+    """
+
+
+def test_locks_flags_blocking_and_cycle(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _LOCKS_BAD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["locks"])
+    got = keys(result, "locks")
+    assert "block:Cache._lock:time.sleep@Cache.slow_get" in got
+    assert any(k.startswith("cycle:") for k in got), got
+
+
+def test_locks_flags_blocking_through_calls(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _LOCKS_INTERPROC_BAD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["locks"])
+    assert "block:Cache._lock:open@Cache.get" in keys(result, "locks")
+
+
+def test_locks_quiet_on_good_tree(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _LOCKS_GOOD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["locks"])
+    assert result["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# checker 3: atomic-write enforcement
+# ---------------------------------------------------------------------------
+
+_ATOMIC_BAD = """
+    import pickle
+
+    def publish(obj, path):
+        with open(path, "wb") as fh:
+            pickle.dump(obj, fh)
+    """
+
+_ATOMIC_GOOD_WRITER = """
+    import os
+    import pickle
+
+    def save_pickle(obj, path):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(obj, fh)
+        os.replace(tmp, path)
+    """
+
+_ATOMIC_GOOD_CALLER = """
+    from .writer import save_pickle
+
+    def publish(obj, path):
+        save_pickle(obj, path)
+
+    def read(path):
+        with open(path, "rb") as fh:
+            return fh.read()
+    """
+
+
+def test_atomic_flags_bare_pickle_dump(tmp_path):
+    write_tree(tmp_path, {"pkg/mine.py": _ATOMIC_BAD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["atomic-write"])
+    got = keys(result, "atomic-write")
+    assert "open(mode='wb')@publish" in got
+    assert "pickle.dump@publish" in got
+
+
+def test_atomic_allows_writer_module_and_reads(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/writer.py": _ATOMIC_GOOD_WRITER,
+            "pkg/mine.py": _ATOMIC_GOOD_CALLER,
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["atomic-write"])
+    assert result["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# checker 4: env-knob registry
+# ---------------------------------------------------------------------------
+
+_KNOBS_CONFIG = """
+    KNOB_REGISTRY: dict[str, str] = {
+        "KMLS_GOOD_KNOB": "serving",
+        "KMLS_ORPHAN_KNOB": "tool",
+    }
+    """
+
+_KNOBS_CODE = """
+    import os
+
+    def read():
+        good = os.getenv("KMLS_GOOD_KNOB", "1")
+        rogue = os.getenv("KMLS_ROGUE_KNOB")
+        return good, rogue
+    """
+
+
+def _knobs_tree(tmp_path, readme="KMLS_GOOD_KNOB KMLS_ORPHAN_KNOB",
+                deploy="env: KMLS_GOOD_KNOB"):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/config.py": _KNOBS_CONFIG,
+            "pkg/serve.py": _KNOBS_CODE,
+            "README.md": readme + "\n",
+            "k8s/deploy.yaml": deploy + "\n",
+            "k8s/job.yaml": "restartPolicy: Never\n",
+        },
+    )
+
+
+def test_knobs_flags_undeclared_orphan_and_undocumented(tmp_path):
+    _knobs_tree(tmp_path, readme="KMLS_ORPHAN_KNOB only", deploy="x: y")
+    result = run_fixture(tmp_path, fixture_cfg(), ["knobs"])
+    got = keys(result, "knobs")
+    assert "undeclared:KMLS_ROGUE_KNOB" in got
+    assert "orphan:KMLS_ORPHAN_KNOB" in got
+    assert "undocumented:KMLS_GOOD_KNOB" in got
+    assert "unbound:KMLS_GOOD_KNOB:k8s/deploy.yaml" in got
+
+
+def test_knobs_quiet_when_registries_agree(tmp_path):
+    _knobs_tree(tmp_path)
+    write_tree(
+        tmp_path,
+        {
+            "pkg/serve.py": """
+                import os
+
+                def read():
+                    return os.getenv("KMLS_GOOD_KNOB", "1")
+                """,
+            "pkg/config.py": """
+                KNOB_REGISTRY: dict[str, str] = {
+                    "KMLS_GOOD_KNOB": "serving",
+                }
+                """,
+            "README.md": "KMLS_GOOD_KNOB\n",
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["knobs"])
+    assert result["findings"] == []
+
+
+def test_knobs_sees_literals_inside_embedded_scripts(tmp_path):
+    # bench.py-style phase bracket: the knob read lives inside a string
+    _knobs_tree(tmp_path)
+    write_tree(
+        tmp_path,
+        {
+            "pkg/serve.py": (
+                "SCRIPT = '''\n"
+                "import os\n"
+                'qps = os.environ.get("KMLS_EMBEDDED_KNOB", "1")\n'
+                "'''\n"
+            ),
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["knobs"])
+    assert "undeclared:KMLS_EMBEDDED_KNOB" in keys(result, "knobs")
+
+
+# ---------------------------------------------------------------------------
+# checker 5: fault-site registry
+# ---------------------------------------------------------------------------
+
+_FAULTS_GOOD = """
+    import os
+
+    def inject(site, times=1):
+        pass
+
+    def fire(site, replica=None):
+        pass
+
+    def load_env():
+        raw = os.getenv("KMLS_FAULT_WIRED")
+        if raw:
+            inject("engine.boom", times=int(raw))
+    """
+
+_FAULTS_FIRE_SITE = """
+    from .faults import fire
+
+    def load():
+        fire("engine.boom")
+    """
+
+_FAULTS_DEAD_KNOB = """
+    import os
+
+    def inject(site, times=1):
+        pass
+
+    def fire(site, replica=None):
+        pass
+
+    def load_env():
+        raw = os.getenv("KMLS_FAULT_DEAD")
+        if raw:
+            inject("nowhere.fired", times=int(raw))
+    """
+
+
+def test_fault_sites_quiet_when_wired_and_tested(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/faults.py": _FAULTS_GOOD,
+            "pkg/engine.py": _FAULTS_FIRE_SITE,
+            "tests/test_chaos.py": (
+                'def test_boom(monkeypatch):\n'
+                '    monkeypatch.setenv("KMLS_FAULT_WIRED", "1")\n'
+            ),
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["fault-sites"])
+    assert result["findings"] == []
+
+
+def test_fault_sites_flags_dead_knob_and_untested(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/faults.py": _FAULTS_DEAD_KNOB,
+            "pkg/engine.py": _FAULTS_FIRE_SITE,
+            "tests/test_chaos.py": "def test_nothing():\n    pass\n",
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["fault-sites"])
+    got = keys(result, "fault-sites")
+    assert "dead-knob:KMLS_FAULT_DEAD" in got
+    # engine.boom is fired but no knob arms it -> dead chaos surface
+    assert "unarmed-site:engine.boom" in got
+
+
+def test_fault_sites_flags_untested_knob(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/faults.py": _FAULTS_GOOD,
+            "pkg/engine.py": _FAULTS_FIRE_SITE,
+            "tests/test_chaos.py": "def test_nothing():\n    pass\n",
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["fault-sites"])
+    assert "untested:KMLS_FAULT_WIRED" in keys(result, "fault-sites")
+
+
+# ---------------------------------------------------------------------------
+# checker 6: exit-code contract
+# ---------------------------------------------------------------------------
+
+_JOB_PY = """
+    EXIT_OK = 0
+    EXIT_FATAL_CONFIG = 64
+    EXIT_RESUMABLE = 75
+    EXIT_RANK_DEAD = 76
+    RETRYABLE_EXIT_CODES = (EXIT_RESUMABLE, EXIT_RANK_DEAD)
+    """
+
+_JOB_YAML_GOOD = """
+    spec:
+      podFailurePolicy:
+        rules:
+          - action: FailJob
+            onExitCodes:
+              operator: In
+              values: [64]
+          - action: Ignore
+            onExitCodes:
+              operator: In
+              values: [75, 76]
+      template:
+        spec:
+          restartPolicy: Never
+    """
+
+
+def test_exit_codes_quiet_when_contract_matches(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/job.py": _JOB_PY, "k8s/job.yaml": _JOB_YAML_GOOD},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["exit-codes"])
+    assert result["findings"] == []
+
+
+def test_exit_codes_flags_drifted_policy(tmp_path):
+    drifted = _JOB_YAML_GOOD.replace("[75, 76]", "[75]").replace(
+        "restartPolicy: Never", "restartPolicy: OnFailure"
+    )
+    write_tree(
+        tmp_path, {"pkg/job.py": _JOB_PY, "k8s/job.yaml": drifted}
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["exit-codes"])
+    got = keys(result, "exit-codes")
+    assert any(k.startswith("ignore-mismatch") for k in got), got
+    assert "restart-policy" in got
+
+
+def test_exit_codes_flags_new_code_without_policy(tmp_path):
+    # a NEW resumable code in job.py the manifest does not Ignore: the
+    # exact drift class this checker exists for
+    job = _JOB_PY.replace(
+        "RETRYABLE_EXIT_CODES = (EXIT_RESUMABLE, EXIT_RANK_DEAD)",
+        "EXIT_LEASE_LOST = 77\n"
+        "    RETRYABLE_EXIT_CODES = "
+        "(EXIT_RESUMABLE, EXIT_RANK_DEAD, EXIT_LEASE_LOST)",
+    )
+    write_tree(
+        tmp_path, {"pkg/job.py": job, "k8s/job.yaml": _JOB_YAML_GOOD}
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["exit-codes"])
+    assert any(
+        k.startswith("ignore-mismatch") for k in keys(result, "exit-codes")
+    )
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + CLI gate
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    write_tree(tmp_path, {"pkg/serve.py": _HOTPATH_BAD})
+    cfg = fixture_cfg()
+    first = run_fixture(tmp_path, cfg, ["hotpath"])
+    assert first["findings"]
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, first["findings"])
+    baseline = load_baseline(baseline_path)
+    second = run_fixture(tmp_path, cfg, ["hotpath"], baseline=baseline)
+    assert second["findings"] == []
+    assert len(second["baselined"]) == len(first["findings"])
+    # the baseline pins EXISTING findings only: a fresh violation in the
+    # same tree must still fail the gate
+    write_tree(
+        tmp_path,
+        {
+            "pkg/serve.py": _HOTPATH_BAD.replace(
+                "return helper(batch)",
+                "open('/tmp/x', 'r')\n            return helper(batch)",
+            )
+        },
+    )
+    third = run_fixture(tmp_path, cfg, ["hotpath"], baseline=baseline)
+    assert "open@Batcher.dispatch" in keys(third)
+
+
+def test_write_baseline_keeps_unselected_checkers_pins(tmp_path):
+    """--write-baseline with a --checker subset must not un-pin the
+    checkers it didn't run (CLI passes them via keep_entries)."""
+    path = str(tmp_path / "baseline.json")
+    write_tree(tmp_path, {"pkg/serve.py": _HOTPATH_BAD})
+    first = run_fixture(tmp_path, fixture_cfg(), ["hotpath"])
+    write_baseline(path, first["findings"])
+    from kmlserver_tpu.analysis.core import load_baseline_entries
+
+    prior = load_baseline_entries(path)
+    assert prior
+    # a "knobs-only" rewrite with no knobs findings must keep them
+    write_baseline(path, [], keep_entries=prior)
+    assert load_baseline(path) == {e["fingerprint"] for e in prior}
+
+
+def test_atomic_flags_writes_in_closures_and_module_level(tmp_path):
+    """A bare pickle.dump hidden in a nested closure (or at module
+    level) must still fail the gate — the closure exemption is a hotpath
+    design stance, not an atomic-write one."""
+    write_tree(
+        tmp_path,
+        {
+            "pkg/mine.py": """
+                import pickle
+
+                def publish(obj, path):
+                    def _w():
+                        with open(path, "wb") as fh:
+                            pickle.dump(obj, fh)
+                    _w()
+
+                with open("/tmp/side-effect", "a") as fh:
+                    fh.write("x")
+                """
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["atomic-write"])
+    got = keys(result, "atomic-write")
+    assert "pickle.dump@publish" in got
+    assert "open(mode='a')@<module>" in got
+
+
+def test_knobs_docstring_mentions_do_not_count_as_reads(tmp_path):
+    """A knob mentioned only in prose is an orphan (nothing reads it),
+    and a knob-shaped token in a docstring demands no registry entry."""
+    _knobs_tree(tmp_path)
+    write_tree(
+        tmp_path,
+        {
+            "pkg/serve.py": '''
+                """Module docs mention KMLS_GOOD_KNOB and invent
+                KMLS_DOCSTRING_ONLY_KNOB — neither is a read."""
+
+                def helper():
+                    """KMLS_ORPHAN_KNOB in prose is not a read either."""
+                    return 1
+                ''',
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["knobs"])
+    got = keys(result, "knobs")
+    assert "orphan:KMLS_GOOD_KNOB" in got
+    assert "orphan:KMLS_ORPHAN_KNOB" in got
+    assert not any("KMLS_DOCSTRING_ONLY_KNOB" in k for k in got)
+
+
+def test_fault_sites_pairs_nested_getenv_inject(tmp_path):
+    """`inject("s", times=int(os.getenv(...)))` on one line must pair
+    the knob with ITS OWN inject, not drift to a neighbor."""
+    write_tree(
+        tmp_path,
+        {
+            "pkg/faults.py": """
+                import os
+
+                def inject(site, times=1):
+                    pass
+
+                def fire(site, replica=None):
+                    pass
+
+                def load_env():
+                    inject("engine.boom", times=int(os.getenv("KMLS_FAULT_WIRED") or 1))
+                    raw = os.getenv("KMLS_FAULT_OTHER")
+                    if raw:
+                        inject("other.site", times=int(raw))
+                """,
+            "pkg/engine.py": _FAULTS_FIRE_SITE,
+            "tests/test_chaos.py": (
+                'X = ("KMLS_FAULT_WIRED", "KMLS_FAULT_OTHER")\n'
+            ),
+        },
+    )
+    from kmlserver_tpu.analysis.registries import collect_fault_env_map
+
+    cfg = fixture_cfg()
+    index = ProjectIndex.from_config(str(tmp_path), cfg)
+    env_map = collect_fault_env_map(index, cfg)
+    assert env_map["KMLS_FAULT_WIRED"][0] == "engine.boom"
+    assert env_map["KMLS_FAULT_OTHER"][0] == "other.site"
+
+
+def test_exit_codes_accepts_second_fatal_code_when_policied(tmp_path):
+    """A new fatal code with a matching FailJob rule is NOT a finding;
+    the fatal set is derived (non-zero, non-retryable), not name-bound
+    to EXIT_FATAL_CONFIG."""
+    job = _JOB_PY.replace(
+        "EXIT_FATAL_CONFIG = 64", "EXIT_FATAL_CONFIG = 64\n    EXIT_FATAL_DATA = 65"
+    )
+    good = _JOB_YAML_GOOD.replace("[64]", "[64, 65]")
+    write_tree(tmp_path, {"pkg/job.py": job, "k8s/job.yaml": good})
+    result = run_fixture(tmp_path, fixture_cfg(), ["exit-codes"])
+    assert result["findings"] == []
+    # …and without the manifest rule, it IS a finding
+    write_tree(
+        tmp_path, {"pkg/job.py": job, "k8s/job.yaml": _JOB_YAML_GOOD}
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["exit-codes"])
+    assert any(
+        k.startswith("failjob-mismatch")
+        for k in keys(result, "exit-codes")
+    )
+
+
+def test_baseline_file_is_valid_and_documented():
+    with open(BASELINE, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    assert data["version"] == 1
+    for entry in data["findings"]:
+        assert entry["fingerprint"].count("::") == 2
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_runs_clean():
+    """Acceptance: the shipped configuration + baseline yields zero new
+    findings on the repository itself — the exact CI gate."""
+    result = run_analysis(
+        REPO_ROOT, AnalysisConfig(), baseline=load_baseline(BASELINE)
+    )
+    assert result["findings"] == [], "\n".join(
+        f.render() for f in result["findings"]
+    )
+
+
+def test_real_tree_indexes_the_things_checkers_depend_on():
+    """Guard the analyzer's blind spots: if renames move these anchors,
+    the checkers would silently check nothing — fail loudly instead."""
+    cfg = AnalysisConfig()
+    index = ProjectIndex.from_config(REPO_ROOT, cfg)
+    for entry in cfg.hotpath_entries:
+        assert index.function(entry) is not None, entry
+    from kmlserver_tpu.analysis.locking import discover_locks
+    from kmlserver_tpu.analysis.registries import (
+        collect_code_knobs,
+        collect_fault_env_map,
+        collect_fire_sites,
+        parse_knob_registry,
+    )
+
+    locks, aliases = discover_locks(index)
+    assert len(locks) >= 14, sorted(lk.render() for lk in locks)
+    # the Condition wraps _n_lock: acquiring it IS acquiring the lock
+    assert any(
+        c.attr == "_pipe_cond" and aliases[c].attr == "_n_lock"
+        for c in aliases
+    )
+    scopes, _lines, _line = parse_knob_registry(index, cfg)
+    refs = collect_code_knobs(index, cfg)
+    assert len(refs) >= 70 and set(refs) <= set(scopes)
+    env_map = collect_fault_env_map(index, cfg)
+    assert len(env_map) == 6, env_map
+    sites = collect_fire_sites(index, cfg)
+    assert {"engine.load", "replica.kernel", "ckpt.corrupt"} <= sites
+
+
+def test_cli_exit_codes(tmp_path):
+    """The CLI is the CI gate: clean tree -> 0, violation -> 1."""
+    script = os.path.join(REPO_ROOT, "scripts", "kmls_verify.py")
+    clean = subprocess.run(
+        [sys.executable, script, "--checker", "exit-codes"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    # seed a violation into a COPY of the tree shape the checker reads
+    write_tree(
+        tmp_path,
+        {
+            "pkg/job.py": _JOB_PY,
+            "k8s/job.yaml": _JOB_YAML_GOOD.replace("[64]", "[63]"),
+        },
+    )
+    cfg = fixture_cfg()
+    result = run_fixture(tmp_path, cfg, ["exit-codes"])
+    assert result["findings"], "seeded manifest drift must be caught"
+
+
+@pytest.mark.parametrize(
+    "checker",
+    ["hotpath", "locks", "atomic-write", "knobs", "fault-sites", "exit-codes"],
+)
+def test_every_checker_registered(checker):
+    from kmlserver_tpu.analysis.core import all_checkers
+
+    assert checker in all_checkers()

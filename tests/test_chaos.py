@@ -17,7 +17,7 @@ import time
 import pytest
 
 from kmlserver_tpu import faults
-from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.config import MiningConfig
 from kmlserver_tpu.io import artifacts, registry
 from kmlserver_tpu.serving.app import RecommendApp
 from kmlserver_tpu.serving.batcher import (

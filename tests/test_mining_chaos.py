@@ -16,7 +16,6 @@ All tests carry the ``chaos`` marker (the dedicated CI job runs
 """
 
 import dataclasses
-import hashlib
 import os
 import subprocess
 import sys

@@ -10,7 +10,6 @@ import threading
 import jax
 import pytest
 
-from kmlserver_tpu.config import ServingConfig
 from kmlserver_tpu.serving.batcher import MicroBatcher
 from kmlserver_tpu.serving.engine import RecommendEngine
 from kmlserver_tpu.serving.metrics import ServingMetrics

@@ -1,8 +1,6 @@
 """Native C++ CSV loader: parse correctness vs the pandas path, tricky
 RFC-4180 inputs, and the facade fallback."""
 
-import os
-import subprocess
 
 import numpy as np
 import pytest

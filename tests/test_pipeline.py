@@ -3,7 +3,6 @@ artifact contract, oracle parity of the recommendations pickle, dataset
 rotation across runs, duplicate-artist validation failure."""
 
 import os
-import pickle
 
 import numpy as np
 import pytest

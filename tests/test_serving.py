@@ -8,7 +8,6 @@ import threading
 import time
 import urllib.request
 
-import numpy as np
 import pytest
 
 from kmlserver_tpu.config import MiningConfig, ServingConfig

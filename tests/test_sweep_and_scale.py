@@ -1,9 +1,7 @@
 """Sweep harness (M14 resurrection) + the Apriori-pruned large-vocab path."""
 
-import os
 
 import numpy as np
-import pytest
 
 from kmlserver_tpu.config import MiningConfig
 from kmlserver_tpu.data.csv import write_tracks_csv
