@@ -345,6 +345,10 @@ _COMPACT_PRIORITY = (
     "chaos_eject_recovery_ms", "chaos_redispatched",
     "mine_resume_s", "mine_resume_full_s", "mine_resume_saved_pct",
     "mine_resume_identical", "mine_resume_phase",
+    "als_train_s", "hybrid_p50_ms", "hybrid_p99_ms", "hybrid_errors",
+    "cold_start_hit_frac", "cold_start_seeds",
+    "confserve_p50_ms", "confserve_p99_ms", "confserve_qps",
+    "confserve_errors",
     "replay_queue_wait_p99_ms", "replay_device_p99_ms",
     "replay_queue_wait_p50_ms", "replay_device_p50_ms", "replay_e2e_p999_ms",
     "replay_server_p50_ms", "replay_server_p95_ms", "replay_server_p99_ms",
@@ -1470,6 +1474,153 @@ print(report.to_json())
 """
 
 
+# the second-model-family phase (ISSUE 6): ALS embedding training time
+# through the real pipeline (embed phase enabled), then hybrid
+# rule∪embedding serving — 1k-QPS blend-mode replay p50/p99 through
+# cache → batcher → both kernels, plus the cold-start bracket: every
+# zero-rule track in the embedding vocabulary is asked as a single seed
+# and the hit fraction counts answers served from the embedding space
+# (source "embed") instead of the popularity fallback. In-process for the
+# same reason as replay10k. CPU-platform by construction, self-labeled.
+_ALS_HYBRID_BENCH = r"""
+import dataclasses, json, os, sys, tempfile
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+with tempfile.TemporaryDirectory(prefix="kmls_als_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = dataclasses.replace(
+        MiningConfig.from_env(dotenv_path=None), base_dir=base,
+        datasets_dir=ds_dir, min_support=0.05, embed_enabled=True,
+    )
+    summary = run_mining_job(mcfg)
+    cfg = dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), base_dir=base,
+        hybrid_mode="blend", batch_max_size=64, shed_queue_budget_ms=0.0,
+    )
+    app = RecommendApp(cfg)
+    assert app.engine.load(), "mined artifacts must load"
+    bundle = app.engine.bundle
+    assert bundle.emb_factors is not None, "embedding artifact must attach"
+
+    # cold-start bracket: every embedding-vocab track with ZERO rules
+    known = {bundle.vocab[i] for i in range(len(bundle.vocab))
+             if bundle.known_mask[i]}
+    cold = [n for n in bundle.emb_vocab if n not in known][:512]
+    embed_answered = 0
+    for name in cold:
+        _songs, source, _cached = app.recommend_direct([name])
+        if source == "embed":
+            embed_answered += 1
+
+    def make_send():
+        def send(seeds):
+            recs, source, cached = app.recommend_direct(seeds)
+            return source, cached
+        return send
+
+    payloads = sample_seed_sets(
+        bundle.emb_vocab, 8000, rng_seed=11, zipf_s=1.1
+    )
+    replay_pooled(make_send, payloads[:1000], qps=250, n_workers=8)  # warm
+    report = replay_pooled(
+        make_send, payloads, qps=1000, n_workers=16, max_queue=4096
+    )
+    print(json.dumps({
+        "als_train_s": round(summary.als_train_s, 3),
+        "als_rank": mcfg.als_rank,
+        "als_iters": mcfg.als_iters,
+        "emb_vocab": len(bundle.emb_vocab),
+        "qps": 1000.0,
+        "achieved_qps": report.achieved_qps,
+        "p50_ms": report.p50_ms,
+        "p95_ms": report.p95_ms,
+        "p99_ms": report.p99_ms,
+        "errors": report.n_errors,
+        "cold_start_seeds": len(cold),
+        "cold_start_hit_frac": (
+            embed_answered / len(cold) if cold else None
+        ),
+        "platform": dev.platform,
+    }))
+"""
+
+# confidence-mode serving bracket (carried-over ROADMAP item): mine with
+# the dormant slow path's true-confidence semantics + multi-antecedent
+# rules (max_itemset_len 3), then replay-grade the SAME max-merge kernel
+# those rules serve through (native kernel off so the jitted device
+# kernel is the one measured). In-process; CPU-platform by construction.
+_CONFSERVE_BENCH = r"""
+import dataclasses, json, os, sys, tempfile
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+with tempfile.TemporaryDirectory(prefix="kmls_confserve_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = dataclasses.replace(
+        MiningConfig.from_env(dotenv_path=None), base_dir=base,
+        datasets_dir=ds_dir, min_support=0.05,
+        confidence_mode="confidence", max_itemset_len=3,
+    )
+    run_mining_job(mcfg)
+    cfg = dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), base_dir=base,
+        native_serve=False, batch_max_size=64, shed_queue_budget_ms=0.0,
+    )
+    app = RecommendApp(cfg)
+    assert app.engine.load(), "mined artifacts must load"
+    bundle = app.engine.bundle
+
+    def make_send():
+        def send(seeds):
+            recs, source, cached = app.recommend_direct(seeds)
+            return source, cached
+        return send
+
+    payloads = sample_seed_sets(bundle.vocab, 8000, rng_seed=7, zipf_s=1.1)
+    replay_pooled(make_send, payloads[:1000], qps=250, n_workers=8)  # warm
+    report = replay_pooled(
+        make_send, payloads, qps=1000, n_workers=16, max_queue=4096
+    )
+    print(json.dumps({
+        "qps": 1000.0,
+        "achieved_qps": report.achieved_qps,
+        "p50_ms": report.p50_ms,
+        "p95_ms": report.p95_ms,
+        "p99_ms": report.p99_ms,
+        "errors": report.n_errors,
+        "rule_keys": int(bundle.known_mask.sum()),
+        "max_itemset_len": mcfg.max_itemset_len,
+        "confidence_mode": mcfg.confidence_mode,
+        "platform": dev.platform,
+    }))
+"""
+
+
 # every phase script prints "device: ..." to stderr right after backend
 # init; on TPU, not seeing it within this grace period means the backend
 # init hung (the flaky-pool failure mode) — kill early instead of burning
@@ -2266,6 +2417,16 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
     if "mine_resume_s" not in result:
         _record_mine_resume(result, bank="mine_resume_cpu", budget_s=150)
         em.checkpoint()
+
+    # second-model-family + confidence-mode brackets: CPU-measured by
+    # construction (self-labeled keys) — skip only when a CPU suite
+    # earlier in this run already recorded them
+    if "hybrid_p99_ms" not in result:
+        _record_als_hybrid(result, bank="als_hybrid_cpu", budget_s=240)
+        em.checkpoint()
+    if "confserve_p99_ms" not in result:
+        _record_confserve(result, bank="confserve_cpu", budget_s=200)
+        em.checkpoint()
     return mining
 
 
@@ -2305,6 +2466,18 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # mining-interruption bracket (ISSUE 4): kill-at-phase, resume,
         # bit-identical artifacts + wall-clock savings
         _record_mine_resume(result)
+        em.checkpoint()
+
+    if _remaining() > 200:
+        # second model family (ISSUE 6): ALS train time, hybrid blend
+        # replay p50/p99, cold-start hit fraction
+        _record_als_hybrid(result)
+        em.checkpoint()
+
+    if _remaining() > 150:
+        # confidence-mode serving bracket: multi-antecedent rules through
+        # the jitted max-merge kernel (carried-over ROADMAP item)
+        _record_confserve(result)
         em.checkpoint()
 
     if _remaining() > 180:
@@ -2599,6 +2772,89 @@ def _record_replay10k(
     ):
         if src in r10k and r10k[src] is not None:
             val = r10k[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_als_hybrid(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The second-model-family bracket (ISSUE 6): ALS training time
+    through the real pipeline's embed phase, hybrid blend-mode replay
+    p50/p99, and the cold-start hit fraction (zero-rule seeds answered
+    from the embedding space, not the popularity fallback). CPU-platform
+    by construction, self-labeled — never relabeled by a TPU takeover."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "als-hybrid", _ALS_HYBRID_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    frac = res.get("cold_start_hit_frac")
+    log(
+        f"als-hybrid: ALS train {res['als_train_s']:.2f}s (rank "
+        f"{res['als_rank']}), blend replay p50 {res['p50_ms']:.2f}ms "
+        f"p99 {res['p99_ms']:.2f}ms @ {res['achieved_qps']:.0f} QPS, "
+        f"cold-start hit "
+        f"{frac:.2%}" if frac is not None else
+        "als-hybrid: no cold-start seeds in this workload (!)"
+    )
+    for src, dst in (
+        ("als_train_s", "als_train_s"),
+        ("als_rank", "als_rank"),
+        ("als_iters", "als_iters"),
+        ("emb_vocab", "als_emb_vocab"),
+        ("achieved_qps", "hybrid_achieved_qps"),
+        ("p50_ms", "hybrid_p50_ms"),
+        ("p95_ms", "hybrid_p95_ms"),
+        ("p99_ms", "hybrid_p99_ms"),
+        ("errors", "hybrid_errors"),
+        ("cold_start_seeds", "cold_start_seeds"),
+        ("cold_start_hit_frac", "cold_start_hit_frac"),
+        ("platform", "hybrid_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 4) if isinstance(val, float) else val
+
+
+def _record_confserve(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """Confidence-mode serving bracket (carried-over ROADMAP item):
+    multi-antecedent true-confidence rules replayed through the jitted
+    max-merge kernel. CPU-platform by construction, self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "confserve", _CONFSERVE_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"confserve (confidence mode, itemsets ≤{res['max_itemset_len']}): "
+        f"p50 {res['p50_ms']:.2f}ms p99 {res['p99_ms']:.2f}ms @ "
+        f"{res['achieved_qps']:.0f} QPS, {res['errors']} errors, "
+        f"{res['rule_keys']} rule keys"
+    )
+    for src, dst in (
+        ("achieved_qps", "confserve_qps"),
+        ("p50_ms", "confserve_p50_ms"),
+        ("p95_ms", "confserve_p95_ms"),
+        ("p99_ms", "confserve_p99_ms"),
+        ("errors", "confserve_errors"),
+        ("rule_keys", "confserve_rule_keys"),
+        ("max_itemset_len", "confserve_max_itemset_len"),
+        ("platform", "confserve_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
             result[dst] = round(val, 3) if isinstance(val, float) else val
 
 

@@ -36,6 +36,26 @@ def _getenv_bool(name: str, default: bool) -> bool:
     return raw.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _getenv_hybrid_mode() -> str:
+    """``KMLS_HYBRID_MODE``: one of ``rules``/``embed``/``blend``
+    (case-insensitive). An unrecognized value falls back to ``rules`` —
+    the FAIL-SAFE direction: a typo while trying to pin the legacy path
+    must never silently enable the hybrid merge — with a loud warning."""
+    raw = os.getenv("KMLS_HYBRID_MODE")
+    if raw in (None, ""):
+        return "blend"
+    word = raw.strip().lower()
+    if word in ("rules", "embed", "blend"):
+        return word
+    import logging
+
+    logging.getLogger("kmlserver_tpu.serving").warning(
+        "KMLS_HYBRID_MODE=%r is not one of rules/embed/blend; "
+        "serving rules-only", raw,
+    )
+    return "rules"
+
+
 def _getenv_bitpack_threshold() -> int | str | None:
     """``KMLS_BITPACK_THRESHOLD_ELEMS``: "auto" (HBM-fit dispatch, the
     default), "none"/"never" (dense always), or an explicit element count."""
@@ -98,6 +118,9 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_REDISPATCH_MAX_RETRIES": "serving",
     "KMLS_REQUEST_DEADLINE_MS": "serving",
     "KMLS_FALLBACK_BUDGET_MS": "serving",
+    # --- serving: hybrid rule∪embedding merge (second model family) ---
+    "KMLS_HYBRID_MODE": "serving",
+    "KMLS_HYBRID_BLEND_WEIGHT": "serving",
     # --- mining: semantics / device dispatch ---
     "KMLS_MAX_ITEMSET_LEN": "mining",
     "KMLS_K_MAX_CONSEQUENTS": "mining",
@@ -121,6 +144,11 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_POPCOUNT_TILE_J": "mining",
     "KMLS_POPCOUNT_WORD_CHUNK": "mining",
     "KMLS_PROFILE_DIR": "mining",
+    # --- mining: ALS embedding phase (second model family) ---
+    "KMLS_EMBED_ENABLED": "mining",
+    "KMLS_ALS_RANK": "mining",
+    "KMLS_ALS_ITERS": "mining",
+    "KMLS_ALS_REG": "mining",
     # --- mining: preemption-proofing / multi-host ---
     "KMLS_CKPT_ENABLED": "mining",
     "KMLS_CKPT_DIR": "mining",
@@ -170,6 +198,7 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_FAULT_MINE_CRASH_PHASE": "fault",
     "KMLS_FAULT_CKPT_CORRUPT": "fault",
     "KMLS_FAULT_RANK_DEAD": "fault",
+    "KMLS_FAULT_EMBED_CORRUPT": "fault",
 }
 
 # Columns dropped from the raw CSV before any processing
@@ -261,6 +290,20 @@ class MiningConfig:
     # Ignored on TPU; falls back automatically when the .so can't build.
     native_cpu_pair_counts: bool = True
 
+    # --- second model family: ALS embedding phase (mining/als.py) ---
+    # Optional `embed` pipeline phase after `rules`: train ALS item
+    # embeddings over the playlist×track matrix and publish embeddings.npz
+    # through the same manifest + lease-fenced path as the rule tensors.
+    # Off by default — the reference pipeline has no embedding model, and
+    # the serving side degrades to rules-only when the artifact is absent.
+    embed_enabled: bool = False
+    # Factorization rank (embedding dimension).
+    als_rank: int = 32
+    # Alternating sweeps (users then items per sweep).
+    als_iters: int = 8
+    # L2 regularization λ on both factor matrices.
+    als_reg: float = 0.1
+
     # --- preemption-proofing knobs (checkpoint / lease / watchdog) ---
     # Phase-level checkpointing: after each expensive phase (encode, mine,
     # rules) the writer rank persists an atomic, sha256-manifested
@@ -346,6 +389,10 @@ class MiningConfig:
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
             write_manifest=_getenv_bool("KMLS_WRITE_MANIFEST", True),
             native_cpu_pair_counts=_getenv_bool("KMLS_NATIVE_PAIR_COUNTS", True),
+            embed_enabled=_getenv_bool("KMLS_EMBED_ENABLED", False),
+            als_rank=_getenv_int("KMLS_ALS_RANK", 32),
+            als_iters=_getenv_int("KMLS_ALS_ITERS", 8),
+            als_reg=_getenv_float("KMLS_ALS_REG", 0.1),
             checkpoint_enabled=_getenv_bool("KMLS_CKPT_ENABLED", True),
             checkpoint_dir=os.getenv("KMLS_CKPT_DIR", ""),
             checkpoint_quarantine_after=_getenv_int(
@@ -482,6 +529,20 @@ class ServingConfig:
     # of the popularity ranking (cheapest possible answer).
     fallback_budget_ms: float = 50.0
 
+    # --- second model family: hybrid rule∪embedding serving ---
+    # How the two model families combine when an embedding artifact is
+    # published: "rules" ignores embeddings entirely (the legacy path),
+    # "embed" serves embedding top-k (rules only when the seeds are
+    # unknown to the embedding vocab), "blend" unions both candidate
+    # lists with blended scores. With no embedding artifact on the PVC —
+    # or one that fails validation — every mode serves rules-only.
+    hybrid_mode: str = "blend"
+    # Weight of the EMBEDDING similarity in blend mode: blended score =
+    # (1 - w)·rule_confidence + w·cosine_similarity. 0 ranks like
+    # rules-only (embeddings still backfill rule-less candidates),
+    # 1 like embed-only.
+    hybrid_blend_weight: float = 0.5
+
     @property
     def pickles_dir(self) -> str:
         return os.path.join(self.base_dir, self.pickle_dir)
@@ -528,4 +589,6 @@ class ServingConfig:
             redispatch_max_retries=_getenv_int("KMLS_REDISPATCH_MAX_RETRIES", 3),
             request_deadline_ms=_getenv_float("KMLS_REQUEST_DEADLINE_MS", 0.0),
             fallback_budget_ms=_getenv_float("KMLS_FALLBACK_BUDGET_MS", 50.0),
+            hybrid_mode=_getenv_hybrid_mode(),
+            hybrid_blend_weight=_getenv_float("KMLS_HYBRID_BLEND_WEIGHT", 0.5),
         )

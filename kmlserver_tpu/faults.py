@@ -35,6 +35,11 @@ Sites currently wired:
   watchdog's heartbeat loop: a fail fault silences that rank's
   heartbeats from then on, simulating a dead process so peers' watchdogs
   must convert the would-be forever-hang into a bounded-time abort.
+- ``"embed.artifact"`` — fired inside the engine's embedding-artifact
+  load (the second model family's reader): a fail fault makes
+  ``embeddings.npz`` unloadable exactly like a torn/corrupt file — the
+  reload must still publish a rules-only bundle (graceful degradation,
+  never a failed reload, never a 5xx).
 
 Arming, two ways:
 
@@ -54,7 +59,9 @@ Arming, two ways:
   - ``KMLS_FAULT_CKPT_CORRUPT=N`` — corrupt the next N checkpoint
     payloads at save time;
   - ``KMLS_FAULT_RANK_DEAD=rank`` — silence rank ``rank``'s watchdog
-    heartbeats permanently (a dead multi-host process).
+    heartbeats permanently (a dead multi-host process);
+  - ``KMLS_FAULT_EMBED_CORRUPT=N`` — fail the next N embedding-artifact
+    loads (rules-only degradation, not a failed reload).
 
 File corruption is a separate concern (faults happen to BYTES, not call
 sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
@@ -196,6 +203,9 @@ def load_env(force: bool = False) -> None:
     raw = os.getenv("KMLS_FAULT_RANK_DEAD")
     if raw:
         inject("rank.heartbeat", replica=int(raw), times=-1)
+    raw = os.getenv("KMLS_FAULT_EMBED_CORRUPT")
+    if raw:
+        inject("embed.artifact", times=int(raw))
 
 
 def _ensure_env() -> None:

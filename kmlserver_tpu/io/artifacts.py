@@ -44,6 +44,11 @@ import numpy as np
 TENSOR_ARTIFACT_SUFFIX = ".tensors.npz"
 MANIFEST_FILENAME = "artifacts.manifest.json"
 QUARANTINE_DIRNAME = "quarantine"
+# the second model family's artifact (mining/als.py writes it through the
+# same manifest + lease-fenced publication path as the rule tensors; the
+# engine loads it fail-soft — absent or corrupt means rules-only serving)
+EMBEDDINGS_FILENAME = "embeddings.npz"
+EMBEDDINGS_VERSION = 1
 
 
 class ArtifactIntegrityError(RuntimeError):
@@ -545,6 +550,94 @@ def load_rule_tensors(path: str) -> dict[str, Any]:
             "min_support": float(npz["min_support"]),
             "mode": mode,
             "min_confidence": float(npz["min_confidence"]),
+        }
+
+
+def embeddings_artifact_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, EMBEDDINGS_FILENAME)
+
+
+def save_embeddings(
+    path: str,
+    *,
+    vocab: list[str],
+    item_factors: np.ndarray,
+    rank: int,
+    iters: int,
+    reg: float,
+    final_loss: float | None = None,
+) -> None:
+    """Write the embedding artifact as one atomic ``.npz``.
+
+    ``item_factors`` f32 (V, rank), rows L2-normalized — serving-ready:
+    the engine ``device_put``s them straight into HBM and the lookup is
+    cosine top-k (``ops/embed.py``). ``vocab`` is the EMBEDDING id space,
+    which is the full encode-phase vocabulary — deliberately broader than
+    the (possibly Apriori-pruned) rule vocabulary, because long-tail
+    coverage is the whole point of the second model family. The hybrid
+    merge happens at the name level, so the two id spaces never need to
+    agree."""
+    if item_factors.ndim != 2 or item_factors.shape[0] != len(vocab):
+        raise ValueError(
+            f"item_factors {item_factors.shape} does not match vocab size "
+            f"{len(vocab)}"
+        )
+    arrays = dict(
+        version=np.int64(EMBEDDINGS_VERSION),
+        vocab=np.asarray(vocab, dtype=object),
+        item_factors=item_factors.astype(np.float32),
+        rank=np.int64(rank),
+        iters=np.int64(iters),
+        reg=np.float64(reg),
+    )
+    if final_loss is not None:
+        arrays["final_loss"] = np.float64(final_loss)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    _atomic_write_bytes(path, buf.getvalue())
+
+
+def remove_embeddings(pickles_dir: str) -> bool:
+    """Retire the embedding artifact (an embed-DISABLED publication must
+    not leave a previous generation's embeddings on disk, where the fresh
+    manifest would re-bless them against new rules). → True if removed."""
+    try:
+        os.unlink(embeddings_artifact_path(pickles_dir))
+        return True
+    except FileNotFoundError:
+        return False
+
+
+def load_embeddings(path: str) -> dict[str, Any]:
+    """Load + validate the embedding artifact. Raises ``ValueError`` on
+    any structural problem (shape mismatch, non-finite factors, unknown
+    format version) — the engine treats every raise here as "corrupt"
+    and serves rules-only, so validation must be strict enough that a
+    torn file can never publish garbage similarities."""
+    with np.load(path, allow_pickle=True) as npz:
+        if "item_factors" not in npz.files or "vocab" not in npz.files:
+            raise ValueError(f"{path}: not an embedding artifact")
+        version = int(npz["version"]) if "version" in npz.files else 0
+        if version != EMBEDDINGS_VERSION:
+            raise ValueError(
+                f"{path}: embedding artifact version {version} != "
+                f"{EMBEDDINGS_VERSION}"
+            )
+        vocab = [str(s) for s in npz["vocab"]]
+        factors = np.asarray(npz["item_factors"], dtype=np.float32)
+        if factors.ndim != 2 or factors.shape[0] != len(vocab):
+            raise ValueError(
+                f"{path}: item_factors {factors.shape} does not match "
+                f"vocab size {len(vocab)}"
+            )
+        if factors.shape[1] < 1 or not np.isfinite(factors).all():
+            raise ValueError(f"{path}: non-finite or rank-0 item factors")
+        return {
+            "vocab": vocab,
+            "item_factors": factors,
+            "rank": int(npz["rank"]) if "rank" in npz.files else factors.shape[1],
+            "iters": int(npz["iters"]) if "iters" in npz.files else 0,
+            "reg": float(npz["reg"]) if "reg" in npz.files else 0.0,
         }
 
 

@@ -1,0 +1,169 @@
+"""TPU-native ALS matrix factorization — the second model family.
+
+FP-Growth rules (the paper's only model) structurally cannot answer
+cold-start seeds or long-tail tracks that never co-occur above
+``min_support``: a track with no frequent pair has an empty rule row, and
+a track pruned before pair counting isn't even a rule-dict key. A learned
+embedding space has no such floor — every track that appears in ANY
+playlist gets a vector, and similarity generalizes across co-occurrence
+gaps. ALX (PAPERS.md) is the recipe this follows: alternating least
+squares over the playlist×track interaction matrix, where each half-sweep
+is a batched normal-equation solve — matmul-shaped work that rides the
+MXU, not a per-row Python loop.
+
+Formulation: the binary membership matrix ``X ∈ {0,1}^{P×V}`` (the same
+matrix the encode phase already produces as the mining one-hot) is
+factorized as ``X ≈ U Fᵀ`` minimizing
+
+    ‖X − U Fᵀ‖²_F + λ(‖U‖²_F + ‖F‖²_F)
+
+with every cell observed (zeros included). Because the loss weights all
+cells equally, both half-sweeps share ONE rank×rank Gramian, so the
+per-row normal equations collapse into a single batched solve:
+
+    U ← X F (FᵀF + λI)⁻¹        (all P users at once)
+    F ← Xᵀ U (UᵀU + λI)⁻¹       (all V items at once)
+
+Each iteration is two (big × skinny) matmuls plus two rank×rank solves —
+exactly the shape ALX shards across TPU pods; here it runs on the local
+device (the mesh-sharded variant is the ROADMAP's model-parallel item).
+
+Serving consumes only the ITEM factors: seed→candidate scores are
+cosine similarities in item space (item-item collaborative filtering),
+so the published artifact carries the L2-normalized item factors and the
+user factors are discarded after training.
+
+Determinism: factor init comes from a fixed-seed host RNG and every
+device op is deterministic on a fixed backend, so two trainings of the
+same baskets on the same host produce bit-identical factors — which is
+what lets the ``embed`` phase checkpoint resume bit-identically and the
+manifest sha256 prove it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MiningConfig
+from ..ops import encode
+from .vocab import Baskets
+
+
+@jax.jit
+def _als_sweep(
+    x_mat: jax.Array,  # f32 (P, V) binary interactions
+    user_f: jax.Array,  # f32 (P, R)
+    item_f: jax.Array,  # f32 (V, R)
+    reg: jax.Array,  # f32 scalar
+) -> tuple[jax.Array, jax.Array]:
+    """One alternating sweep: users then items, each a single batched
+    normal-equation solve against the shared rank×rank Gramian."""
+    rank = user_f.shape[1]
+    eye = jnp.eye(rank, dtype=user_f.dtype)
+    g_item = item_f.T @ item_f + reg * eye  # (R, R)
+    # solve (R,R) @ Uᵀ = (X F)ᵀ for all P rows at once
+    user_f = jnp.linalg.solve(g_item, (x_mat @ item_f).T).T
+    g_user = user_f.T @ user_f + reg * eye
+    item_f = jnp.linalg.solve(g_user, (x_mat.T @ user_f).T).T
+    return user_f, item_f
+
+
+@jax.jit
+def _als_loss(
+    x_mat: jax.Array, user_f: jax.Array, item_f: jax.Array, reg: jax.Array
+) -> jax.Array:
+    resid = x_mat - user_f @ item_f.T
+    return (
+        jnp.sum(resid * resid)
+        + reg * (jnp.sum(user_f * user_f) + jnp.sum(item_f * item_f))
+    )
+
+
+def normalize_factors(item_factors: np.ndarray) -> np.ndarray:
+    """Row-L2-normalize → unit vectors, so serving dot products are cosine
+    similarities in [-1, 1] and blend cleanly with rule confidences. A
+    zero row (can't arise from baskets — every vocab track appears at
+    least once — but a loaded artifact must not NaN) keeps a zero vector."""
+    norms = np.linalg.norm(item_factors, axis=1, keepdims=True)
+    return (item_factors / np.maximum(norms, 1e-12)).astype(np.float32)
+
+
+def train_embeddings(
+    baskets: Baskets, cfg: MiningConfig, seed: int = 0
+) -> dict[str, Any]:
+    """Train item embeddings over the transaction DB → the ``embed``
+    phase's checkpoint payload:
+
+    ``{"item_factors": f32 (V, rank) L2-normalized, "rank", "iters",
+    "reg", "final_loss", "duration_s"}`` — or, when the dense
+    formulation would not fit ``cfg.hbm_budget_bytes``, a payload with
+    ``item_factors=None`` and a ``skipped`` reason (the pipeline then
+    publishes a rules-only generation; the skip is a function of config
+    + dataset shape, so every rank — and every resume — decides it
+    identically).
+
+    The interaction matrix is the SAME encode the mining path uses
+    (``ops.encode.onehot_matrix`` over the deduplicated membership
+    pairs), cast to f32 — two writers, one spine.
+    """
+    rank = max(1, cfg.als_rank)
+    iters = max(1, cfg.als_iters)
+    reg = jnp.float32(cfg.als_reg)
+    p, v = baskets.n_playlists, baskets.n_tracks
+    # HBM-fit guard: this formulation materializes the interaction matrix
+    # DENSE float32 — 4x the int8 footprint the mining path's bitpack
+    # dispatch exists to avoid. At scales where that dispatch fires, the
+    # dense ALS would OOM the job AFTER the expensive mine; skip the
+    # phase deterministically instead (rules-only generation, loud
+    # message). The sparse/sharded ALS is the ROADMAP model-parallel
+    # item. Budgeted terms: X (P·V f32) + its int8 encode source + both
+    # factor matrices and their normal-equation right-hand sides.
+    dense_bytes = 5 * p * v + 8 * rank * (p + v)
+    if dense_bytes > cfg.hbm_budget_bytes:
+        return {
+            "item_factors": None,
+            "rank": rank,
+            "iters": iters,
+            "reg": float(cfg.als_reg),
+            "final_loss": None,
+            "duration_s": 0.0,
+            "skipped": (
+                f"dense {p}x{v} interaction matrix (~{dense_bytes >> 20} MiB)"
+                f" exceeds hbm_budget_bytes ({cfg.hbm_budget_bytes >> 20} "
+                "MiB); embed phase skipped — serving stays rules-only"
+            ),
+        }
+    t0 = time.perf_counter()
+    x_mat = encode.onehot_matrix(
+        jnp.asarray(baskets.playlist_rows),
+        jnp.asarray(baskets.track_ids),
+        n_playlists=p,
+        n_tracks=v,
+    ).astype(jnp.float32)
+    # fixed-seed HOST init: device RNG streams differ across backends,
+    # host bytes do not — resume/fingerprint identity depends on this
+    rng = np.random.default_rng(seed)
+    user_f = jnp.asarray(
+        rng.standard_normal((p, rank)).astype(np.float32) / np.sqrt(rank)
+    )
+    item_f = jnp.asarray(
+        rng.standard_normal((v, rank)).astype(np.float32) / np.sqrt(rank)
+    )
+    for _ in range(iters):
+        user_f, item_f = _als_sweep(x_mat, user_f, item_f, reg)
+    final_loss = float(_als_loss(x_mat, user_f, item_f, reg))
+    item_host = normalize_factors(np.array(jax.device_get(item_f)))
+    duration_s = time.perf_counter() - t0
+    return {
+        "item_factors": item_host,
+        "rank": rank,
+        "iters": iters,
+        "reg": float(cfg.als_reg),
+        "final_loss": final_loss,
+        "duration_s": duration_s,
+    }

@@ -54,10 +54,15 @@ from ..io.artifacts import _atomic_write_bytes, file_digest, quarantine_file
 # mine    — frequent-itemset mining + rule-tensor extraction (the device
 #           compute; by far the dominant cost at scale)
 # rules   — expansion of the rule tensors into the reference's pickle dict
-PHASES = ("encode", "mine", "rules")
+# embed   — ALS item-embedding training (the second model family; runs —
+#           and checkpoints — only with ``embed_enabled``, but keeps its
+#           slot in the canonical order so resume bookkeeping and the
+#           kill-at-phase chaos matrix cover it like any other phase)
+PHASES = ("encode", "mine", "rules", "embed")
 
 STATE_FILENAME = "state.json"
-CKPT_VERSION = 1
+# v2: the `embed` phase + ALS fields joined the fingerprint identity
+CKPT_VERSION = 2
 
 # MiningConfig fields that can change the bytes of the final artifacts (or
 # of any phase payload). Anything NOT listed — dispatch/backend knobs like
@@ -73,6 +78,13 @@ _FINGERPRINT_FIELDS = (
     "confidence_mode",
     "min_confidence",
     "prune_vocab_threshold",
+    # second model family: toggling the embed phase or its ALS
+    # hyperparameters changes the published artifact set, so a checkpoint
+    # written under different values must never resume
+    "embed_enabled",
+    "als_rank",
+    "als_iters",
+    "als_reg",
 )
 
 

@@ -14,7 +14,12 @@ phases (``mining/checkpoint.py``):
 - **encode** — CSV read, vocab validation/aux maps, basket encoding;
 - **mine**   — frequent-itemset mining + rule-tensor extraction (the
   device compute, the dominant cost at scale);
-- **rules**  — expansion into the reference's pickle dict.
+- **rules**  — expansion into the reference's pickle dict;
+- **embed**  — (optional, ``embed_enabled``) ALS item-embedding training
+  over the same baskets (``mining/als.py``) — the SECOND model family,
+  published as ``embeddings.npz`` through the same manifest + lease path
+  and checkpointed like any other phase, proving the artifact spine is
+  model-agnostic plumbing rather than rule-specific.
 
 After each phase the writer rank persists an atomic sha256-manifested
 checkpoint keyed by a config+dataset fingerprint; a restarted job resumes
@@ -63,6 +68,8 @@ class JobSummary:
     resumed_phases: tuple[str, ...] = ()
     # the publication lease's fencing token (None: lease disabled / reader)
     fencing_token: int | None = None
+    # ALS embedding training wall clock (None: embed phase disabled)
+    als_train_s: float | None = None
 
 
 def _pickle_path(cfg: MiningConfig, filename: str) -> str:
@@ -202,6 +209,31 @@ def run_mining_job(
             "rules", lambda: tensors.to_rules_dict(result.vocab_names)
         )
 
+        # the second model family: ALS item embeddings over the SAME
+        # baskets the rule miner consumed (reused from the encode
+        # checkpoint on resume), trained as its own checkpointed phase
+        emb_payload = None
+        if cfg.embed_enabled:
+
+            def _embed():
+                from . import als
+
+                return als.train_embeddings(baskets, cfg)
+
+            emb_payload = phase("embed", _embed)
+            if emb_payload.get("item_factors") is None:
+                # HBM-fit guard declined to train (als.py): this
+                # generation publishes rules-only — loudly, not silently
+                print(f"ALS embed phase skipped: {emb_payload.get('skipped')}")
+                emb_payload = None
+            else:
+                print(
+                    f"ALS embeddings trained: rank {emb_payload['rank']}, "
+                    f"{emb_payload['iters']} iters, final loss "
+                    f"{emb_payload['final_loss']:.3f} "
+                    f"({emb_payload['duration_s']:.2f}s)"
+                )
+
         # ---------- publication (writer only, lease-fenced) ----------
         paths: dict[str, str] = {}
         token = ""
@@ -250,6 +282,28 @@ def run_mining_job(
                     min_confidence=tensors.min_confidence,
                     rule_confs64=tensors.rule_confs64,
                 )
+            if emb_payload is None:
+                # embed phase off: a previous generation's embeddings must
+                # not survive into this publication's manifest, where they
+                # would be re-blessed against rules they weren't trained on
+                artifacts.remove_embeddings(cfg.pickles_dir)
+            else:
+                # second writer on the same spine: the embedding artifact
+                # rides the identical atomic-write + manifest + fence
+                # discipline as the rule tensors — a reader that can
+                # validate one can validate the other
+                paths["embeddings"] = artifacts.embeddings_artifact_path(
+                    cfg.pickles_dir
+                )
+                artifacts.save_embeddings(
+                    paths["embeddings"],
+                    vocab=baskets.vocab.names,
+                    item_factors=emb_payload["item_factors"],
+                    rank=emb_payload["rank"],
+                    iters=emb_payload["iters"],
+                    reg=emb_payload["reg"],
+                    final_loss=emb_payload["final_loss"],
+                )
             if cfg.write_manifest:
                 # integrity sidecar AFTER the artifact set, BEFORE the token:
                 # any reader that sees the new token sees a manifest matching
@@ -268,6 +322,7 @@ def run_mining_job(
                         cfg.artists_mapping_file,
                         cfg.track_info_file,
                         cfg.repeated_tracks_file,
+                        artifacts.EMBEDDINGS_FILENAME,
                     ],
                     token=token_value,
                     fencing_token=lease.fencing_token if lease else None,
@@ -312,4 +367,7 @@ def run_mining_job(
         artifact_paths=paths,
         resumed_phases=tuple(resumed),
         fencing_token=lease.fencing_token if lease else None,
+        als_train_s=(
+            emb_payload["duration_s"] if emb_payload is not None else None
+        ),
     )

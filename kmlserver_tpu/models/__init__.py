@@ -5,17 +5,24 @@ rule artifact the mining job produces and the API serves (reference:
 machine-learning/main.py:262-313 produces it; rest_api/app/main.py:224-254
 applies it). This package names that abstraction explicitly:
 
-- :class:`RuleModel` — the deployable unit: HBM-resident rule tensors +
-  vocabulary + the jitted apply (recommendation) function.
-- two model *families*, selected by ``MiningConfig.confidence_mode``:
+- :class:`RuleModel` — the deployable rule unit: HBM-resident rule
+  tensors + vocabulary + the jitted apply (recommendation) function.
+  Two rule sub-families, selected by ``MiningConfig.confidence_mode``:
   ``"support"`` (the reference fast path's symmetric support-as-confidence
   rules) and ``"confidence"`` (true asymmetric confidence with
   multi-antecedent rules, the slow path's semantics).
+- :class:`EmbeddingModel` — the SECOND model family (ISSUE 6): ALS item
+  embeddings over the same playlist×track matrix, opening the cold-start
+  and long-tail scenarios association rules structurally miss. Same
+  artifact spine (manifest + lease-fenced publication), second writer.
 
-Training = ``kmlserver_tpu.mining.miner.mine``; inference =
-``kmlserver_tpu.ops.serve.recommend_batch``; serialization =
+Training = ``kmlserver_tpu.mining.miner.mine`` /
+``kmlserver_tpu.mining.als.train_embeddings``; inference =
+``kmlserver_tpu.ops.serve.recommend_batch`` /
+``kmlserver_tpu.ops.embed.embed_topk``; serialization =
 ``kmlserver_tpu.io.artifacts``. This module composes them into the
 model-object view without duplicating any of it.
 """
 
+from .embedding_model import EmbeddingModel  # noqa: F401
 from .rule_model import RuleModel  # noqa: F401

@@ -1,0 +1,87 @@
+"""The embedding serving hot path as one jitted device call.
+
+The second model family's lookup kernel (the rule twin is
+``ops/serve.py``): seed songs' unit item vectors are gathered from the
+HBM-resident factor matrix, scored against EVERY item by dot product
+(cosine similarity — the factors are row-normalized at publication),
+max-merged over the seeds, and the top-K extracted — batched over B
+concurrent requests, same shape-bucket discipline as the rule kernel so
+every (batch, length) a request can produce is pre-warmed at publish.
+
+Semantics, mirroring the rule kernel where the models agree and
+diverging only where the geometry demands it:
+
+- ``-1``-padded seeds contribute nothing (parity with the rule kernel's
+  membership filter);
+- the merge is a MAX over per-seed similarities (parity with the rule
+  max-merge: "how strongly does the closest seed pull this item");
+- the SEED items themselves are masked out of the candidates — a unit
+  vector's nearest neighbor is itself (cosine 1.0), and "you might like
+  the songs you just told me about" is not a recommendation. The rule
+  kernel doesn't need this mask because a rule row never contains its
+  own antecedent;
+- rows with no valid seed return all ``-1`` (the engine's membership
+  filter degrades those to the popularity fallback before dispatch, so
+  this is belt-and-braces, not the primary path).
+
+Memory shape: the similarity pass runs as a ``lax.scan`` over the seed
+axis — each step is one (B, R) × (R, V) matmul into a (B, V) running
+max — so peak live memory is O(B·V), never the O(B·L·V) a one-shot
+einsum would materialize (at a 100k-track vocabulary that difference is
+the whole HBM budget).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# large-but-finite floor instead of -inf: masked lanes stay out of every
+# max without breeding NaNs through 0·inf corners
+_NEG = jnp.float32(-3.0e38)
+
+
+def _embed_topk_impl(
+    item_factors: jax.Array,  # f32 (V, R), rows L2-normalized
+    seed_ids: jax.Array,  # int32 (B, L), -1 padded
+    *,
+    k_best: int,
+):
+    """→ ``(top_ids int32 (B, k_best) with -1 padding, top_sims f32)``."""
+    v = item_factors.shape[0]
+    b = seed_ids.shape[0]
+    safe_seeds = jnp.where(seed_ids >= 0, seed_ids, 0)
+
+    def step(running_max, cols):
+        seed_col, safe_col = cols  # each (B,)
+        vecs = item_factors[safe_col]  # (B, R)
+        sims = vecs @ item_factors.T  # (B, V) — one MXU matmul per seed slot
+        sims = jnp.where((seed_col >= 0)[:, None], sims, _NEG)
+        return jnp.maximum(running_max, sims), None
+
+    init = jnp.full((b, v), _NEG, dtype=item_factors.dtype)
+    scores, _ = jax.lax.scan(step, init, (seed_ids.T, safe_seeds.T))
+    # mask the seeds out of their own candidate set (self-similarity is
+    # trivially maximal); padding dumps into an extra slot V, sliced off
+    padded = jnp.concatenate(
+        [scores, jnp.full((b, 1), _NEG, dtype=scores.dtype)], axis=1
+    )
+    targets = jnp.where(seed_ids >= 0, seed_ids, v)
+    batch_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+    padded = padded.at[batch_idx, targets].set(_NEG)
+    scores = padded[:, :v]
+    k = min(k_best, v)
+    top_sims, top_ids = jax.lax.top_k(scores, k)
+    valid = top_sims > _NEG / 2
+    top_ids = jnp.where(valid, top_ids, -1)
+    top_sims = jnp.where(valid, top_sims, 0.0)
+    if k < k_best:  # static pad so callers always see k_best columns
+        pad = ((0, 0), (0, k_best - k))
+        top_ids = jnp.pad(top_ids, pad, constant_values=-1)
+        top_sims = jnp.pad(top_sims, pad)
+    return top_ids, top_sims
+
+
+embed_topk = partial(jax.jit, static_argnames=("k_best",))(_embed_topk_impl)

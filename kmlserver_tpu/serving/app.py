@@ -210,6 +210,14 @@ class RecommendApp:
             "reload_consecutive_failures": getattr(
                 self.engine, "consecutive_reload_failures", 0
             ),
+            # second model family: is the hybrid merge live, and how many
+            # embedding-artifact loads degraded to rules-only
+            "embedding_active": int(
+                getattr(self.engine, "embedding_active", False)
+            ),
+            "embedding_load_failures_total": getattr(
+                self.engine, "embedding_load_failures", 0
+            ),
         }
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         state["replicas_ejected"] = (
@@ -326,6 +334,11 @@ class RecommendApp:
             reasons.append(
                 f"reload failing x{consec} (serving last-good bundle)"
             )
+        if getattr(self.engine, "embedding_degraded", False):
+            # a PUBLISHED embeddings.npz failed validation/parse: the
+            # bundle serves rules-only — answered, but flagged so the
+            # operator knows the second model family is dark
+            reasons.append("embedding artifact unusable (serving rules-only)")
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         if callable(ejected_fn):
             ejected = ejected_fn()

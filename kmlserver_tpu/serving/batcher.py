@@ -6,7 +6,12 @@ The reference serves each request with per-request Python dict merges
 (rest_api/app/main.py:240-253); the TPU hot path is a batched kernel, and at
 1k QPS (BASELINE.json config 5) per-request device calls would serialize on
 the device lock. This batcher collects requests and issues a single
-:meth:`RecommendEngine.recommend_many_async` call per group.
+:meth:`RecommendEngine.recommend_many_async` call per group. With the
+second model family published, that one call dispatches BOTH model
+kernels (rule max-merge + embedding cosine top-k) onto the chosen
+replica and merges on the completion side — the batcher needs no
+hybrid-awareness; a batch slot is a batch slot whichever models answer
+it.
 
 Dispatch and completion run on SEPARATE threads: the collector dispatches a
 batch to the device (async, returns immediately) and keeps collecting while

@@ -3,7 +3,9 @@
 Rule lookup is deterministic per published bundle: the same seed set
 against the same rule generation always yields the same answer (the
 static-fallback path included — its sampling seed is a stable digest of
-the seed tracks). Real playlist-seed traffic is Zipf-skewed, so a bounded
+the seed tracks; the hybrid rule∪embedding merge too — its blend is pure
+float arithmetic with a deterministic tie order, so cached hybrid
+answers are exactly as replayable as rule answers). Real playlist-seed traffic is Zipf-skewed, so a bounded
 LRU in front of the batcher turns the hot head of the request
 distribution into dictionary lookups — the same shape of win prefix/KV
 caching delivers in inference serving stacks.

@@ -36,6 +36,19 @@ on replica ``i``'s device — the batcher's least-loaded dispatcher uses
 this to run concurrent batches on different devices instead of
 serializing them on one in-order execution queue. ``bundle_epoch`` is the
 monotonic publication counter the recommendation cache keys on.
+
+Hybrid serving (the second model family): when the mining job published
+an ``embeddings.npz`` (ALS item factors, ``mining/als.py``), every
+replica also carries the factor matrix on its device and each batch
+dispatches TWO kernels — the rule max-merge and the embedding cosine
+top-k (``ops/embed.py``) — whose per-request top-k lists merge on the
+completion side per ``KMLS_HYBRID_MODE`` (rules | embed | blend, weight
+``KMLS_HYBRID_BLEND_WEIGHT``). A seed set unknown to the rules but known
+to the embedding vocabulary (cold-start / long-tail) is answered from
+the embedding space instead of the popularity fallback. An absent,
+torn, or checksum-failing embedding artifact degrades to rules-only —
+the exact analogue of the npz→pickle fallback — and never costs the
+reload.
 """
 
 from __future__ import annotations
@@ -57,6 +70,7 @@ from .. import faults
 from ..config import ServingConfig
 from ..io import artifacts, registry
 from ..io.artifacts import ArtifactIntegrityError
+from ..ops.embed import embed_topk
 from ..ops.serve import recommend_batch, recommend_batch_donated
 
 logger = logging.getLogger("kmlserver_tpu.serving")
@@ -137,6 +151,21 @@ class RuleBundle:
     # accelerator backends — their lookups stay on the device.
     host_rule_ids: np.ndarray | None = None
     host_rule_confs: np.ndarray | None = None
+    # ---- second model family (hybrid rule∪embedding serving) ----
+    # ALS item factors on this replica's device (f32 (V_emb, rank), rows
+    # L2-normalized) with their OWN vocabulary — the embedding id space is
+    # the full encode-phase vocab, deliberately broader than the (possibly
+    # Apriori-pruned) rule vocab; the hybrid merge happens at the name
+    # level so the two spaces never need to agree. None = no embedding
+    # artifact published (or it failed validation): rules-only serving.
+    emb_factors: "jax.Array | None" = None
+    emb_vocab: list[str] | None = None
+    emb_index: dict[str, int] | None = None
+    # (batch, length) shapes the embedding kernel was compiled for at
+    # publication — same zero-compiles-post-publish discipline as
+    # warmed_shapes, tracked separately because the native-rule-kernel
+    # bundle has no rule shapes to warm but still jits the embed kernel
+    emb_warmed_shapes: set = dataclasses.field(default_factory=set)
 
 
 class RecommendEngine:
@@ -169,6 +198,15 @@ class RecommendEngine:
         self.consecutive_reload_failures = 0
         self.artifact_quarantines = 0
         self.last_load_error: str | None = None
+        # second-model-family bookkeeping: embedding-artifact load
+        # failures are SURVIVABLE (the bundle publishes rules-only), so
+        # they get their own counters instead of riding reload_failures
+        self.embedding_load_failures = 0
+        self.last_embedding_error: str | None = None
+        # True when the LAST publication wanted embeddings (file present)
+        # but had to fall back to rules-only — rendered into /readyz's
+        # degraded reasons and /metrics
+        self.embedding_degraded = False
         # monotonic deadline before which reload_if_required() won't retry
         # a FAILED load (direct load() calls always go through — tests and
         # operator nudges must not be backoff-gated)
@@ -232,12 +270,24 @@ class RecommendEngine:
                 # faults.inject("engine.load") fails the reload exactly like
                 # a torn artifact — same rollback, same retry ladder
                 faults.fire("engine.load")
-                use_npz = self._verify_before_load(
+                use_npz, use_emb = self._verify_before_load(
                     best_path, rec_path, npz_path
                 )
                 best = artifacts.load_pickle(best_path)
                 replicas = self._build_replicas(
                     rec_path, npz_path, use_npz=use_npz
+                )
+                # second model family: attach ALS item factors to every
+                # replica. Fail-SOFT by design — a torn/corrupt/absent
+                # embeddings.npz costs the embedding path, never the
+                # reload (rules-only is the documented degradation, the
+                # exact analogue of the npz→pickle fallback above). The
+                # degraded/error outcome stays in LOCALS until the swap
+                # commits below: a reload that fails after this point
+                # (warmup raise → last-good keeps serving) must not leave
+                # /readyz describing the failed CANDIDATE generation.
+                emb_degraded, emb_error = self._attach_embeddings(
+                    replicas, use_emb=use_emb
                 )
                 # warm the serving kernel for every seed-bucket shape on
                 # EVERY replica BEFORE publishing: the first jit compile
@@ -284,40 +334,56 @@ class RecommendEngine:
                     self.dispatch_counts.append(0)
             self.cache_value = replicas[0].model_token or self.cache_value
             self.finished_loading = True
+            # embedding status commits WITH the bundle it describes
+            self.embedding_degraded = emb_degraded
+            self.last_embedding_error = emb_error
+            if emb_degraded:
+                self.embedding_load_failures += 1
             self.reload_counter += 1
             self.consecutive_reload_failures = 0
             self.last_load_error = None
             self._backoff_until = 0.0
             logger.info(
                 "reload #%d complete (epoch %d): %d tracks, %d rule keys, "
-                "%d replica(s), token %r",
+                "%d replica(s), embeddings %s, token %r",
                 self.reload_counter, epoch, len(replicas[0].vocab),
                 int(replicas[0].known_mask.sum()), len(replicas),
+                (
+                    f"on ({len(replicas[0].emb_vocab)} tracks)"
+                    if replicas[0].emb_factors is not None else "off"
+                ),
                 replicas[0].model_token,
             )
             return True
 
     def _verify_before_load(
         self, best_path: str, rec_path: str, npz_path: str
-    ) -> bool:
+    ) -> tuple[bool, bool]:
         """Integrity gate before any bytes are trusted: validate the
         artifact set against the mining job's manifest (sizes + sha256).
         A mismatched best/recommendations pickle ABORTS the reload (raise
         → last-good keeps serving); a mismatched npz is survivable — the
         pickle carries the same generation — so it only disables the
-        tensor-artifact fast path for this reload. The CURRENT token gates
-        the check: a manifest stamped for another generation (a
-        manifest-less writer — the reference's job — has published since)
-        is stale and steps aside rather than condemning fresh bytes.
-        → use_npz."""
+        tensor-artifact fast path for this reload, and a mismatched
+        embeddings.npz likewise only disables the embedding path (the
+        rule artifacts carry the generation; rules-only is the documented
+        degradation). The CURRENT token gates the check: a manifest
+        stamped for another generation (a manifest-less writer — the
+        reference's job — has published since) is stale and steps aside
+        rather than condemning fresh bytes. → (use_npz, use_emb)."""
         if not self.cfg.verify_manifest:
-            return True
+            return True, True
+        emb_path = artifacts.embeddings_artifact_path(self.cfg.pickles_dir)
         bad = artifacts.verify_files(
             self.cfg.pickles_dir,
-            [os.path.basename(p) for p in (best_path, rec_path, npz_path)],
+            [
+                os.path.basename(p)
+                for p in (best_path, rec_path, npz_path, emb_path)
+            ],
             token=self._read_token(),
         )
         use_npz = True
+        use_emb = True
         if npz_path in bad:
             logger.warning(
                 "tensor artifact %s fails its manifest checksum; "
@@ -325,11 +391,73 @@ class RecommendEngine:
             )
             use_npz = False
             bad = [p for p in bad if p != npz_path]
+        if emb_path in bad:
+            logger.warning(
+                "embedding artifact %s fails its manifest checksum; "
+                "serving rules-only this generation", emb_path,
+            )
+            use_emb = False
+            bad = [p for p in bad if p != emb_path]
         if bad:
             raise ArtifactIntegrityError(
                 f"artifact checksum mismatch vs manifest: {bad}", bad
             )
-        return use_npz
+        return use_npz, use_emb
+
+    def _attach_embeddings(
+        self, replicas: list[RuleBundle], use_emb: bool = True
+    ) -> tuple[bool, str | None]:
+        """Load ``embeddings.npz`` (if published) and commit the item
+        factors to every replica's device. NEVER raises: embedding
+        problems degrade to rules-only serving — a bad second-model
+        artifact must not cost the first model's reload. Fires the
+        ``embed.artifact`` chaos site so the degradation is
+        deterministically testable.
+
+        → ``(degraded, error)`` for the CALLER to commit alongside the
+        bundle swap — engine-level status must describe the bundle that
+        actually published, never a candidate whose reload later failed."""
+        if self.cfg.hybrid_mode == "rules":
+            # operator pinned rules-only: don't even read the file
+            return False, None
+        emb_path = artifacts.embeddings_artifact_path(self.cfg.pickles_dir)
+        if not os.path.exists(emb_path):
+            # no second model published: rules-only, not degraded
+            return False, None
+        try:
+            if not use_emb:
+                raise ArtifactIntegrityError(
+                    f"{emb_path} fails its manifest checksum", [emb_path]
+                )
+            faults.fire("embed.artifact")
+            loaded = artifacts.load_embeddings(emb_path)
+        except FileNotFoundError:
+            # raced a writer retiring the artifact (an embed-disabled
+            # publication removes it before the token rewrite): absent,
+            # not corrupt — rules-only without the degraded flag
+            logger.info(
+                "embedding artifact %s vanished mid-load (retired by the "
+                "miner); serving rules-only", emb_path,
+            )
+            return False, None
+        except Exception as exc:
+            logger.exception(
+                "embedding artifact %s unusable; serving rules-only",
+                emb_path,
+            )
+            return True, f"{type(exc).__name__}: {exc}"
+        emb_vocab = loaded["vocab"]
+        emb_index = {n: i for i, n in enumerate(emb_vocab)}
+        factors = jnp.asarray(loaded["item_factors"])
+        for bundle in replicas:
+            bundle.emb_vocab = emb_vocab
+            bundle.emb_index = emb_index
+            bundle.emb_factors = (
+                jax.device_put(factors, bundle.device)
+                if bundle.device is not None
+                else factors
+            )
+        return False, None
 
     def _note_reload_failure(
         self, exc: Exception, best_path: str, rec_path: str, npz_path: str
@@ -516,10 +644,15 @@ class RecommendEngine:
     def _warmup(self, bundle: RuleBundle) -> None:
         """Compile EVERY (batch-bucket, length-bucket) shape before the
         bundle publishes: no request — whatever its batch size — ever pays
-        a compile or a 32-wide kernel for a batch of 3."""
-        if bundle.host_rule_ids is not None:
-            return  # native host kernel: nothing ever compiles
-        kernel = self._resolve_kernel()
+        a compile or a 32-wide kernel for a batch of 3. Covers BOTH model
+        families: the rule max-merge kernel (skipped for the native host
+        kernel, which never compiles) and, when embeddings are attached,
+        the cosine top-k kernel over the same bucket grid."""
+        warm_rules = bundle.host_rule_ids is None
+        warm_emb = bundle.emb_factors is not None
+        if not warm_rules and not warm_emb:
+            return  # native host kernel, no embeddings: nothing compiles
+        kernel = self._resolve_kernel() if warm_rules else None
         for length in self._len_buckets():
             for batch in self._batch_buckets():
                 seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
@@ -527,10 +660,26 @@ class RecommendEngine:
                     # commit the seeds to the replica's device so the
                     # warmed executable is the one its dispatches will hit
                     seeds = jax.device_put(seeds, bundle.device)
-                jax.block_until_ready(
-                    kernel(bundle.rule_ids, bundle.rule_confs, seeds)
-                )
-                bundle.warmed_shapes.add((batch, length))
+                if warm_rules:
+                    jax.block_until_ready(
+                        kernel(bundle.rule_ids, bundle.rule_confs, seeds)
+                    )
+                    bundle.warmed_shapes.add((batch, length))
+                if warm_emb:
+                    jax.block_until_ready(
+                        embed_topk(
+                            bundle.emb_factors, seeds,
+                            k_best=self.cfg.k_best_tracks,
+                        )
+                    )
+                    bundle.emb_warmed_shapes.add((batch, length))
+
+    @property
+    def embedding_active(self) -> bool:
+        """True when the published bundle carries ALS item factors (the
+        hybrid merge path is live)."""
+        bundle = self.bundle
+        return bundle is not None and bundle.emb_factors is not None
 
     @property
     def host_kernel_active(self) -> bool:
@@ -648,12 +797,96 @@ class RecommendEngine:
             )
         return seeds_dev, known_rows
 
+    # ---------- second model family: embedding dispatch + hybrid merge ----
+
+    def _dispatch_embed(
+        self, bundle: RuleBundle, seed_sets: list[list[str]],
+        n_rows: int, length: int,
+    ):
+        """Dispatch the embedding cosine top-k for a batch → ``(device
+        top_ids, device top_sims, host known-row mask)``, or None when the
+        bundle carries no factors / the operator pinned rules-only. Runs
+        on the DISPATCH path (no host syncs — jax dispatch is async); the
+        caller's ``finish()`` converts the device results. The (n_rows,
+        length) shape must come from the warmed bucket grid — an unwarmed
+        shape is counted and logged exactly like the rule kernel's."""
+        if bundle.emb_factors is None or self.cfg.hybrid_mode == "rules":
+            return None
+        arr = np.full((n_rows, length), -1, dtype=np.int32)
+        known = np.zeros(len(seed_sets), dtype=bool)
+        index = bundle.emb_index or {}
+        for r, seeds in enumerate(seed_sets):
+            ids = [index[s] for s in seeds if s in index][:length]
+            arr[r, : len(ids)] = ids
+            known[r] = len(ids) > 0
+        if not known.any():
+            # no row has an embed-known seed: the kernel's output would be
+            # ignored wholesale — skip the transfer + full-vocab matmul
+            return None
+        seeds_dev = jax.device_put(arr, bundle.device)
+        shape = (n_rows, length)
+        if shape not in bundle.emb_warmed_shapes:
+            self.unwarmed_dispatches += 1
+            logger.warning(
+                "unwarmed embedding seed shape %s dispatched (compile on "
+                "the serving path); warmed buckets: batches %s x lengths %s",
+                shape, self._batch_buckets(), self._len_buckets(),
+            )
+        top_ids, top_sims = embed_topk(
+            bundle.emb_factors, seeds_dev, k_best=self.cfg.k_best_tracks
+        )
+        return top_ids, top_sims, known
+
+    def _compose_answer(
+        self, bundle: RuleBundle, seeds: list[str], rule_known: bool,
+        ids_row, confs_row, emb_row,
+    ) -> tuple[list[str], str]:
+        """Merge the two model families' top-k for ONE request → (songs,
+        source ∈ {"rules", "embed", "hybrid", "fallback", "empty"}).
+
+        ``emb_row`` is ``(ids, sims, known)`` host rows or None (no
+        embeddings / rules-only mode) — None reproduces the legacy
+        rules-only behavior bit for bit. The merge is pure host float
+        arithmetic over ≤ 2·k candidates with a deterministic tie order
+        (score desc, name asc), so every replica — and every cache epoch
+        over identical artifacts — composes the identical answer."""
+        emb_known = emb_row is not None and bool(emb_row[2])
+        if not rule_known and not emb_known:
+            return self.static_recommendation(seeds), "fallback"
+        if not emb_known:
+            songs = [bundle.vocab[int(i)] for i in ids_row if i >= 0]
+            return songs, ("rules" if songs else "empty")
+        emb_pairs = [
+            (bundle.emb_vocab[int(i)], float(s))
+            for i, s in zip(emb_row[0], emb_row[1])
+            if i >= 0
+        ]
+        if self.cfg.hybrid_mode == "embed" or not rule_known:
+            # embed-only mode, or a cold-start seed the rules have never
+            # seen: the embedding answer IS the answer (this is the
+            # scenario the second model family exists for)
+            songs = [n for n, _ in emb_pairs]
+            return songs, ("embed" if songs else "empty")
+        # blend: union of both candidate lists, scores mixed by the knob
+        w = min(max(self.cfg.hybrid_blend_weight, 0.0), 1.0)
+        scores: dict[str, float] = {}
+        for i, c in zip(ids_row, confs_row):
+            if i >= 0:
+                scores[bundle.vocab[int(i)]] = (1.0 - w) * float(c)
+        for name, sim in emb_pairs:
+            scores[name] = scores.get(name, 0.0) + w * sim
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+        songs = [n for n, _ in ranked[: self.cfg.k_best_tracks]]
+        return songs, ("hybrid" if songs else "empty")
+
     def recommend(self, seed_tracks: list[str]) -> tuple[list[str], str]:
-        """→ (songs, source) where source ∈ {"rules", "fallback", "empty"}.
+        """→ (songs, source), source ∈ {"rules", "embed", "hybrid",
+        "fallback", "empty"}.
 
         Mirrors rest_api/app/main.py:224-254, including: degraded fallback
         while rules are loading (:225-228), membership filter (:235),
-        fallback only when NO seed is known (:236-238), and results that may
+        fallback only when NO seed is known to EITHER model family
+        (:236-238 — the reference knows only rules), and results that may
         legitimately be empty when all known seeds have empty rows.
         """
         bundle = self.bundle
@@ -666,31 +899,46 @@ class RecommendEngine:
             for s in seed_tracks
             if s in bundle.index and bundle.known_mask[bundle.index[s]]
         ]
-        if not known_ids:
+        # dispatch the embedding kernel FIRST (async — the known mask is
+        # host-computed at dispatch, no sync), then the rule kernel, and
+        # only convert results after both are in flight: the two device
+        # calls overlap instead of serializing, mirroring the batched
+        # path's dispatch-both-then-finish discipline
+        emb = self._dispatch_embed(
+            bundle, [seed_tracks], 1,
+            self._bucket_len(max(len(seed_tracks), 1)),
+        )
+        if not known_ids and (emb is None or not emb[2][0]):
             logger.info("no seed of %d known; static fallback", len(seed_tracks))
             return self.static_recommendation(seed_tracks), "fallback"
-        known_ids = known_ids[: self.cfg.max_seed_tracks]
-        if bundle.host_rule_ids is not None:
-            from . import native_serve
+        ids = confs = None
+        if known_ids:
+            known_ids = known_ids[: self.cfg.max_seed_tracks]
+            if bundle.host_rule_ids is not None:
+                from . import native_serve
 
-            arr = np.full((1, max(len(known_ids), 1)), -1, dtype=np.int32)
-            arr[0, : len(known_ids)] = known_ids
-            top_ids, _ = native_serve.serve_topk(
-                bundle.host_rule_ids, bundle.host_rule_confs, arr,
-                self.cfg.k_best_tracks,
-            )
-            ids = top_ids[0]
-            self._note_dispatch(0)
-        else:
-            length = self._bucket_len(len(known_ids))
-            seeds_dev, _ = self._stage_seeds(bundle, [seed_tracks], 1, length)
-            top_ids, _ = self._resolve_kernel()(
-                bundle.rule_ids, bundle.rule_confs, seeds_dev
-            )
-            ids = np.asarray(top_ids[0])
-            self._note_dispatch(0)
-        songs = [bundle.vocab[int(i)] for i in ids if i >= 0]
-        return songs, ("rules" if songs else "empty")
+                arr = np.full((1, max(len(known_ids), 1)), -1, dtype=np.int32)
+                arr[0, : len(known_ids)] = known_ids
+                top_ids, top_confs = native_serve.serve_topk(
+                    bundle.host_rule_ids, bundle.host_rule_confs, arr,
+                    self.cfg.k_best_tracks,
+                )
+                ids, confs = top_ids[0], top_confs[0]
+            else:
+                length = self._bucket_len(len(known_ids))
+                seeds_dev, _ = self._stage_seeds(bundle, [seed_tracks], 1, length)
+                top_ids, top_confs = self._resolve_kernel()(
+                    bundle.rule_ids, bundle.rule_confs, seeds_dev
+                )
+                ids = np.asarray(top_ids[0])
+                confs = np.asarray(top_confs[0])
+        self._note_dispatch(0)
+        emb_row = None
+        if emb is not None:
+            emb_row = (np.asarray(emb[0])[0], np.asarray(emb[1])[0], emb[2][0])
+        return self._compose_answer(
+            bundle, seed_tracks, bool(known_ids), ids, confs, emb_row
+        )
 
     def recommend_many_async(
         self, seed_sets: list[list[str]], replica: int | None = None
@@ -738,6 +986,15 @@ class RecommendEngine:
             )
             arr = np.full((len(seed_sets), length), -1, dtype=np.int32)
             known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
+            # the embedding kernel IS jitted even next to the native rule
+            # kernel, so ITS seed array rides the warmed bucket grid
+            emb = self._dispatch_embed(
+                bundle, seed_sets,
+                self._bucket_batch(max(len(seed_sets), 1)),
+                self._bucket_len(
+                    max((len(s) for s in seed_sets), default=1)
+                ),
+            )
             self._note_dispatch(idx)
 
             def finish_native() -> list[tuple[list[str], str]]:
@@ -748,21 +1005,22 @@ class RecommendEngine:
                 # faults raise into the batcher's circuit breaker)
                 faults.fire("replica.kernel", replica=idx)
                 # the ctypes call releases the GIL for the whole batch
-                host_ids, _ = native_serve.serve_topk(
+                host_ids, host_confs = native_serve.serve_topk(
                     bundle.host_rule_ids, bundle.host_rule_confs, arr,
                     self.cfg.k_best_tracks,
                 )
+                emb_host = None
+                if emb is not None:
+                    emb_host = (np.asarray(emb[0]), np.asarray(emb[1]), emb[2])
                 out: list[tuple[list[str], str]] = []
                 for r, seeds in enumerate(seed_sets):
-                    if known_rows[r]:
-                        songs = [
-                            bundle.vocab[int(i)] for i in host_ids[r] if i >= 0
-                        ]
-                        out.append((songs, "rules" if songs else "empty"))
-                    else:
-                        out.append(
-                            (self.static_recommendation(seeds), "fallback")
-                        )
+                    emb_row = None if emb_host is None else (
+                        emb_host[0][r], emb_host[1][r], emb_host[2][r]
+                    )
+                    out.append(self._compose_answer(
+                        bundle, seeds, bool(known_rows[r]),
+                        host_ids[r], host_confs[r], emb_row,
+                    ))
                 return out
 
             return finish_native
@@ -779,22 +1037,32 @@ class RecommendEngine:
         seeds_dev, known_rows = self._stage_seeds(
             bundle, seed_sets, n_rows, length
         )
-        top_ids, _ = self._resolve_kernel()(
+        top_ids, top_confs = self._resolve_kernel()(
             bundle.rule_ids, bundle.rule_confs, seeds_dev
         )
+        # second model family: the embedding lookup dispatches alongside
+        # the rule kernel onto the same replica device — both async, both
+        # consumed together in finish()
+        emb = self._dispatch_embed(bundle, seed_sets, n_rows, length)
         self._note_dispatch(idx)
 
         def finish() -> list[tuple[list[str], str]]:
             # chaos hook on the completion path (see finish_native)
             faults.fire("replica.kernel", replica=idx)
             host_ids = np.asarray(top_ids)  # blocks on the device transfer
+            host_confs = np.asarray(top_confs)
+            emb_host = None
+            if emb is not None:
+                emb_host = (np.asarray(emb[0]), np.asarray(emb[1]), emb[2])
             out: list[tuple[list[str], str]] = []
             for r, seeds in enumerate(seed_sets):
-                if known_rows[r]:
-                    songs = [bundle.vocab[int(i)] for i in host_ids[r] if i >= 0]
-                    out.append((songs, "rules" if songs else "empty"))
-                else:
-                    out.append((self.static_recommendation(seeds), "fallback"))
+                emb_row = None if emb_host is None else (
+                    emb_host[0][r], emb_host[1][r], emb_host[2][r]
+                )
+                out.append(self._compose_answer(
+                    bundle, seeds, bool(known_rows[r]),
+                    host_ids[r], host_confs[r], emb_row,
+                ))
             return out
 
         return finish

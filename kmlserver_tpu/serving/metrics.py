@@ -53,7 +53,11 @@ class ServingMetrics:
     def __init__(self):
         self.started_at = time.time()
         self.requests_total = 0
-        self.requests_by_source = {"rules": 0, "fallback": 0, "empty": 0}
+        # "embed"/"hybrid" are the second model family's sources — present
+        # from the start so dashboards can rely on the series existing
+        self.requests_by_source = {
+            "rules": 0, "embed": 0, "hybrid": 0, "fallback": 0, "empty": 0,
+        }
         self.errors_total = 0
         self.shed_total = 0
         # fault-tolerance counters: degraded answers by reason (deadline

@@ -750,9 +750,12 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     refs = collect_code_knobs(index, cfg)
     assert len(refs) >= 70 and set(refs) <= set(scopes)
     env_map = collect_fault_env_map(index, cfg)
-    assert len(env_map) == 6, env_map
+    assert len(env_map) == 7, env_map
+    assert env_map["KMLS_FAULT_EMBED_CORRUPT"][0] == "embed.artifact"
     sites = collect_fire_sites(index, cfg)
-    assert {"engine.load", "replica.kernel", "ckpt.corrupt"} <= sites
+    assert {
+        "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact"
+    } <= sites
 
 
 def test_cli_exit_codes(tmp_path):

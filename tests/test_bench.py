@@ -365,6 +365,19 @@ class TestTpuSuiteWiring:
             "full_s": 1.445, "interrupted_s": 1.298, "resume_s": 0.129,
             "saved_pct": 91.068, "identical": True, "platform": "cpu",
         },
+        "als-hybrid": {
+            "als_train_s": 3.2, "als_rank": 32, "als_iters": 8,
+            "emb_vocab": 2171, "qps": 1000.0, "achieved_qps": 999.0,
+            "p50_ms": 1.2, "p95_ms": 3.0, "p99_ms": 6.5, "errors": 0,
+            "cold_start_seeds": 300, "cold_start_hit_frac": 0.99,
+            "platform": "cpu",
+        },
+        "confserve": {
+            "qps": 1000.0, "achieved_qps": 1001.0, "p50_ms": 2.0,
+            "p95_ms": 4.5, "p99_ms": 9.0, "errors": 0, "rule_keys": 431,
+            "max_itemset_len": 3, "confidence_mode": "confidence",
+            "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -891,7 +904,7 @@ class TestBenchStateResume:
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
-            "mine_resume_cpu",
+            "mine_resume_cpu", "als_hybrid_cpu", "confserve_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -1183,6 +1196,70 @@ class TestCompactLine:
         full = {"metric": "m", "value": 1.0, "unit": "s",
                 "vs_baseline": 20.0, "platform": "cpu", **result}
         assert len(bench._compact_line(full)) <= bench.COMPACT_LINE_LIMIT
+
+    def test_record_als_hybrid_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-6 second-model-family bracket's judged keys (ALS
+        train time, hybrid p99, cold-start hit fraction) must land in the
+        compact line without regressing the ≤1,800 budget."""
+        canned = {
+            "als_train_s": 3.214, "als_rank": 32, "als_iters": 8,
+            "emb_vocab": 2171, "qps": 1000.0, "achieved_qps": 998.7,
+            "p50_ms": 1.2, "p95_ms": 3.1, "p99_ms": 6.4, "errors": 0,
+            "cold_start_seeds": 312, "cold_start_hit_frac": 0.987,
+            "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_als_hybrid(result)
+        assert result["als_train_s"] == 3.214
+        assert result["hybrid_p99_ms"] == 6.4
+        assert result["cold_start_hit_frac"] == 0.987
+        assert result["hybrid_platform"] == "cpu"
+        for key in ("als_train_s", "hybrid_p50_ms", "hybrid_p99_ms",
+                    "hybrid_errors", "cold_start_hit_frac",
+                    "cold_start_seeds"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["als_train_s"] == 3.214
+        assert parsed["hybrid_p99_ms"] == 6.4
+        assert parsed["cold_start_hit_frac"] == 0.987
+
+    def test_record_confserve_emits_bounded_artifact(self, monkeypatch):
+        """The confidence-mode serving bracket (carried-over ROADMAP
+        item): multi-antecedent rules through the max-merge kernel, keys
+        in the compact line under the budget."""
+        canned = {
+            "qps": 1000.0, "achieved_qps": 1001.3, "p50_ms": 2.1,
+            "p95_ms": 4.8, "p99_ms": 9.2, "errors": 0, "rule_keys": 431,
+            "max_itemset_len": 3, "confidence_mode": "confidence",
+            "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_confserve(result)
+        assert result["confserve_p99_ms"] == 9.2
+        assert result["confserve_qps"] == 1001.3
+        assert result["confserve_rule_keys"] == 431
+        for key in ("confserve_p50_ms", "confserve_p99_ms",
+                    "confserve_qps", "confserve_errors"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["confserve_p99_ms"] == 9.2
+        assert parsed["confserve_p50_ms"] == 2.1
 
     def test_emitter_final_line_bounded_with_full_sidecar(
         self, tmp_path, capsys

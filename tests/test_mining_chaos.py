@@ -68,6 +68,12 @@ def _make_pvc(base, rng_seed=0):
         base_dir=base, datasets_dir=ds_dir, min_support=0.1,
         k_max_consequents=32, top_tracks_save_percentile=0.25,
         lease_ttl_s=5.0,
+        # embed phase ON throughout this suite: the second writer rides
+        # the same checkpoint/lease/manifest machinery, so every chaos
+        # scenario here (kill-at-phase incl. "embed", torn checkpoints,
+        # zombie fencing) exercises it too — and the bit-identity
+        # assertions cover embeddings.npz via the manifest sha256
+        embed_enabled=True, als_rank=8, als_iters=4,
     )
 
 
