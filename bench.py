@@ -349,6 +349,12 @@ _COMPACT_PRIORITY = (
     "cold_start_hit_frac", "cold_start_seeds",
     "confserve_p50_ms", "confserve_p99_ms", "confserve_qps",
     "confserve_errors",
+    "shardserve_sharded_p50_ms", "shardserve_sharded_p99_ms",
+    "shardserve_replicated_p50_ms", "shardserve_replicated_p99_ms",
+    "shardserve_identical", "shardserve_shards", "shardserve_unwarmed",
+    "shardserve_max_catalog_bytes",
+    "scale_shard_mine_s", "scale_shard_rows_per_s",
+    "scale_shard_count_path", "scale_shard_shards",
     "replay_queue_wait_p99_ms", "replay_device_p99_ms",
     "replay_queue_wait_p50_ms", "replay_device_p50_ms", "replay_e2e_p999_ms",
     "replay_server_p50_ms", "replay_server_p95_ms", "replay_server_p99_ms",
@@ -1621,6 +1627,155 @@ with tempfile.TemporaryDirectory(prefix="kmls_confserve_") as base:
 """
 
 
+# model-parallel serving bracket (ISSUE 7): mine a real catalog, publish
+# it under BOTH layouts, and prove the acceptance on the 8-virtual-device
+# mesh — auto resolves to sharded because the rule tensors measure over
+# the (deliberately tiny) per-device budget, answers are bit-identical to
+# the replicated engine, zero compiles post-publish, and the p50/p99 of
+# both layouts land in the artifact alongside the max servable catalog
+# bytes the mesh buys (budget × shards vs one device's budget).
+_SHARDSERVE_BENCH = r"""
+import dataclasses, json, os, sys, tempfile, time
+import numpy as np
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.engine import RecommendEngine
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+n_devices = len(jax.devices())
+assert n_devices >= 4, f"mesh bracket needs >=4 virtual devices, have {n_devices}"
+with tempfile.TemporaryDirectory(prefix="kmls_shardserve_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = dataclasses.replace(
+        MiningConfig.from_env(dotenv_path=None), base_dir=base,
+        datasets_dir=ds_dir, min_support=0.05,
+    )
+    run_mining_job(mcfg)
+
+    common = dict(
+        base_dir=base, batch_max_size=32, max_seed_tracks=8,
+        native_serve=False,
+    )
+    rep = RecommendEngine(dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), serve_devices=1, **common
+    ))
+    assert rep.load()
+    catalog_bytes = int(
+        np.asarray(rep.bundle.rule_ids).nbytes
+        + np.asarray(rep.bundle.rule_confs).nbytes
+    )
+    # budget HALF the catalog: one (virtual) device cannot hold a replica,
+    # so the auto layout MUST measure its way to sharded
+    budget = max(catalog_bytes // 2, 1)
+    shd = RecommendEngine(dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), serve_devices=n_devices,
+        model_layout="auto", device_budget_bytes=budget, **common
+    ))
+    assert shd.load()
+    assert shd.bundle.layout == "sharded", shd.bundle.layout
+    shards = shd.bundle.n_shards
+
+    bundle = shd.bundle
+    rng = np.random.default_rng(0)
+    known = [
+        s for s in bundle.vocab if bundle.known_mask[bundle.index[s]]
+    ]
+    sets = [
+        list(rng.choice(known, size=int(rng.integers(1, 5)), replace=False))
+        for _ in range(32)
+    ]
+    identical = rep.recommend_many_async(sets)() == \
+        shd.recommend_many_async(sets)()
+
+    def bracket(engine, reps=40):
+        engine.recommend_many_async(sets)()  # warm the bucket
+        lat = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            engine.recommend_many_async(sets)()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        return lat[len(lat) // 2], lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+
+    rep_p50, rep_p99 = bracket(rep)
+    shd_p50, shd_p99 = bracket(shd)
+    print(json.dumps({
+        "shards": shards,
+        "identical": bool(identical),
+        "unwarmed_dispatches": shd.unwarmed_dispatches,
+        "catalog_bytes": catalog_bytes,
+        "device_budget_bytes": budget,
+        "max_catalog_bytes": budget * shards,
+        "replicated_p50_ms": round(rep_p50, 3),
+        "replicated_p99_ms": round(rep_p99, 3),
+        "sharded_p50_ms": round(shd_p50, 3),
+        "sharded_p99_ms": round(shd_p99, 3),
+        "shard_dispatch_counts": shd.shard_dispatch_counts,
+        "platform": dev.platform,
+    }))
+"""
+
+# vocab-sharded mining bracket (ISSUE 7): a basket matrix whose dense
+# single-device formulation busts the (deliberately small) HBM budget is
+# mined through the sharded count→emit pipeline on a 1x8 vocab mesh —
+# counts stay column-sharded, each shard emits its own antecedent rows.
+# Bitpack is pinned off so the bracket measures the MODEL-sharded dense
+# path, not the bit-packed fallback the budget would otherwise trigger.
+_SCALE_SHARD_BENCH = r"""
+import dataclasses, json, sys, time
+import jax
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.synthetic import synthetic_table
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.mining.vocab import build_baskets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+n_devices = len(jax.devices())
+assert n_devices >= 4, f"mesh bracket needs >=4 virtual devices, have {n_devices}"
+P_N, V_N, ROWS = 20000, 2000, 400000
+table = synthetic_table(
+    n_playlists=P_N, n_tracks=V_N, target_rows=ROWS, seed=11
+)
+baskets = build_baskets(table)
+# dense single-device plan: int8 one-hot + int32 counts + top-k scratch
+dense_bytes = P_N * V_N + 8 * V_N * V_N
+budget = dense_bytes // 2  # one device cannot hold the dense formulation
+cfg = dataclasses.replace(
+    MiningConfig.from_env(dotenv_path=None),
+    min_support=0.005, k_max_consequents=64,
+    model_layout="sharded", bitpack_threshold_elems=None,
+    hbm_budget_bytes=budget, prune_vocab_threshold=1 << 30,
+)
+t0 = time.perf_counter()
+result = mine(baskets, cfg)
+mine_s = time.perf_counter() - t0
+n_rules = int((result.tensors.rule_ids >= 0).sum())
+print(json.dumps({
+    "mine_s": round(mine_s, 3),
+    "rows_per_s": round(ROWS / mine_s, 1),
+    "shape": f"{P_N}x{V_N}",
+    "count_path": result.count_path,
+    "shards": n_devices,
+    "dense_single_device_bytes": dense_bytes,
+    "hbm_budget_bytes": budget,
+    "per_shard_counts_bytes": 4 * V_N * V_N // n_devices,
+    "rules_emitted": n_rules,
+    "frequent_items": result.tensors.n_frequent_items,
+    "platform": dev.platform,
+}))
+"""
+
+
 # every phase script prints "device: ..." to stderr right after backend
 # init; on TPU, not seeing it within this grace period means the backend
 # init hung (the flaky-pool failure mode) — kill early instead of burning
@@ -2480,6 +2635,19 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         _record_confserve(result)
         em.checkpoint()
 
+    if _remaining() > 200:
+        # model-parallel serving (ISSUE 7): auto layout shards a catalog
+        # that exceeds one (virtual) device's budget, answers stay
+        # bit-identical to replicated, zero compiles post-publish
+        _record_shardserve(result)
+        em.checkpoint()
+
+    if _remaining() > 240:
+        # vocab-sharded mining (ISSUE 7): the sharded count→emit path on
+        # an input whose dense formulation busts the per-device budget
+        _record_scale_shard(result)
+        em.checkpoint()
+
     if _remaining() > 180:
         # interpret-mode Pallas popcount at a small shape: proves the
         # kernel path exists + counts match, labeled honestly as interpret
@@ -2852,6 +3020,92 @@ def _record_confserve(
         ("rule_keys", "confserve_rule_keys"),
         ("max_itemset_len", "confserve_max_itemset_len"),
         ("platform", "confserve_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_shardserve(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The model-parallel serving bracket (ISSUE 7): a catalog whose
+    rule tensors exceed the per-device budget serves SHARDED (auto
+    layout), bit-identical to replicated, zero compiles post-publish;
+    replicated-vs-sharded p50/p99 and the max servable catalog bytes
+    land in the artifact. CPU-platform by construction (virtual 8-device
+    mesh), self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "shardserve", _SHARDSERVE_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"shardserve: {res['shards']} shards, identical="
+        f"{res['identical']}, unwarmed={res['unwarmed_dispatches']}, "
+        f"replicated p50 {res['replicated_p50_ms']:.2f}ms vs sharded "
+        f"p50 {res['sharded_p50_ms']:.2f}ms (batch bracket), max catalog "
+        f"{res['max_catalog_bytes'] / 1e6:.1f} MB across the mesh"
+    )
+    for src, dst in (
+        ("shards", "shardserve_shards"),
+        ("identical", "shardserve_identical"),
+        ("unwarmed_dispatches", "shardserve_unwarmed"),
+        ("catalog_bytes", "shardserve_catalog_bytes"),
+        ("device_budget_bytes", "shardserve_device_budget_bytes"),
+        ("max_catalog_bytes", "shardserve_max_catalog_bytes"),
+        ("replicated_p50_ms", "shardserve_replicated_p50_ms"),
+        ("replicated_p99_ms", "shardserve_replicated_p99_ms"),
+        ("sharded_p50_ms", "shardserve_sharded_p50_ms"),
+        ("sharded_p99_ms", "shardserve_sharded_p99_ms"),
+        ("platform", "shardserve_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_scale_shard(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The vocab-sharded mining bracket (ISSUE 7): a basket matrix whose
+    dense single-device formulation busts the HBM budget mines through
+    the sharded count→emit pipeline on the 1x8 vocab mesh."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "scale-shard", _SCALE_SHARD_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"scale-shard: {res['shape']} mined in {res['mine_s']:.1f}s "
+        f"({res['rows_per_s']:.0f} rows/s) via {res['count_path']} — "
+        f"dense needs {res['dense_single_device_bytes'] / 1e6:.0f} MB on "
+        f"one device (budget {res['hbm_budget_bytes'] / 1e6:.0f} MB); "
+        f"per-shard counts {res['per_shard_counts_bytes'] / 1e6:.1f} MB"
+    )
+    for src, dst in (
+        ("mine_s", "scale_shard_mine_s"),
+        ("rows_per_s", "scale_shard_rows_per_s"),
+        ("shape", "scale_shard_shape"),
+        ("count_path", "scale_shard_count_path"),
+        ("shards", "scale_shard_shards"),
+        ("dense_single_device_bytes", "scale_shard_dense_bytes"),
+        ("hbm_budget_bytes", "scale_shard_budget_bytes"),
+        ("rules_emitted", "scale_shard_rules"),
+        ("frequent_items", "scale_shard_frequent_items"),
+        ("platform", "scale_shard_platform"),
     ):
         if src in res and res[src] is not None:
             val = res[src]

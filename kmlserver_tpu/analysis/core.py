@@ -77,6 +77,12 @@ class AnalysisConfig:
         "kmlserver_tpu/serving/batcher.py::AsyncMicroBatcher.submit",
         "kmlserver_tpu/serving/batcher.py::AsyncMicroBatcher._flush",
         "kmlserver_tpu/serving/engine.py::RecommendEngine.recommend_many_async",
+        # the sharded-layout dispatch rides recommend_many_async, but its
+        # staging step (seed transfer + per-shard accounting) is anchored
+        # EXPLICITLY so a refactor that stops routing through the parent
+        # entry cannot silently take the sharded path out of the purity
+        # check (ISSUE 7; the anchor-existence test fails on a rename)
+        "kmlserver_tpu/serving/engine.py::RecommendEngine._stage_seeds",
     )
     # host-sync / blocking constructs forbidden on the dispatch path,
     # by resolved dotted name …

@@ -56,6 +56,18 @@ def _getenv_hybrid_mode() -> str:
     return "rules"
 
 
+def _getenv_model_layout() -> str:
+    """``KMLS_MODEL_LAYOUT``: ``replicated`` (default), ``sharded``, or
+    ``auto`` (shard when measured tensor bytes exceed
+    ``KMLS_DEVICE_BUDGET_BYTES``). Validation — including the fail-safe
+    fallback to ``replicated`` on a typo — lives in ONE place:
+    ``parallel.layout.validate_layout`` (both workloads resolve through
+    it, so the knob can never mean different things to the two sides)."""
+    from .parallel.layout import validate_layout
+
+    return validate_layout(os.getenv("KMLS_MODEL_LAYOUT", "replicated"))
+
+
 def _getenv_bitpack_threshold() -> int | str | None:
     """``KMLS_BITPACK_THRESHOLD_ELEMS``: "auto" (HBM-fit dispatch, the
     default), "none"/"never" (dense always), or an explicit element count."""
@@ -165,6 +177,11 @@ KNOB_REGISTRY: dict[str, str] = {
     # --- both workloads ---
     "KMLS_NATIVE": "both",
     "KMLS_JAX_CACHE_DIR": "both",
+    # model layout: replicated per-device tensors vs vocab-sharded across
+    # the mesh — read by the serving engine (rule/embedding tensors) and
+    # the mining dispatch (one-hot / support counting / ALS half-sweep)
+    "KMLS_MODEL_LAYOUT": "both",
+    "KMLS_DEVICE_BUDGET_BYTES": "both",
     # --- bench / sweep / dev harness ---
     "KMLS_BENCH_CPU": "tool",
     "KMLS_BENCH_DEADLINE_S": "tool",
@@ -268,6 +285,20 @@ class MiningConfig:
     # partition), "allgather" (explicit shard_map), "ring" (ppermute
     # neighbor exchange; lowest peak memory).
     sharded_impl: str = "gspmd"
+    # Model layout (parallel/layout.py — shared with the serving side):
+    # "replicated" keeps the legacy single-device-shaped mining compute;
+    # "sharded" lays the one-hot, the support counts, the rule emission,
+    # and the ALS item half-sweep out along the vocab axis of the mesh
+    # (a 1xN vocab-major mesh is built automatically when none is given),
+    # so the encode/mine phases accept inputs whose dense replicated
+    # formulation cannot fit one device; "auto" engages the sharded path
+    # only when the configured mesh already spans the vocab axis.
+    model_layout: str = "replicated"
+    # Per-device byte budget the LAYOUT decision measures against (the
+    # serving engine's auto trigger; distinct from hbm_budget_bytes,
+    # which routes the bitpack-vs-dense COUNTING dispatch). 0 = fall
+    # back to hbm_budget_bytes.
+    device_budget_bytes: int = 0
     # Above this vocabulary size, prune infrequent items (exact, by the
     # Apriori property) before pair counting — the path that makes the
     # 1M-track configs feasible (a dense 1M x 1M count matrix is 4 TB).
@@ -385,6 +416,8 @@ class MiningConfig:
             bitpack_threshold_elems=_getenv_bitpack_threshold(),
             hbm_budget_bytes=_getenv_int("KMLS_HBM_BUDGET_BYTES", 12 * (1 << 30)),
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
+            model_layout=_getenv_model_layout(),
+            device_budget_bytes=_getenv_int("KMLS_DEVICE_BUDGET_BYTES", 0),
             prune_vocab_threshold=_getenv_int("KMLS_PRUNE_VOCAB_THRESHOLD", 512),
             write_tensor_artifact=_getenv_bool("KMLS_WRITE_TENSOR_ARTIFACT", True),
             write_manifest=_getenv_bool("KMLS_WRITE_MANIFEST", True),
@@ -469,6 +502,22 @@ class ServingConfig:
     # KMLS_SERVE_DEVICES=8 on an 8-virtual-device CPU host exercises the
     # full data-parallel dispatch tier without hardware.
     serve_devices: int = 0
+    # Model layout for the published serving tensors (parallel/layout.py,
+    # shared with the mining side): "replicated" = one full rule-tensor
+    # copy per serving device (PR 2's data-parallel replicas, the
+    # default); "sharded" = ONE logical model vocab-sharded across every
+    # serving device via NamedSharding — per-device HBM holds V/S rule
+    # rows, so the servable catalog scales with the mesh; "auto" measures
+    # the loaded tensor bytes against device_budget_bytes and shards only
+    # when a replica would not fit. Sharded layout serves through the
+    # jitted sharded kernel (the native host kernel has no per-device
+    # state to partition, so it is bypassed) and presents as one replica
+    # to the dispatcher.
+    model_layout: str = "replicated"
+    # Per-device byte budget the auto layout measures rule+confidence
+    # tensor bytes against. 0 disables the auto trigger (auto then always
+    # resolves to replicated).
+    device_budget_bytes: int = 12 * (1 << 30)
     # Epoch-keyed recommendation cache in front of the batcher: answers are
     # keyed by (bundle epoch, canonicalized seed set), so a bundle hot-swap
     # invalidates the whole cache for free (the epoch moves, old keys can
@@ -572,6 +621,10 @@ class ServingConfig:
             shed_retry_after_s=_getenv_float("KMLS_SHED_RETRY_AFTER_S", 1.0),
             batch_max_inflight=_getenv_int("KMLS_BATCH_MAX_INFLIGHT", 4),
             serve_devices=_getenv_int("KMLS_SERVE_DEVICES", 0),
+            model_layout=_getenv_model_layout(),
+            device_budget_bytes=_getenv_int(
+                "KMLS_DEVICE_BUDGET_BYTES", 12 * (1 << 30)
+            ),
             cache_enabled=_getenv_bool("KMLS_CACHE_ENABLED", True),
             cache_max_entries=_getenv_int("KMLS_CACHE_MAX_ENTRIES", 8192),
             prefer_tensor_artifact=_getenv_bool("KMLS_PREFER_TENSOR_ARTIFACT", True),

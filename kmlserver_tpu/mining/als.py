@@ -25,8 +25,23 @@ per-row normal equations collapse into a single batched solve:
     F ← Xᵀ U (UᵀU + λI)⁻¹       (all V items at once)
 
 Each iteration is two (big × skinny) matmuls plus two rank×rank solves —
-exactly the shape ALX shards across TPU pods; here it runs on the local
-device (the mesh-sharded variant is the ROADMAP's model-parallel item).
+exactly the shape ALX shards across TPU pods. Two layouts:
+
+- **replicated** (default): the whole sweep on one device, as before.
+- **mesh-sharded** (``KMLS_MODEL_LAYOUT=sharded``, or ``auto`` when the
+  dense interaction matrix busts the per-device budget): the ALX recipe
+  proper — the interaction matrix shards along the VOCAB axis of the
+  same ``tp`` mesh the sharded miner uses (``P(None, 'tp')``), the item
+  factors shard with it (``P('tp', None)``), and the user half-sweep's
+  two reductions (``FᵀF`` Gramian and ``X F``) become ``psum``s over the
+  vocab axis while the ITEM half-sweep stays fully shard-local
+  (``X[:, lo:hi]ᵀ U`` touches only resident columns). Per-device memory
+  drops to O(P·V/tp), so the auto layout can TRAIN an embedding the
+  single-device HBM guard would previously have skipped. Collective
+  reduction order makes the sharded factors float-equal-but-not-bit-
+  equal to the replicated ones, which is exactly why ``model_layout``
+  joined the checkpoint fingerprint (mining/checkpoint.py): resume
+  within a layout is bit-identical, across layouts it re-trains.
 
 Serving consumes only the ITEM factors: seed→candidate scores are
 cosine similarities in item space (item-item collaborative filtering),
@@ -42,6 +57,7 @@ manifest sha256 prove it.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any
 
@@ -84,6 +100,75 @@ def _als_loss(
     )
 
 
+@functools.lru_cache(maxsize=8)
+def _sharded_sweep_fn(mesh):
+    """One ALS sweep with the item axis sharded over the mesh's vocab
+    (``tp``) axis — the ALX partitioning of these exact matmuls. The user
+    half-sweep reduces over items (``psum`` of the Gramian and of
+    ``X F``); the item half-sweep is embarrassingly shard-local. Cached
+    per mesh so the iteration loop reuses one compiled program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_TP
+    from ..utils.jaxcompat import shard_map
+
+    def local(x_loc, user_f, item_f_loc, reg):
+        # x_loc (P, V_loc) f32; user_f (P, R) replicated; item_f_loc
+        # (V_loc, R) — this shard's rows of the item-factor matrix
+        rank = user_f.shape[1]
+        eye = jnp.eye(rank, dtype=user_f.dtype)
+        g_item = (
+            jax.lax.psum(item_f_loc.T @ item_f_loc, AXIS_TP) + reg * eye
+        )
+        xf = jax.lax.psum(x_loc @ item_f_loc, AXIS_TP)  # (P, R)
+        user_f = jnp.linalg.solve(g_item, xf.T).T
+        g_user = user_f.T @ user_f + reg * eye
+        item_f_loc = jnp.linalg.solve(g_user, (x_loc.T @ user_f).T).T
+        return user_f, item_f_loc
+
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P(None, AXIS_TP), P(None, None), P(AXIS_TP, None), P()
+            ),
+            out_specs=(P(None, None), P(AXIS_TP, None)),
+            # the psums make user_f mesh-invariant; item_f varies by
+            # design (it IS the sharded output)
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_loss_fn(mesh):
+    """Training loss over the column-sharded interaction matrix: local
+    residual + local item-factor penalty, ``psum`` over the vocab axis;
+    the (replicated) user-factor penalty is added once by the caller."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_TP
+    from ..utils.jaxcompat import shard_map
+
+    def local(x_loc, user_f, item_f_loc, reg):
+        resid = x_loc - user_f @ item_f_loc.T
+        return jax.lax.psum(
+            jnp.sum(resid * resid) + reg * jnp.sum(item_f_loc * item_f_loc),
+            AXIS_TP,
+        )
+
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P(None, AXIS_TP), P(None, None), P(AXIS_TP, None), P()
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
 def normalize_factors(item_factors: np.ndarray) -> np.ndarray:
     """Row-L2-normalize → unit vectors, so serving dot products are cosine
     similarities in [-1, 1] and blend cleanly with rule confidences. A
@@ -93,8 +178,45 @@ def normalize_factors(item_factors: np.ndarray) -> np.ndarray:
     return (item_factors / np.maximum(norms, 1e-12)).astype(np.float32)
 
 
+def _als_shards(cfg: MiningConfig, mesh, p: int, v: int, rank: int) -> int:
+    """How many vocab shards the trainer lays the item axis over (1 =
+    the legacy single-device sweep). Sharding engages only when the mesh
+    spans the vocab (``tp``) axis AND the layout knob asks for it —
+    explicitly (``sharded``), or via ``auto`` exactly when the
+    single-device dense formulation would bust the HBM budget (the case
+    that previously SKIPPED the embed phase: the mesh can hold what one
+    device cannot). Deterministic in (config, dataset shape, mesh), so
+    every rank of a multi-host job decides identically."""
+    if mesh is None:
+        return 1
+    from ..parallel.mesh import AXIS_TP
+
+    from ..parallel.layout import validate_layout
+
+    tp = mesh.shape.get(AXIS_TP, 1)
+    if tp <= 1:
+        return 1
+    layout = validate_layout(getattr(cfg, "model_layout", "replicated"))
+    if layout == "sharded":
+        return tp
+    # auto: the LAYOUT decision measures against KMLS_DEVICE_BUDGET_BYTES
+    # (0 = fall back to the HBM dispatch budget — the documented contract
+    # in config.py); the fit GUARD below still budgets compute against
+    # hbm_budget_bytes, which is a different question (can the planned
+    # slab run) than this one (should the matrix shard at all)
+    layout_budget = (
+        getattr(cfg, "device_budget_bytes", 0) or cfg.hbm_budget_bytes
+    )
+    if (
+        layout == "auto"
+        and 5 * p * v + 8 * rank * (p + v) > layout_budget
+    ):
+        return tp
+    return 1
+
+
 def train_embeddings(
-    baskets: Baskets, cfg: MiningConfig, seed: int = 0
+    baskets: Baskets, cfg: MiningConfig, seed: int = 0, mesh=None
 ) -> dict[str, Any]:
     """Train item embeddings over the transaction DB → the ``embed``
     phase's checkpoint payload:
@@ -115,15 +237,18 @@ def train_embeddings(
     iters = max(1, cfg.als_iters)
     reg = jnp.float32(cfg.als_reg)
     p, v = baskets.n_playlists, baskets.n_tracks
+    shards = _als_shards(cfg, mesh, p, v, rank)
     # HBM-fit guard: this formulation materializes the interaction matrix
     # DENSE float32 — 4x the int8 footprint the mining path's bitpack
     # dispatch exists to avoid. At scales where that dispatch fires, the
     # dense ALS would OOM the job AFTER the expensive mine; skip the
     # phase deterministically instead (rules-only generation, loud
-    # message). The sparse/sharded ALS is the ROADMAP model-parallel
-    # item. Budgeted terms: X (P·V f32) + its int8 encode source + both
-    # factor matrices and their normal-equation right-hand sides.
-    dense_bytes = 5 * p * v + 8 * rank * (p + v)
+    # message). Under the sharded layout the matrix-shaped terms divide
+    # across the vocab shards (the ALX point), so the guard budgets the
+    # PER-DEVICE slab. Budgeted terms: X (P·V f32) + its int8 encode
+    # source + both factor matrices and their normal-equation right-hand
+    # sides.
+    dense_bytes = 5 * p * v // shards + 8 * rank * (p + v)
     if dense_bytes > cfg.hbm_budget_bytes:
         return {
             "item_factors": None,
@@ -133,31 +258,41 @@ def train_embeddings(
             "final_loss": None,
             "duration_s": 0.0,
             "skipped": (
-                f"dense {p}x{v} interaction matrix (~{dense_bytes >> 20} MiB)"
+                f"dense {p}x{v} interaction matrix (~{dense_bytes >> 20} MiB"
+                f" per device across {shards} shard(s))"
                 f" exceeds hbm_budget_bytes ({cfg.hbm_budget_bytes >> 20} "
                 "MiB); embed phase skipped — serving stays rules-only"
             ),
         }
     t0 = time.perf_counter()
-    x_mat = encode.onehot_matrix(
-        jnp.asarray(baskets.playlist_rows),
-        jnp.asarray(baskets.track_ids),
-        n_playlists=p,
-        n_tracks=v,
-    ).astype(jnp.float32)
     # fixed-seed HOST init: device RNG streams differ across backends,
-    # host bytes do not — resume/fingerprint identity depends on this
+    # host bytes do not — resume/fingerprint identity depends on this.
+    # The draw ORDER (users then items) is shared by both layouts.
     rng = np.random.default_rng(seed)
-    user_f = jnp.asarray(
-        rng.standard_normal((p, rank)).astype(np.float32) / np.sqrt(rank)
+    user_init = rng.standard_normal((p, rank)).astype(np.float32) / np.sqrt(
+        rank
     )
-    item_f = jnp.asarray(
-        rng.standard_normal((v, rank)).astype(np.float32) / np.sqrt(rank)
+    item_init = rng.standard_normal((v, rank)).astype(np.float32) / np.sqrt(
+        rank
     )
-    for _ in range(iters):
-        user_f, item_f = _als_sweep(x_mat, user_f, item_f, reg)
-    final_loss = float(_als_loss(x_mat, user_f, item_f, reg))
-    item_host = normalize_factors(np.array(jax.device_get(item_f)))
+    if shards > 1:
+        item_raw, final_loss = _train_sharded(
+            baskets, mesh, user_init, item_init, reg, iters, p, v
+        )
+        item_host = normalize_factors(item_raw)
+    else:
+        x_mat = encode.onehot_matrix(
+            jnp.asarray(baskets.playlist_rows),
+            jnp.asarray(baskets.track_ids),
+            n_playlists=p,
+            n_tracks=v,
+        ).astype(jnp.float32)
+        user_f = jnp.asarray(user_init)
+        item_f = jnp.asarray(item_init)
+        for _ in range(iters):
+            user_f, item_f = _als_sweep(x_mat, user_f, item_f, reg)
+        final_loss = float(_als_loss(x_mat, user_f, item_f, reg))
+        item_host = normalize_factors(np.array(jax.device_get(item_f)))
     duration_s = time.perf_counter() - t0
     return {
         "item_factors": item_host,
@@ -166,4 +301,50 @@ def train_embeddings(
         "reg": float(cfg.als_reg),
         "final_loss": final_loss,
         "duration_s": duration_s,
+        "shards": shards,
     }
+
+
+def _train_sharded(
+    baskets: Baskets, mesh, user_init: np.ndarray, item_init: np.ndarray,
+    reg: jax.Array, iters: int, p: int, v: int,
+) -> tuple[np.ndarray, float]:
+    """The mesh-sharded sweep loop → ``(item factors (V, R) host, final
+    loss)``. The interaction matrix is built DIRECTLY into its
+    ``P(None, 'tp')`` layout (no single-device staging — the whole point
+    is that no device ever holds all of X), the item factors ride
+    ``P('tp', None)``, and the padded vocab rows are zero-initialized so
+    they stay exactly zero through every sweep (zero interaction columns
+    solve to zero rows) and slice off at the end."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_TP, round_up
+
+    tp = mesh.shape[AXIS_TP]
+    v_pad = round_up(max(v, 1), tp)
+    rank = user_init.shape[1]
+    build = jax.jit(
+        lambda pr, ti: encode.onehot_matrix(
+            pr, ti, n_playlists=p, n_tracks=v_pad
+        ).astype(jnp.float32),
+        out_shardings=NamedSharding(mesh, P(None, AXIS_TP)),
+    )
+    x_mat = build(
+        jnp.asarray(baskets.playlist_rows), jnp.asarray(baskets.track_ids)
+    )
+    user_f = jax.device_put(
+        user_init, NamedSharding(mesh, P(None, None))
+    )
+    item_padded = np.zeros((v_pad, rank), dtype=np.float32)
+    item_padded[:v] = item_init
+    item_f = jax.device_put(
+        item_padded, NamedSharding(mesh, P(AXIS_TP, None))
+    )
+    sweep = _sharded_sweep_fn(mesh)
+    for _ in range(iters):
+        user_f, item_f = sweep(x_mat, user_f, item_f, reg)
+    user_host = np.array(jax.device_get(user_f))
+    loss = float(_sharded_loss_fn(mesh)(x_mat, user_f, item_f, reg))
+    loss += float(reg) * float(np.sum(user_host * user_host))
+    item_host = np.array(jax.device_get(item_f))[:v]
+    return item_host, loss

@@ -62,14 +62,21 @@ PHASES = ("encode", "mine", "rules", "embed")
 
 STATE_FILENAME = "state.json"
 # v2: the `embed` phase + ALS fields joined the fingerprint identity
-CKPT_VERSION = 2
+# v3: the model layout joined it (ISSUE 7) — rule emission is layout-exact
+#     either way, but the sharded ALS half-sweep's collective reduction
+#     order makes the embedding FACTORS float-different across layouts,
+#     so a checkpoint written under one layout must never publish under
+#     the other; within a layout, resume stays bit-identical
+CKPT_VERSION = 3
 
 # MiningConfig fields that can change the bytes of the final artifacts (or
 # of any phase payload). Anything NOT listed — dispatch/backend knobs like
 # bitpack_threshold_elems, sharded_impl, native_cpu_pair_counts — selects a
 # different route to the SAME exact result (the miner's dominance/exactness
 # guarantees), so a checkpoint survives e.g. a TPU-to-CPU restart.
+# ``model_layout`` is the one deliberate exception (see v3 note above).
 _FINGERPRINT_FIELDS = (
+    "model_layout",
     "min_support",
     "sample_ratio",
     "top_tracks_save_percentile",
@@ -100,6 +107,18 @@ def compute_fingerprint(
     }
     for field in _FINGERPRINT_FIELDS:
         ident[field] = getattr(cfg, field)
+    if getattr(cfg, "model_layout", "replicated") != "replicated":
+        # the SHARD TOPOLOGY joins the identity for the same reason the
+        # layout does: the sharded ALS half-sweep's psum order follows
+        # the mesh, so a resume onto a rescaled gang (tp=4 → tp=8) must
+        # re-mine rather than splice topology-mixed artifacts. Global
+        # device count is identical on every rank of a gang, so all
+        # ranks still fingerprint identically. The replicated default
+        # deliberately omits it — its compute is device-count-invariant
+        # and a TPU↔CPU restart must keep resuming.
+        import jax
+
+        ident["shard_topology"] = len(jax.devices())
     blob = json.dumps(ident, sort_keys=True).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 

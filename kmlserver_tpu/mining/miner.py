@@ -42,6 +42,7 @@ import numpy as np
 
 from ..config import MiningConfig
 from ..ops import cpu_popcount, encode, rules, support
+from ..parallel import layout as layout_mod
 from ..utils.profiling import PhaseTimer, trace_session
 from .vocab import Baskets, Vocab
 
@@ -443,6 +444,12 @@ def mine(
 ) -> MiningResult:
     """Run the full mining compute, timed like the reference's rule step."""
     timer = PhaseTimer()
+    # model layout (KMLS_MODEL_LAYOUT): under the sharded layout a run
+    # with no mesh — or the default dp-major auto mesh — gets a
+    # vocab-major 1xN mesh over the local devices, so the one-hot, the
+    # counts, and the emission all shard the vocab axis. Idempotent; a
+    # replicated layout leaves the mesh untouched.
+    mesh = layout_mod.mining_mesh(cfg, mesh)
     # native-library availability (and, on a fresh checkout, the one-time
     # g++ build it triggers) resolves BEFORE the reference-parity timer:
     # library setup is environment preparation, not rule generation — the
@@ -550,6 +557,17 @@ def mine(
         # (native/kmls_popcount.cpp). Same eligibility as the fused path
         # (no downstream step may need the one-hot or counts on device).
         use_native_cpu = native_cpu_ok
+        # vocab-sharded count+emit (the model-parallel layout's mining
+        # half): counts stay column-sharded across the mesh and each
+        # shard emits its own antecedent rows — the (V, V) matrix never
+        # lands on one device. Exact (bit-identical emission); the
+        # census/triple paths need materialized intermediates, so they
+        # keep the staged pipeline and report honestly.
+        use_shard_mine = (
+            layout_mod.wants_sharded_mining(cfg, mesh)
+            and not wants_bitpack
+            and cfg.max_itemset_len < 3
+        )
         use_fused = (
             mesh is None
             and not wants_bitpack
@@ -559,6 +577,8 @@ def mine(
         counts = x = None
         if use_native_cpu:
             count_path = "native-cpu"
+        elif use_shard_mine:
+            count_path = f"sharded-vocab-{cfg.sharded_impl}"
         elif use_fused:
             count_path = "dense-fused"
         else:
@@ -575,6 +595,27 @@ def mine(
                     mode=cfg.confidence_mode,
                     min_confidence=cfg.min_confidence,
                     n_total_songs=n_total,
+                )
+        elif use_shard_mine:
+            with timer.phase("sharded_mine"):
+                from ..parallel.support import sharded_rule_tensors
+
+                min_count = support.min_count_for(
+                    cfg.min_support, mined_baskets.n_playlists
+                )
+                emitted = sharded_rule_tensors(
+                    mined_baskets, mesh, min_count,
+                    cfg.k_max_consequents, impl=cfg.sharded_impl,
+                )
+                tensors = rules.assemble_rule_tensors(
+                    *emitted,
+                    n_playlists=mined_baskets.n_playlists,
+                    min_support=cfg.min_support,
+                    k_max=cfg.k_max_consequents,
+                    mode=cfg.confidence_mode,
+                    min_confidence=cfg.min_confidence,
+                    n_total_songs=n_total,
+                    n_tracks=mined_baskets.n_tracks,
                 )
         elif use_fused:
             with timer.phase("fused_mine"):

@@ -141,6 +141,14 @@ def run_mining_job(
 ) -> JobSummary:
     print(f"Job starting at {get_current_time_str()}")
 
+    # model layout (KMLS_MODEL_LAYOUT): resolved ONCE here so the mine
+    # and embed phases ride the SAME vocab-sharded mesh — a sharded
+    # layout with no mesh (or the dp-major auto mesh) gets a vocab-major
+    # 1xN mesh over the local devices; replicated leaves it untouched
+    from ..parallel import layout as layout_mod
+
+    mesh = layout_mod.mining_mesh(cfg, mesh)
+
     # Multi-host: every rank participates in the sharded compute (the
     # collectives need all processes), but only rank 0 touches the shared
     # PVC — duplicate history appends would corrupt the dataset rotation,
@@ -218,7 +226,10 @@ def run_mining_job(
             def _embed():
                 from . import als
 
-                return als.train_embeddings(baskets, cfg)
+                # the second model family rides the same mesh: under the
+                # sharded layout the item half-sweep partitions along the
+                # vocab axis (ALX recipe) instead of training one-device
+                return als.train_embeddings(baskets, cfg, mesh=mesh)
 
             emb_payload = phase("embed", _embed)
             if emb_payload.get("item_factors") is None:

@@ -19,10 +19,44 @@ Semantics parity notes:
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+
+def _masked_topk_from_candidates(
+    cand_ids: jax.Array,  # int32 (B, N) GLOBAL ids, -1 = dead lane
+    cand_confs: jax.Array,  # float32 (B, N), 0 = dead lane
+    *,
+    v: int,
+    k_best: int,
+):
+    """THE kernel epilogue, shared by every lookup variant: max-merge
+    (id, conf) candidate lanes into a (B, V) score vector (dead lanes —
+    id < 0 or conf ≤ 0 — dump into a spill slot V, sliced off), then the
+    canonical masked top-k: ids with conf ≤ 0 become -1, columns
+    statically padded up to ``k_best``. One copy on purpose — the
+    replicated kernel, the per-shard partials, and the cross-shard merge
+    all route through it, which is what makes the layout bit-identity
+    contract (tests/test_shard_layout.py) a structural property instead
+    of three hand-kept copies."""
+    b = cand_ids.shape[0]
+    live = (cand_ids >= 0) & (cand_confs > 0)
+    targets = jnp.where(live, cand_ids, v)
+    confs = jnp.where(live, cand_confs, 0.0)
+    scores = jnp.zeros((b, v + 1), dtype=cand_confs.dtype)
+    batch_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+    scores = scores.at[batch_idx, targets].max(confs)[:, :v]
+    k = min(k_best, v)
+    top_confs, top_ids = jax.lax.top_k(scores, k)
+    top_ids = jnp.where(top_confs > 0, top_ids, -1)
+    if k < k_best:  # static pad so callers always see k_best columns
+        pad = ((0, 0), (0, k_best - k))
+        top_ids = jnp.pad(top_ids, pad, constant_values=-1)
+        top_confs = jnp.pad(top_confs, pad)
+    return top_ids, top_confs
 
 
 def _recommend_batch_impl(
@@ -39,21 +73,11 @@ def _recommend_batch_impl(
     gathered_ids = rule_ids[safe_seeds]  # (B, L, K)
     gathered_confs = rule_confs[safe_seeds]  # (B, L, K)
     valid = (gathered_ids >= 0) & (seed_ids >= 0)[..., None]
-    # dump padding into an extra slot V, sliced off after the scatter
-    targets = jnp.where(valid, gathered_ids, v)
-    confs = jnp.where(valid, gathered_confs, 0.0)
-    scores = jnp.zeros((b, v + 1), dtype=rule_confs.dtype)
-    batch_idx = jnp.arange(b, dtype=jnp.int32)[:, None, None]
-    scores = scores.at[batch_idx, targets].max(confs)
-    scores = scores[:, :v]
-    k = min(k_best, v)
-    top_confs, top_ids = jax.lax.top_k(scores, k)
-    top_ids = jnp.where(top_confs > 0, top_ids, -1)
-    if k < k_best:  # static pad so callers always see k_best columns
-        pad = ((0, 0), (0, k_best - k))
-        top_ids = jnp.pad(top_ids, pad, constant_values=-1)
-        top_confs = jnp.pad(top_confs, pad)
-    return top_ids, top_confs
+    return _masked_topk_from_candidates(
+        jnp.where(valid, gathered_ids, -1).reshape(b, -1),
+        jnp.where(valid, gathered_confs, 0.0).reshape(b, -1),
+        v=v, k_best=k_best,
+    )
 
 
 recommend_batch = partial(jax.jit, static_argnames=("k_best",))(
@@ -70,3 +94,100 @@ recommend_batch = partial(jax.jit, static_argnames=("k_best",))(
 recommend_batch_donated = partial(
     jax.jit, static_argnames=("k_best",), donate_argnums=(2,)
 )(_recommend_batch_impl)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-sharded layout (KMLS_MODEL_LAYOUT=sharded): the rule tensors are
+# partitioned along the vocab (antecedent) axis across a 1-D device mesh —
+# per-device HBM holds V/S rows instead of V, so the servable catalog scales
+# with the mesh instead of capping at one device (the ALX sharding recipe,
+# PAPERS.md). Lookup runs as one shard_map program:
+#
+#   1. each shard maps the replicated seed batch onto its own row range
+#      (seeds outside the range contribute nothing — exactly the replicated
+#      kernel's membership semantics, partitioned),
+#   2. gathers + scatter-maxes its rows into a GLOBAL-width score vector
+#      (consequent ids span the full vocab; the transient (B, V) scores are
+#      ~K_max× smaller than the resident rule rows, so full width per shard
+#      is the cheap axis), and takes a per-shard top-k partial,
+#   3. all_gather of the (B, k) partials over the shard axis, then a
+#      max-merge rescatter + final top-k — replicated on every shard.
+#
+# Exactness, including lax.top_k's index tie order: for any consequent in
+# the true global top-k, the shard where it attains its max partial score
+# must rank it inside ITS top-k (fewer than k competitors beat it there, or
+# they would beat it globally too), so the gathered candidate set contains
+# every true winner at its exact global score, and the merge's scatter-max
+# + top_k reproduces the replicated kernel's output bit for bit (pinned by
+# tests/test_shard_layout.py).
+# ---------------------------------------------------------------------------
+
+
+def _sharded_recommend_local(
+    rule_ids_loc: jax.Array,  # int32 (V_loc, K) — GLOBAL consequent ids
+    rule_confs_loc: jax.Array,  # float32 (V_loc, K)
+    seed_ids: jax.Array,  # int32 (B, L), -1 padded, GLOBAL ids, replicated
+    *,
+    k_best: int,
+    axis: str,
+    n_shards: int,
+):
+    v_loc = rule_ids_loc.shape[0]
+    v = v_loc * n_shards  # padded global vocab width
+    b = seed_ids.shape[0]
+    lo = jax.lax.axis_index(axis).astype(jnp.int32) * v_loc
+    in_shard = (seed_ids >= lo) & (seed_ids < lo + v_loc)
+    local_seeds = jnp.where(in_shard, seed_ids - lo, -1)
+    safe_seeds = jnp.where(local_seeds >= 0, local_seeds, 0)
+    gathered_ids = rule_ids_loc[safe_seeds]  # (B, L, K)
+    gathered_confs = rule_confs_loc[safe_seeds]
+    valid = (gathered_ids >= 0) & (local_seeds >= 0)[..., None]
+    # per-shard top-k partial: the SAME epilogue as the replicated kernel
+    # over this shard's candidate lanes (global ids, global width)
+    part_ids, part_confs = _masked_topk_from_candidates(
+        jnp.where(valid, gathered_ids, -1).reshape(b, -1),
+        jnp.where(valid, gathered_confs, 0.0).reshape(b, -1),
+        v=v, k_best=k_best,
+    )
+    all_ids = jax.lax.all_gather(part_ids, axis)  # (S, B, k_best)
+    all_confs = jax.lax.all_gather(part_confs, axis)
+    # cross-shard max-merge: every shard's masked partial lanes become
+    # candidates for one more pass through the shared epilogue
+    return _masked_topk_from_candidates(
+        jnp.swapaxes(all_ids, 0, 1).reshape(b, n_shards * k_best),
+        jnp.swapaxes(all_confs, 0, 1).reshape(b, n_shards * k_best),
+        v=v, k_best=k_best,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_recommend_fn(mesh, k_best: int, axis: str = "shard"):
+    """The jitted sharded lookup for one (mesh, k_best) — cached so the
+    serving engine resolves it ONCE at bundle build (publication side) and
+    every dispatch reuses the same compiled program: rebuilding the
+    jit(shard_map(...)) closure per call would retrace on the hot path.
+
+    Contract: ``rule_ids``/``rule_confs`` laid out
+    ``NamedSharding(mesh, P(axis, None))`` with the padded vocab length a
+    multiple of the shard count; ``seed_ids`` replicated. Output
+    (replicated) is bit-identical to :func:`recommend_batch` on the same
+    (unpadded) tensors."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxcompat import shard_map
+
+    n_shards = mesh.shape[axis]
+    local = partial(
+        _sharded_recommend_local,
+        k_best=k_best, axis=axis, n_shards=n_shards,
+    )
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P(None, None)),
+            out_specs=(P(None, None), P(None, None)),
+            # the all_gather makes both outputs mesh-invariant; the scatter
+            # updates carry no vma annotation the checker could follow
+            check_vma=False,
+        )
+    )

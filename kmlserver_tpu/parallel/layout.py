@@ -1,0 +1,127 @@
+"""Model-layout resolution — replicated vs vocab-sharded tensors.
+
+PR 2 scaled *data* parallelism: every device holds a full replica of the
+rule (and embedding) tensors and the dispatcher spreads batches. That
+caps the servable catalog — and the minable input — at what ONE device
+can hold. The ``sharded`` layout is the model-parallel counterpart (the
+ALX recipe, PAPERS.md: matrix-shaped recommendation state partitioned
+across a TPU mesh with batched solves and collectives): the rule /
+consequent / score tensors shard along the VOCAB axis, lookups run as a
+sharded gather + per-shard top-k with a cross-device max-merge, and
+mining's one-hot / support counting shards the same axis so the encode
+and mine phases accept inputs the dense replicated path cannot.
+
+THE one copy of the layout decision, shared by the serving engine and
+the mining dispatch so the two sides can never resolve the same knobs
+differently:
+
+- ``KMLS_MODEL_LAYOUT=replicated`` — the legacy layout (default).
+- ``KMLS_MODEL_LAYOUT=sharded``    — force vocab sharding (needs > 1
+  local device; silently resolves to replicated on a single device —
+  there is nothing to shard across).
+- ``KMLS_MODEL_LAYOUT=auto``       — shard exactly when the measured
+  tensor bytes exceed ``KMLS_DEVICE_BUDGET_BYTES`` for one device
+  (Misam's framing, PAPERS.md: layout selection is a *measured*
+  decision, not a vibe) — small catalogs keep the replicated layout's
+  zero-collective dispatch, oversized ones transparently spread.
+"""
+
+from __future__ import annotations
+
+import logging
+
+LAYOUTS = ("replicated", "sharded", "auto")
+
+logger = logging.getLogger("kmlserver_tpu.layout")
+
+
+def validate_layout(layout: str) -> str:
+    """Normalize a layout knob value; an unrecognized spelling fails SAFE
+    to ``replicated`` (the legacy path) with a loud warning — a typo must
+    never silently enable cross-device collectives."""
+    word = (layout or "").strip().lower()
+    if word in LAYOUTS:
+        return word
+    logger.warning(
+        "KMLS_MODEL_LAYOUT=%r is not one of %s; using 'replicated'",
+        layout, "/".join(LAYOUTS),
+    )
+    return "replicated"
+
+
+def resolve_layout(
+    layout: str, tensor_bytes: int, budget_bytes: int, n_devices: int
+) -> str:
+    """→ ``"replicated"`` or ``"sharded"``, from the knob value, the
+    MEASURED model-tensor bytes, the per-device budget, and the devices
+    actually available. ``budget_bytes <= 0`` disables the auto trigger
+    (no budget: nothing measurable to exceed)."""
+    word = validate_layout(layout)
+    if n_devices <= 1:
+        if word == "sharded":
+            logger.warning(
+                "KMLS_MODEL_LAYOUT=sharded with a single device: "
+                "nothing to shard across — serving replicated"
+            )
+        return "replicated"
+    if word == "sharded":
+        return "sharded"
+    if word == "auto" and budget_bytes > 0 and tensor_bytes > budget_bytes:
+        logger.info(
+            "auto layout: model tensors (%d bytes) exceed the %d-byte "
+            "device budget — sharding across %d devices",
+            tensor_bytes, budget_bytes, n_devices,
+        )
+        return "sharded"
+    return "replicated"
+
+
+def mining_mesh(cfg, mesh):
+    """Apply the model-layout knob to the mining mesh: under the
+    ``sharded`` layout the vocab (``tp``) axis is the one that must span
+    devices, so a layout-sharded run with no mesh — or with the default
+    dp-major auto mesh — gets a vocab-major ``1xN`` mesh over the local
+    devices instead. Explicit ``DPxTP``/hybrid shapes (tp already > 1,
+    or a multi-host hybrid mesh) are respected as given. Idempotent —
+    the pipeline and the miner may both call it."""
+    import jax
+
+    from .mesh import AXIS_TP, make_mesh
+
+    word = validate_layout(getattr(cfg, "model_layout", "replicated"))
+    if word == "replicated":
+        return mesh
+    if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
+        return mesh  # already vocab-sharded (explicit shape or hybrid)
+    if mesh is not None and jax.process_count() > 1:
+        # multi-host: the hybrid DCN×ICI axis discipline (tp rides ICI)
+        # must stand — never rewrite a cross-host mesh onto the vocab
+        # axis, even under the sharded layout (a tp=1-per-host topology
+        # would put the block exchange on DCN)
+        return mesh
+    if word == "auto":
+        # auto never invents a mesh: mining's memory routing (bitpack
+        # dispatch, Apriori prune) already covers the oversized-input
+        # case, so auto only engages the sharded mining path when the
+        # operator's mesh already spans the vocab axis (handled above)
+        return mesh
+    devices = (
+        list(mesh.devices.flatten()) if mesh is not None
+        else jax.local_devices()
+    )
+    if len(devices) <= 1:
+        return mesh
+    return make_mesh((1, len(devices)), devices=devices)
+
+
+def wants_sharded_mining(cfg, mesh) -> bool:
+    """True when the miner should take the vocab-sharded count+emit path
+    for this (config, mesh): the mesh spans the vocab axis and the layout
+    knob is not pinned to replicated."""
+    from .mesh import AXIS_TP
+
+    if mesh is None or mesh.shape.get(AXIS_TP, 1) <= 1:
+        return False
+    return validate_layout(
+        getattr(cfg, "model_layout", "replicated")
+    ) != "replicated"

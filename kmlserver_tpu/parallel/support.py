@@ -239,17 +239,109 @@ def _sharded_counts_fn(mesh, impl, interpret, variant, swar):
     )
 
 
-def sharded_pair_counts(
+def _padded_sharded_counts(
     baskets: Baskets, mesh: Mesh, impl: str = "gspmd"
-) -> jax.Array:
-    """Pair-count matrix (V, V) int32, computed over the mesh. The result
-    keeps its ``P(None, 'tp')`` sharding; downstream rule emission is a
-    row/column-local threshold+top-k that composes under the same jit."""
+) -> tuple[jax.Array, int]:
+    """Pair counts over the mesh, still PADDED (``v_pad`` a multiple of
+    ``tp``) and still column-sharded ``P(None, 'tp')`` → ``(counts, v)``.
+    The sharded rule emission consumes the padded sharded matrix directly
+    (slicing would gather it); :func:`sharded_pair_counts` slices for
+    callers that want the plain (V, V) result."""
     if impl not in _IMPLS:
         raise ValueError(f"impl must be one of {sorted(_IMPLS)}, got {impl!r}")
     p_pad = round_up(max(baskets.n_playlists, 1), mesh.shape[AXIS_DP])
     v_pad = round_up(max(baskets.n_tracks, 1), mesh.shape[AXIS_TP])
     x = _onehot_padded(baskets, p_pad, v_pad, mesh)
     counts = _IMPLS[impl](mesh)(x) if impl != "gspmd" else _IMPLS[impl](mesh)(x, x)
-    v = baskets.n_tracks
+    return counts, baskets.n_tracks
+
+
+def sharded_pair_counts(
+    baskets: Baskets, mesh: Mesh, impl: str = "gspmd"
+) -> jax.Array:
+    """Pair-count matrix (V, V) int32, computed over the mesh. The result
+    keeps its ``P(None, 'tp')`` sharding; downstream rule emission is a
+    row/column-local threshold+top-k that composes under the same jit."""
+    counts, v = _padded_sharded_counts(baskets, mesh, impl)
     return counts[:v, :v]
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_emit_fn(mesh: Mesh, k_max: int):
+    """Vocab-sharded rule emission (the model-parallel layout's miner
+    half): each ``tp`` shard emits the rule rows for ITS slice of the
+    antecedent axis from its resident block of the count matrix — the
+    full (V, V) counts never exist on one device, which is what lets the
+    mine phase accept inputs the dense replicated path cannot hold.
+
+    The count matrix arrives column-sharded ``P(None, 'tp')`` (each shard
+    holds ``C[:, lo:hi]``); ``C = XᵀX`` is symmetric, so the transpose of
+    the local block IS the shard's row slab ``C[lo:hi, :]`` — no
+    collective needed between counting and emission. Per-row semantics
+    are exactly ``ops.rules.emit_rule_tensors`` (global-index diagonal
+    masking, threshold, top-k with lax.top_k's index tie order), so the
+    gathered tensors are bit-identical to the dense emission (pinned by
+    tests/test_shard_layout.py). Outputs come back row-sharded
+    ``P('tp', None)`` — the exact layout the sharded SERVING bundle
+    wants, one vocab axis end to end."""
+
+    def local(c_block: jax.Array, min_count: jax.Array):
+        rows = c_block.T  # (V_loc, v_pad) = C[lo:hi, :] by symmetry
+        v_loc, v_pad = rows.shape
+        lo = jax.lax.axis_index(AXIS_TP).astype(jnp.int32) * v_loc
+        row_ids = lo + jnp.arange(v_loc, dtype=jnp.int32)[:, None]
+        col_ids = jnp.arange(v_pad, dtype=jnp.int32)[None, :]
+        valid = (col_ids != row_ids) & (rows >= min_count)
+        row_valid = valid.sum(axis=1, dtype=jnp.int32)
+        score = jnp.where(valid, rows, -1)
+        k = min(k_max, v_pad)
+        top_counts, top_ids = jax.lax.top_k(score, k)
+        keep = top_counts > 0
+        rule_ids = jnp.where(keep, top_ids, -1).astype(jnp.int32)
+        rule_counts = jnp.where(keep, top_counts, 0)
+        if k < k_max:  # static pad up to the declared row capacity
+            pad = ((0, 0), (0, k_max - k))
+            rule_ids = jnp.pad(rule_ids, pad, constant_values=-1)
+            rule_counts = jnp.pad(rule_counts, pad)
+        # the slab's diagonal — element (r, lo + r) — = singleton supports
+        item_counts = jnp.take_along_axis(rows, row_ids, axis=1)[:, 0]
+        return rule_ids, rule_counts, row_valid, item_counts
+
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, AXIS_TP), P()),
+            out_specs=(
+                P(AXIS_TP, None), P(AXIS_TP, None), P(AXIS_TP), P(AXIS_TP)
+            ),
+            # outputs are per-shard slabs of dp-invariant data; the
+            # transpose/top_k chain carries no vma annotation to check
+            check_vma=False,
+        )
+    )
+
+
+def sharded_rule_tensors(
+    baskets: Baskets,
+    mesh: Mesh,
+    min_count: int,
+    k_max: int,
+    impl: str = "gspmd",
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The vocab-sharded count→emit mining core
+    (``KMLS_MODEL_LAYOUT=sharded``): one-hot sharded ``P('dp','tp')``,
+    counts sharded ``P(None,'tp')``, emission per row shard — only the
+    (V, K_max) rule tensors (K_max ≪ V) ever reach one host. Returns
+    host ``(rule_ids, rule_counts, row_valid, item_counts)`` sliced to
+    the true vocab, bit-identical to the dense single-device emission."""
+    import numpy as _np
+
+    counts, v = _padded_sharded_counts(baskets, mesh, impl)
+    emitted = _sharded_emit_fn(mesh, k_max)(counts, jnp.int32(min_count))
+    rule_ids, rule_counts, row_valid, item_counts = jax.device_get(emitted)
+    return (
+        _np.asarray(rule_ids[:v]),
+        _np.asarray(rule_counts[:v]),
+        _np.asarray(row_valid[:v]),
+        _np.asarray(item_counts[:v]),
+    )

@@ -191,6 +191,9 @@ class RecommendApp:
                         self.engine, "dispatch_counts", None
                     ),
                     robustness=self._robustness_state(),
+                    shard_counts=getattr(
+                        self.engine, "shard_dispatch_counts", None
+                    ),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -218,6 +221,10 @@ class RecommendApp:
             "embedding_load_failures_total": getattr(
                 self.engine, "embedding_load_failures", 0
             ),
+            # model layout: how many vocab shards the published bundle
+            # spans (1 = replicated — a dashboard can alert on a fleet
+            # unexpectedly flipping layout after a publication)
+            "model_shards": getattr(self.engine, "n_shards", 1),
         }
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         state["replicas_ejected"] = (

@@ -37,6 +37,21 @@ this to run concurrent batches on different devices instead of
 serializing them on one in-order execution queue. ``bundle_epoch`` is the
 monotonic publication counter the recommendation cache keys on.
 
+Model-parallel serving (``KMLS_MODEL_LAYOUT=sharded|auto``): instead of
+one full replica per device, a publication can build ONE logical bundle
+whose rule tensors are vocab-sharded across every serving device
+(``NamedSharding``; ``ops/serve.py sharded_recommend_fn``) — per-device
+HBM holds ``V/S`` rule rows, so the servable catalog scales with the
+mesh rather than capping at a single device. ``auto`` measures the
+loaded tensor bytes against ``KMLS_DEVICE_BUDGET_BYTES`` and shards only
+when a replica would not fit (parallel/layout.py is the one copy of
+that decision, shared with the mining side). The sharded bundle presents
+as one replica to the dispatcher, pre-warms its kernel over the same
+(batch, length) bucket grid — zero compiles post-publish, same contract
+— and answers bit-identically to the replicated layout (pinned by
+tests/test_shard_layout.py). Per-vocab-shard seed-hit counters render as
+``kmls_shard_dispatch_total`` in ``/metrics``.
+
 Hybrid serving (the second model family): when the mining job published
 an ``embeddings.npz`` (ALS item factors, ``mining/als.py``), every
 replica also carries the factor matrix on its device and each batch
@@ -151,6 +166,24 @@ class RuleBundle:
     # accelerator backends — their lookups stay on the device.
     host_rule_ids: np.ndarray | None = None
     host_rule_confs: np.ndarray | None = None
+    # ---- model layout (KMLS_MODEL_LAYOUT, parallel/layout.py) ----
+    # "replicated": this bundle is one full-tensor replica on `device`.
+    # "sharded": ONE logical bundle whose rule tensors are vocab-sharded
+    # across `mesh` (NamedSharding, P("shard", None)); the replica set is
+    # exactly [this] and dispatch runs the sharded kernel below.
+    layout: str = "replicated"
+    mesh: object = None  # jax.sharding.Mesh spanning the serve devices
+    n_shards: int = 1
+    # padded per-shard vocab rows (v_pad / n_shards) — the divisor the
+    # per-shard dispatch counters bucket seed ids by
+    shard_size: int = 0
+    # the jitted shard_map lookup bound to (mesh, k_best), resolved at
+    # BUILD time (ops.serve.sharded_recommend_fn is lru-cached) so the
+    # dispatch path never constructs a jit closure
+    shard_kernel: object = None
+    # replicated NamedSharding over `mesh` — the placement target for
+    # staged seed batches (replicated layout uses `device` instead)
+    seed_sharding: object = None
     # ---- second model family (hybrid rule∪embedding serving) ----
     # ALS item factors on this replica's device (f32 (V_emb, rank), rows
     # L2-normalized) with their OWN vocabulary — the embedding id space is
@@ -184,6 +217,11 @@ class RecommendEngine:
         # cumulative per-replica dispatch counters (Prometheus-monotonic:
         # they survive hot swaps), index-aligned with `replicas`
         self.dispatch_counts: list[int] = []
+        # sharded layout: cumulative seed ids dispatched per vocab shard
+        # (the load-balance signal — which shard's rows the traffic
+        # actually hits), rendered as kmls_shard_dispatch_total in
+        # /metrics; empty in replicated layout
+        self.shard_dispatch_counts: list[int] = []
         self._dispatch_lock = threading.Lock()
         self.best_tracks: list[dict] | None = None
         self.cache_value: str | None = None  # the reference's app.cache_value
@@ -345,9 +383,11 @@ class RecommendEngine:
             self._backoff_until = 0.0
             logger.info(
                 "reload #%d complete (epoch %d): %d tracks, %d rule keys, "
-                "%d replica(s), embeddings %s, token %r",
+                "%d replica(s), layout %s (%d shard(s)), embeddings %s, "
+                "token %r",
                 self.reload_counter, epoch, len(replicas[0].vocab),
                 int(replicas[0].known_mask.sum()), len(replicas),
+                self.model_layout, self.n_shards,
                 (
                     f"on ({len(replicas[0].emb_vocab)} tracks)"
                     if replicas[0].emb_factors is not None else "off"
@@ -566,6 +606,26 @@ class RecommendEngine:
             )
         index = {n: i for i, n in enumerate(vocab)}
         known_mask = np.asarray(known)
+        devs = self._serve_devices()
+        # layout decision (parallel/layout.py, the one shared copy):
+        # MEASURED rule-tensor bytes vs the per-device budget. A sharded
+        # resolution builds ONE logical bundle spanning every serve
+        # device instead of a replica per device.
+        from ..parallel.layout import resolve_layout
+
+        layout = resolve_layout(
+            self.cfg.model_layout,
+            int(rule_ids.nbytes + rule_confs.nbytes),
+            self.cfg.device_budget_bytes,
+            len(devs),
+        )
+        if layout == "sharded" and len(vocab) > 0:
+            return [
+                self._build_sharded_bundle(
+                    vocab, index, known_mask, rule_ids, rule_confs,
+                    token, devs,
+                )
+            ]
         if self._use_native_serve():
             # rule rows are trailing-padded (emission writes the top-k
             # descending, then -1 fill) — the native kernel's early-break
@@ -591,19 +651,72 @@ class RecommendEngine:
                 known_mask=known_mask, model_token=token,
                 device=dev,
             )
-            for dev in self._serve_devices()
+            for dev in devs
         ]
+
+    def _build_sharded_bundle(
+        self, vocab, index, known_mask, rule_ids, rule_confs, token, devs
+    ) -> RuleBundle:
+        """ONE logical bundle whose rule tensors are vocab-sharded across
+        ``devs`` (``NamedSharding(mesh, P("shard", None))``): per-device
+        HBM holds ``V/S`` rule rows, so a catalog exceeding one device's
+        budget serves as long as the MESH can hold it. The antecedent
+        axis is padded to a multiple of the shard count with empty rows
+        (-1 ids / 0 confs — unreachable: seed ids are always < V), and
+        the lookup kernel is resolved here, at build time, so dispatch
+        never constructs a jit closure (hot-path purity)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import Mesh as JaxMesh
+
+        from ..ops.serve import sharded_recommend_fn
+
+        n = len(devs)
+        mesh = JaxMesh(np.asarray(devs), ("shard",))
+        v, k = rule_ids.shape
+        v_pad = ((v + n - 1) // n) * n
+        ids = np.full((v_pad, k), -1, dtype=np.int32)
+        confs = np.zeros((v_pad, k), dtype=np.float32)
+        ids[:v] = rule_ids
+        confs[:v] = rule_confs
+        row_spec = NamedSharding(mesh, PartitionSpec("shard", None))
+        bundle = RuleBundle(
+            vocab=vocab, index=index,
+            rule_ids=jax.device_put(ids, row_spec),
+            rule_confs=jax.device_put(confs, row_spec),
+            known_mask=known_mask, model_token=token,
+            device=None, layout="sharded", mesh=mesh, n_shards=n,
+            shard_size=v_pad // n,
+            shard_kernel=sharded_recommend_fn(
+                mesh, self.cfg.k_best_tracks
+            ),
+            seed_sharding=NamedSharding(mesh, PartitionSpec(None, None)),
+        )
+        logger.info(
+            "sharded layout: %d rule rows (+%d pad) across %d shards "
+            "(%d rows, ~%.1f MiB of rule tensors per device)",
+            v, v_pad - v, n, v_pad // n,
+            (ids.nbytes + confs.nbytes) / n / (1 << 20),
+        )
+        return bundle
 
     def _serve_devices(self) -> list:
         """The local devices the replica set spans. ``serve_devices == 0``
         (auto) replicates onto every local device on accelerator backends;
         on CPU it stays at one — virtual CPU devices share the same host
         cores, so extra replicas there only multiply warmup compiles unless
-        an operator (or a test) opts in via KMLS_SERVE_DEVICES."""
+        an operator (or a test) opts in via KMLS_SERVE_DEVICES. Exception:
+        an EXPLICIT ``KMLS_MODEL_LAYOUT=sharded`` spans every local device
+        even on CPU — the operator asked for vocab sharding, and one
+        device has nothing to shard across."""
+        from ..parallel.layout import validate_layout
+
         devs = jax.local_devices()
         n = self.cfg.serve_devices
         if n <= 0:
-            n = 1 if jax.default_backend() == "cpu" else len(devs)
+            if validate_layout(self.cfg.model_layout) == "sharded":
+                n = len(devs)
+            else:
+                n = 1 if jax.default_backend() == "cpu" else len(devs)
         return devs[: max(1, min(n, len(devs)))]
 
     @property
@@ -617,6 +730,26 @@ class RecommendEngine:
             while len(self.dispatch_counts) <= idx:
                 self.dispatch_counts.append(0)
             self.dispatch_counts[idx] += 1
+
+    def _note_shard_dispatch(self, per_shard) -> None:
+        with self._dispatch_lock:
+            while len(self.shard_dispatch_counts) < len(per_shard):
+                self.shard_dispatch_counts.append(0)
+            for i, count in enumerate(per_shard):
+                self.shard_dispatch_counts[i] += int(count)
+
+    @property
+    def model_layout(self) -> str:
+        """The layout of the PUBLISHED bundle ("replicated" before the
+        first load — there is nothing sharded to describe yet)."""
+        bundle = self.bundle
+        return bundle.layout if bundle is not None else "replicated"
+
+    @property
+    def n_shards(self) -> int:
+        """Vocab shards in the published bundle (1 = replicated)."""
+        bundle = self.bundle
+        return bundle.n_shards if bundle is not None else 1
 
     def _use_native_serve(self) -> bool:
         """Native host kernel iff the backend is CPU (an accelerator's
@@ -652,23 +785,41 @@ class RecommendEngine:
         warm_emb = bundle.emb_factors is not None
         if not warm_rules and not warm_emb:
             return  # native host kernel, no embeddings: nothing compiles
-        kernel = self._resolve_kernel() if warm_rules else None
+        # sharded layout warms ITS kernel (per-shard lookup + cross-device
+        # max-merge) over the same bucket grid — every sharded bucket is
+        # compiled before publication, same zero-compile contract
+        kernel = (
+            (bundle.shard_kernel or self._resolve_kernel())
+            if warm_rules else None
+        )
         for length in self._len_buckets():
             for batch in self._batch_buckets():
                 seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
-                if bundle.device is not None:
-                    # commit the seeds to the replica's device so the
+                target = bundle.seed_sharding or bundle.device
+                rule_seeds = seeds
+                if target is not None:
+                    # commit the seeds to the replica's device (or, in
+                    # sharded layout, replicate them over the mesh) so the
                     # warmed executable is the one its dispatches will hit
-                    seeds = jax.device_put(seeds, bundle.device)
+                    rule_seeds = jax.device_put(seeds, target)
                 if warm_rules:
                     jax.block_until_ready(
-                        kernel(bundle.rule_ids, bundle.rule_confs, seeds)
+                        kernel(bundle.rule_ids, bundle.rule_confs, rule_seeds)
                     )
                     bundle.warmed_shapes.add((batch, length))
                 if warm_emb:
+                    # the embedding kernel dispatches with _dispatch_embed's
+                    # placement (bundle.device; default placement in the
+                    # sharded layout, where only the RULE tensors span the
+                    # mesh) — warm with the same placement, or the warmed
+                    # executable would not be the dispatched one
+                    emb_seeds = (
+                        jax.device_put(seeds, bundle.device)
+                        if bundle.device is not None else seeds
+                    )
                     jax.block_until_ready(
                         embed_topk(
-                            bundle.emb_factors, seeds,
+                            bundle.emb_factors, emb_seeds,
                             k_best=self.cfg.k_best_tracks,
                         )
                     )
@@ -786,7 +937,18 @@ class RecommendEngine:
             else:
                 arr = np.full(shape, -1, dtype=np.int32)
             known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
-            seeds_dev = jax.device_put(arr, bundle.device)
+            if bundle.n_shards > 1 and bundle.shard_size > 0:
+                # per-shard dispatch accounting: which vocab shard's rows
+                # this batch's seed ids actually hit (host integer math on
+                # the already-staged buffer — no device sync)
+                hit = arr[arr >= 0]
+                if hit.size:
+                    self._note_shard_dispatch(np.bincount(
+                        hit // bundle.shard_size, minlength=bundle.n_shards
+                    ))
+            seeds_dev = jax.device_put(
+                arr, bundle.seed_sharding or bundle.device
+            )
         if shape not in bundle.warmed_shapes:
             # a compile is landing on the serving path — count it loudly
             self.unwarmed_dispatches += 1
@@ -927,9 +1089,9 @@ class RecommendEngine:
             else:
                 length = self._bucket_len(len(known_ids))
                 seeds_dev, _ = self._stage_seeds(bundle, [seed_tracks], 1, length)
-                top_ids, top_confs = self._resolve_kernel()(
-                    bundle.rule_ids, bundle.rule_confs, seeds_dev
-                )
+                top_ids, top_confs = (
+                    bundle.shard_kernel or self._resolve_kernel()
+                )(bundle.rule_ids, bundle.rule_confs, seeds_dev)
                 ids = np.asarray(top_ids[0])
                 confs = np.asarray(top_confs[0])
         self._note_dispatch(0)
@@ -1037,7 +1199,10 @@ class RecommendEngine:
         seeds_dev, known_rows = self._stage_seeds(
             bundle, seed_sets, n_rows, length
         )
-        top_ids, top_confs = self._resolve_kernel()(
+        # sharded layout dispatches the vocab-sharded lookup (per-shard
+        # gather/top-k + cross-device max-merge) resolved at publication;
+        # replicated keeps the per-replica kernel
+        top_ids, top_confs = (bundle.shard_kernel or self._resolve_kernel())(
             bundle.rule_ids, bundle.rule_confs, seeds_dev
         )
         # second model family: the embedding lookup dispatches alongside

@@ -142,13 +142,16 @@ class ServingMetrics:
     def render(
         self, reload_counter: int, finished_loading: bool,
         cache=None, dispatch_counts=None, robustness=None,
+        shard_counts=None,
     ) -> str:
         """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
-        ``dispatch_counts`` (the engine's per-replica dispatch counters)
-        and ``robustness`` (a flat dict of engine/batcher recovery-state
+        ``dispatch_counts`` (the engine's per-replica dispatch counters),
+        ``robustness`` (a flat dict of engine/batcher recovery-state
         values — names ending in ``_total`` render as counters, the rest
-        as gauges, all under a ``kmls_`` prefix) are optional —
-        deployments without them render exactly the old exposition."""
+        as gauges, all under a ``kmls_`` prefix) and ``shard_counts``
+        (per-vocab-shard seed-hit counters, present only under the
+        sharded model layout) are optional — deployments without them
+        render exactly the old exposition."""
         p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)
         uptime = time.time() - self.started_at
         lines = [
@@ -197,6 +200,15 @@ class ServingMetrics:
             lines += [
                 f'kmls_device_dispatch_total{{device="{i}"}} {count}'
                 for i, count in enumerate(dispatch_counts)
+            ]
+        if shard_counts:
+            # sharded model layout: seed ids dispatched per vocab shard —
+            # the load-balance evidence for the model-parallel lookup
+            # (which shard's rule rows the traffic actually hits)
+            lines.append("# TYPE kmls_shard_dispatch_total counter")
+            lines += [
+                f'kmls_shard_dispatch_total{{shard="{i}"}} {count}'
+                for i, count in enumerate(shard_counts)
             ]
         # fault-tolerance exposition: degraded answers by reason + the
         # circuit breaker's eject/readmit/redispatch counters — always
