@@ -113,6 +113,11 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_BATCH_MAX_INFLIGHT": "serving",
     "KMLS_SHED_QUEUE_BUDGET_MS": "serving",
     "KMLS_SHED_RETRY_AFTER_S": "serving",
+    # adaptive admission ladder (ISSUE 8): degrade band start, hard-shed
+    # band end, and the bounded Retry-After jitter fraction
+    "KMLS_SHED_SOFT_RATIO": "serving",
+    "KMLS_SHED_HARD_RATIO": "serving",
+    "KMLS_SHED_RETRY_JITTER": "serving",
     "KMLS_SERVE_DEVICES": "serving",
     "KMLS_CACHE_ENABLED": "serving",
     "KMLS_CACHE_MAX_ENTRIES": "serving",
@@ -205,6 +210,12 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_BENCH_CHAOS_REQUESTS": "tool",
     "KMLS_BENCH_CHAOS_ZIPF_S": "tool",
     "KMLS_BENCH_RESUME_PHASE": "tool",
+    # traffic-shape replay (ISSUE 8): shape selector for the replay CLI
+    # and the loadshape bench bracket's base rate / volume / burst factor
+    "KMLS_REPLAY_SHAPE": "tool",
+    "KMLS_BENCH_LOADSHAPE_QPS": "tool",
+    "KMLS_BENCH_LOADSHAPE_REQUESTS": "tool",
+    "KMLS_BENCH_LOADSHAPE_BURST": "tool",
     "KMLS_SWEEP_START": "tool",
     "KMLS_SWEEP_STOP": "tool",
     "KMLS_SWEEP_STEP": "tool",
@@ -482,13 +493,31 @@ class ServingConfig:
     # batches — measured 896 vs 1000+ QPS through the 65 ms-RTT tunnel
     # model at 0.2 ms.
     batch_window_min_ms: float = 1.0
-    # Load shedding: when the PROJECTED queue wait for a new request
-    # exceeds this budget (milliseconds), reject it up front with HTTP 429
-    # + Retry-After instead of letting it rot in the queue (backpressure
-    # made visible, not a silent p99 cliff). 0 disables shedding.
+    # Load shedding: when the EFFECTIVE queue wait for a new request
+    # (max of the instantaneous projection and the measured queue-wait
+    # EWMA) exceeds this budget (milliseconds), the request is shed with
+    # HTTP 429 + Retry-After instead of rotting in the queue
+    # (backpressure made visible, not a silent p99 cliff). 0 disables
+    # admission control entirely.
     shed_queue_budget_ms: float = 250.0
-    # Retry-After hint (seconds) returned with a 429 shed.
+    # Retry-After hint (seconds) returned with a 429 shed — the BASE
+    # value; the controller jitters it (see shed_retry_jitter).
     shed_retry_after_s: float = 1.0
+    # Adaptive admission ladder (ISSUE 8): pressure = effective queue
+    # wait / budget. Below soft_ratio every request is admitted at full
+    # quality; between soft_ratio and 1.0 a rising fraction of cache
+    # MISSES degrades to the popularity fallback (200 + X-KMLS-Degraded:
+    # overload — hits are untouched); between 1.0 and hard_ratio a
+    # rising fraction sheds (429) and the rest degrades; past hard_ratio
+    # everything sheds. soft_ratio=1 + hard_ratio=1 restores the legacy
+    # cliff-at-the-budget behavior.
+    shed_soft_ratio: float = 0.6
+    shed_hard_ratio: float = 1.5
+    # Bounded Retry-After jitter: the 429 header carries a value uniform
+    # on base*(1 ± this fraction). A constant Retry-After re-synchronizes
+    # every shed client into one retry wave exactly one hint later — the
+    # storm the shed was supposed to absorb. 0 restores the constant.
+    shed_retry_jitter: float = 0.5
     # Device-call pipeline depth PER REPLICA: batches dispatched but not yet
     # completed. >1 overlaps the next batch's dispatch with the previous
     # transfer — essential when the host<->device link is high-latency
@@ -619,6 +648,9 @@ class ServingConfig:
             batch_window_min_ms=_getenv_float("KMLS_BATCH_WINDOW_MIN_MS", 1.0),
             shed_queue_budget_ms=_getenv_float("KMLS_SHED_QUEUE_BUDGET_MS", 250.0),
             shed_retry_after_s=_getenv_float("KMLS_SHED_RETRY_AFTER_S", 1.0),
+            shed_soft_ratio=_getenv_float("KMLS_SHED_SOFT_RATIO", 0.6),
+            shed_hard_ratio=_getenv_float("KMLS_SHED_HARD_RATIO", 1.5),
+            shed_retry_jitter=_getenv_float("KMLS_SHED_RETRY_JITTER", 0.5),
             batch_max_inflight=_getenv_int("KMLS_BATCH_MAX_INFLIGHT", 4),
             serve_devices=_getenv_int("KMLS_SERVE_DEVICES", 0),
             model_layout=_getenv_model_layout(),

@@ -358,6 +358,9 @@ async def run_async(app: RecommendApp, port: int, ready=None) -> int:
             window_min_ms=cfg.batch_window_min_ms,
             shed_queue_budget_ms=cfg.shed_queue_budget_ms,
             shed_retry_after_s=cfg.shed_retry_after_s,
+            shed_soft_ratio=cfg.shed_soft_ratio,
+            shed_hard_ratio=cfg.shed_hard_ratio,
+            shed_retry_jitter=cfg.shed_retry_jitter,
             eject_threshold=cfg.replica_eject_threshold,
             probe_interval_s=cfg.replica_probe_interval_s,
             redispatch_max=cfg.redispatch_max_retries,

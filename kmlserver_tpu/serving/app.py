@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import random
 import threading
@@ -33,7 +34,12 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config import ServingConfig
-from .batcher import DeadlineExceeded, NoHealthyReplicas, Overloaded
+from .batcher import (
+    DeadlineExceeded,
+    NoHealthyReplicas,
+    Overloaded,
+    OverloadDegraded,
+)
 from .cache import RecommendCache
 from .engine import RecommendEngine
 from .metrics import ServingMetrics
@@ -104,6 +110,9 @@ class RecommendApp:
                 window_min_ms=cfg.batch_window_min_ms,
                 shed_queue_budget_ms=cfg.shed_queue_budget_ms,
                 shed_retry_after_s=cfg.shed_retry_after_s,
+                shed_soft_ratio=cfg.shed_soft_ratio,
+                shed_hard_ratio=cfg.shed_hard_ratio,
+                shed_retry_jitter=cfg.shed_retry_jitter,
                 eject_threshold=cfg.replica_eject_threshold,
                 probe_interval_s=cfg.replica_probe_interval_s,
                 redispatch_max=cfg.redispatch_max_retries,
@@ -230,6 +239,19 @@ class RecommendApp:
         state["replicas_ejected"] = (
             len(ejected_fn()) if callable(ejected_fn) else 0
         )
+        # the autoscaling signal (ISSUE 8): kmls_utilization is what
+        # kubernetes/hpa.yaml scales the fleet on — max of pipeline
+        # occupancy and admission queue pressure, 1.0 = at capacity.
+        # Always present (0.0 without a batcher) so the HPA's metric
+        # query never comes back empty on an idle pod.
+        util_fn = getattr(self.batcher, "utilization", None)
+        state["utilization"] = (
+            round(util_fn(), 4) if callable(util_fn) else 0.0
+        )
+        # overload-degrade admissions (the ladder rung before any 429)
+        state["admission_degrade_total"] = getattr(
+            self.batcher, "degrade_total", 0
+        )
         return state
 
     _STATIC_TYPES = {
@@ -298,11 +320,15 @@ class RecommendApp:
     @staticmethod
     def _degrade_reason(exc: Exception) -> str | None:
         """Exceptions that degrade to a fallback answer instead of an
-        error status: deadline exhaustion and total replica loss."""
+        error status: deadline exhaustion, total replica loss, and the
+        admission controller's degrade band (the ladder rung BEFORE any
+        429 — overload costs answer quality first, availability never)."""
         if isinstance(exc, DeadlineExceeded):
             return "deadline"
         if isinstance(exc, NoHealthyReplicas):
             return "replica-loss"
+        if isinstance(exc, OverloadDegraded):
+            return "overload"
         return None
 
     def _degraded_response(
@@ -363,7 +389,13 @@ class RecommendApp:
                 {"detail": "overloaded: projected queue wait "
                            f"{exc.projected_wait_ms:.0f}ms exceeds budget"},
             )
-            headers["Retry-After"] = f"{max(exc.retry_after_s, 0.0):.0f}"
+            # RFC 9110 delay-seconds is a non-negative INTEGER — a decimal
+            # here crashes urllib3's Retry.parse_retry_after (the requests
+            # default). ceil keeps the batcher's sub-second jitter
+            # (KMLS_SHED_RETRY_JITTER) meaningful: uniform base·(1 ± j)
+            # ceils to a spread across adjacent whole seconds instead of
+            # rounding every draw back to the same synchronized value
+            headers["Retry-After"] = str(math.ceil(max(exc.retry_after_s, 0.0)))
             return status, headers, payload
         logger.error("recommendation failed", exc_info=exc)
         self.metrics.record_error()

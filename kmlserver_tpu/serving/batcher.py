@@ -36,11 +36,26 @@ QPS with the fixed 2 ms window):
   amortize at high rates; the controller tracks the traffic instead. The
   wait is additionally capped so the batch LEADER's queue wait can never
   cross the shed budget — the deadline-aware part.
-- **Load shedding**: when the projected queue wait for a NEW request
-  (batches ahead x device-time EWMA) exceeds ``shed_queue_budget_ms``, the
-  request is rejected up front with :class:`Overloaded` (HTTP 429 +
-  ``Retry-After`` at the app layer). Backpressure becomes a visible,
-  retryable signal instead of a silent p99 cliff.
+- **Adaptive admission control** (ISSUE 8 — replaces the static
+  cliff-edge shed): an :class:`AdmissionController` tracks PRESSURE =
+  effective queue wait / ``shed_queue_budget_ms``, where the effective
+  wait is the max of the instantaneous projection (batches ahead ×
+  device-time EWMA) and a time-decaying EWMA of the queue waits admitted
+  requests actually measured (the projection alone undershoots when
+  batches run larger than estimated; the measured EWMA alone would hold
+  stale overload after a burst drains, so it decays with a half-life of
+  one budget). Admission escalates through a LADDER instead of flipping
+  at the threshold: below ``soft_ratio`` every request is admitted at
+  full quality; between ``soft_ratio`` and 1.0 a rising fraction of
+  requests degrades (:class:`OverloadDegraded` → the app answers from
+  the popularity fallback, 200 + ``X-KMLS-Degraded: overload`` — cache
+  hits are untouched, so the cache-favored rung costs only the
+  compute-needing tail); between 1.0 and ``hard_ratio`` a rising
+  fraction sheds (:class:`Overloaded` → HTTP 429) and the rest still
+  degrades; past ``hard_ratio`` everything sheds. ``Retry-After``
+  carries bounded jitter (± ``retry_jitter`` of the base) — a constant
+  value synchronizes every shed client into the next retry storm.
+  ``soft_ratio=hard_ratio=1.0`` reproduces the legacy cliff exactly.
 
 Per-request enqueue/dispatch/complete timestamps are threaded through and
 reported to :class:`~.metrics.ServingMetrics` as ``queue_wait`` /
@@ -90,7 +105,9 @@ import collections
 import dataclasses
 import itertools
 import logging
+import math
 import queue
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -108,7 +125,9 @@ _EWMA_ALPHA = 0.2
 
 class Overloaded(RuntimeError):
     """Raised by :meth:`MicroBatcher.recommend` instead of enqueueing when
-    the projected queue wait exceeds the shedding budget."""
+    admission pressure says this request would outwait the shed budget.
+    ``retry_after_s`` carries the controller's jitter — the HTTP layer
+    forwards it verbatim so shed clients don't re-arrive in lockstep."""
 
     def __init__(self, retry_after_s: float, projected_wait_ms: float):
         super().__init__(
@@ -117,6 +136,134 @@ class Overloaded(RuntimeError):
         )
         self.retry_after_s = retry_after_s
         self.projected_wait_ms = projected_wait_ms
+
+
+class OverloadDegraded(RuntimeError):
+    """Admission pressure is in the controller's degrade band: instead of
+    queueing (or 429ing) this request, answer it from the popularity
+    fallback — the HTTP layer maps this to 200 + ``X-KMLS-Degraded:
+    overload``, one rung BEFORE any 429. Cache hits never reach admission
+    (the cache sits in front), so under rising pressure cached answers
+    keep full quality and only the compute-needing tail degrades."""
+
+    def __init__(self, pressure: float):
+        super().__init__(
+            f"admission pressure {pressure:.2f} in the degrade band; "
+            "answering from the popularity fallback"
+        )
+        self.pressure = pressure
+
+
+class AdmissionController:
+    """Pressure-proportional admission: admit → degrade → shed.
+
+    Pressure is the effective queue wait over the shed budget. Effective
+    wait = max(instantaneous projection, measured queue-wait EWMA with
+    time decay). Decision bands (ratios of the budget):
+
+    - ``p < soft_ratio``            → admit
+    - ``soft_ratio <= p < 1``       → degrade with prob (p-soft)/(1-soft)
+    - ``1 <= p < hard_ratio``       → shed with prob (p-1)/(hard-1),
+                                      degrade otherwise
+    - ``p >= hard_ratio``           → shed
+
+    ``soft_ratio >= 1`` disables the degrade band and ``hard_ratio <= 1``
+    makes the shed band a cliff at the budget — together they restore the
+    pre-controller DECISION ladder (admit below the budget, shed above,
+    nothing in between). The pressure ESTIMATE is still the new one:
+    effective wait includes the measured queue-wait EWMA, so a cliff-mode
+    controller can keep shedding for ~one decay half-life after a burst
+    the projection alone would already have forgotten.
+
+    All state is plain floats — single-writer per field (the completion
+    side notes queue waits, admission only reads), and a stale read costs
+    at most one request landing a band early/late, the same benign-race
+    budget the batcher's in-flight counters already run on. No locks, so
+    the loop-confined async twin shares the class unchanged.
+    """
+
+    def __init__(
+        self,
+        budget_s: float,
+        *,
+        soft_ratio: float = 0.6,
+        hard_ratio: float = 1.5,
+        retry_after_s: float = 1.0,
+        retry_jitter: float = 0.5,
+        rng: random.Random | None = None,
+    ):
+        self.budget_s = budget_s
+        self.soft_ratio = max(0.0, soft_ratio)
+        self.hard_ratio = max(self.soft_ratio, hard_ratio, 1.0)
+        self.retry_after_s = retry_after_s
+        self.retry_jitter = min(max(retry_jitter, 0.0), 1.0)
+        self._rng = rng or random.Random()
+        self._wait_ewma: float | None = None
+        self._wait_noted_at = 0.0
+        # decay half-life: one budget width (floored so a sub-ms budget
+        # doesn't make the memory vanish between completions)
+        self._half_life_s = max(budget_s, 0.25)
+
+    def note_queue_wait(self, wait_s: float, now: float | None = None) -> None:
+        """Completion-side: fold an admitted request's MEASURED queue wait
+        into the EWMA (the projection's ground truth)."""
+        now = time.perf_counter() if now is None else now
+        # first sample adopted outright (the device-time EWMA does the
+        # same): a cold controller must not spend ~10 batches warming up
+        # while an overload is already measurable
+        self._wait_ewma = (
+            wait_s if self._wait_ewma is None
+            else (1 - _EWMA_ALPHA) * self._decayed_wait(now)
+            + _EWMA_ALPHA * wait_s
+        )
+        self._wait_noted_at = now
+
+    def _decayed_wait(self, now: float) -> float:
+        """The EWMA, decayed by the time since the last completion noted a
+        sample — a burst's high waits must not keep degrading traffic
+        after the queue has drained (completions stop, so only time can
+        bring the estimate back down)."""
+        if self._wait_ewma is None or self._wait_ewma <= 0.0:
+            return 0.0
+        age = max(now - self._wait_noted_at, 0.0)
+        return self._wait_ewma * math.exp(-age * math.log(2) / self._half_life_s)
+
+    def pressure(self, projected_s: float, now: float | None = None) -> float:
+        """Effective queue wait over the budget (0 with shedding off)."""
+        if self.budget_s <= 0.0:
+            return 0.0
+        now = time.perf_counter() if now is None else now
+        return max(projected_s, self._decayed_wait(now)) / self.budget_s
+
+    def decide(self, projected_s: float) -> tuple[str, float]:
+        """→ ``(decision, pressure)`` for a request seeing ``projected_s``
+        of projected queue wait right now; decision is ``"admit"`` |
+        ``"degrade"`` | ``"shed"``. The pressure that drove the decision
+        rides along so callers report the value the band was judged on
+        (re-computing it would both double the hot-path work and skew —
+        the EWMA decays between calls)."""
+        p = self.pressure(projected_s)
+        if p < self.soft_ratio:
+            return "admit", p
+        if p < 1.0:
+            span = 1.0 - self.soft_ratio
+            frac = (p - self.soft_ratio) / span if span > 0 else 1.0
+            return ("degrade" if self._rng.random() < frac else "admit"), p
+        if p < self.hard_ratio:
+            span = self.hard_ratio - 1.0
+            frac = (p - 1.0) / span if span > 0 else 1.0
+            return ("shed" if self._rng.random() < frac else "degrade"), p
+        return "shed", p
+
+    def retry_after_jittered_s(self) -> float:
+        """Retry-After with bounded jitter: uniform on
+        ``base·(1 ± retry_jitter)``, floored at 100 ms. A constant value
+        re-synchronizes every shed client into one retry wave exactly one
+        Retry-After later — the storm the shed was absorbing."""
+        if self.retry_jitter <= 0.0:
+            return self.retry_after_s
+        spread = 1.0 + self.retry_jitter * (2.0 * self._rng.random() - 1.0)
+        return max(self.retry_after_s * spread, 0.1)
 
 
 class DeadlineExceeded(RuntimeError):
@@ -155,6 +302,9 @@ class MicroBatcher:
         window_min_ms: float = 1.0,
         shed_queue_budget_ms: float = 0.0,
         shed_retry_after_s: float = 1.0,
+        shed_soft_ratio: float = 0.6,
+        shed_hard_ratio: float = 1.5,
+        shed_retry_jitter: float = 0.5,
         eject_threshold: int = 0,
         probe_interval_s: float = 5.0,
         redispatch_max: int = 2,
@@ -167,8 +317,16 @@ class MicroBatcher:
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
         self.shed_budget_s = shed_queue_budget_ms / 1e3
         self.shed_retry_after_s = shed_retry_after_s
+        self._admission = AdmissionController(
+            self.shed_budget_s,
+            soft_ratio=shed_soft_ratio,
+            hard_ratio=shed_hard_ratio,
+            retry_after_s=shed_retry_after_s,
+            retry_jitter=shed_retry_jitter,
+        )
         self.metrics = metrics
         self.shed_total = 0
+        self.degrade_total = 0  # OverloadDegraded raised at admission
         # replica health: consecutive-failure circuit breaker (0 = off —
         # the legacy propagate-the-error behavior, which fakes and
         # single-replica harnesses rely on)
@@ -255,6 +413,24 @@ class MicroBatcher:
             return n
         return n - sum(1 for i in self._ejected if i < n)
 
+    def _n_effective_locked(self, n: int) -> int:
+        """Capacity the shed projection and the idle fast path may COUNT
+        ON — stricter than healthy (ISSUE 8 satellite): a replica inside
+        a consecutive-failure run (breaker advancing but not yet
+        tripped) is mid-incident and likely to fail its next batch too,
+        and an ejected replica under a half-open probe is one trial
+        batch, not a replica's worth of throughput (it stays in
+        ``_ejected`` until the probe SUCCEEDS, so it is excluded here by
+        construction). Counting either at full capacity over-admits
+        exactly while the fleet is degraded — the old projection only
+        discounted replicas already ejected."""
+        if self.eject_threshold <= 0:
+            return n
+        return n - sum(
+            1 for i in range(n)
+            if i in self._ejected or self._consec_failures.get(i, 0) > 0
+        )
+
     def _probe_due_locked(self, n: int, now: float) -> bool:
         return any(
             i < n and i not in self._probing
@@ -330,17 +506,44 @@ class MicroBatcher:
         n = self._n_replicas()
         with self._n_lock:
             inflight = self._total_inflight_locked()
-            # ejected replicas aren't capacity: shed capacity re-projects
-            # against the SURVIVING replicas, so the budget tightens the
-            # moment the breaker takes a device out
-            healthy = max(1, self._n_healthy_locked(n))
+            # neither ejected, half-open, nor mid-failure-run replicas
+            # are capacity: shed capacity re-projects against the
+            # replicas that can actually be EXPECTED to complete work,
+            # so the budget tightens the moment a device starts failing,
+            # not only once the breaker trips
+            capacity = max(1, self._n_effective_locked(n))
             for lane in self._dispatch_times.values():
                 if lane:
                     device_s = max(device_s, now - lane[0])
         if device_s <= 0.0:
             return 0.0
         queued_batches = self._queue.qsize() / max(self.max_size, 1)
-        return (inflight + queued_batches) * device_s / healthy
+        return (inflight + queued_batches) * device_s / capacity
+
+    def utilization(self) -> float:
+        """The HPA-compatible utilization signal (ISSUE 8), rendered at
+        ``/metrics`` as the ``kmls_utilization`` gauge: the max of
+
+        - **pipeline occupancy** — in-flight batches over the aggregate
+          pipeline depth of the EFFECTIVE replica set (present even with
+          shedding disabled), and
+        - **queue pressure** — the admission controller's effective
+          queue wait over the shed budget.
+
+        1.0 means at capacity; shedding begins above it (the controller's
+        degrade band starts at ``soft_ratio``), so an HPA target in the
+        0.5–0.7 range scales the fleet out BEFORE any request degrades.
+        Taking the max makes the signal rise with whichever saturates
+        first: a device-bound fleet fills its pipelines, a queue-bound
+        one grows its projected wait."""
+        n = self._n_replicas()
+        with self._n_lock:
+            inflight = self._total_inflight_locked()
+            capacity = max(1, self._n_effective_locked(n))
+        occupancy = inflight / (self.max_inflight * capacity)
+        return max(
+            occupancy, self._admission.pressure(self.projected_queue_wait_s())
+        )
 
     def _arrival_gap_s(self) -> float | None:
         """Mean inter-arrival gap over the sliding window, or None before
@@ -379,13 +582,29 @@ class MicroBatcher:
                         f"<= {self.probe_interval_s:.1f}s"
                     )
         if self.shed_budget_s > 0:
-            projected = self.projected_queue_wait_s()
-            if projected > self.shed_budget_s:
+            decision, pressure = self._admission.decide(
+                self.projected_queue_wait_s()
+            )
+            if decision == "shed":
                 with self._rate_lock:  # += from concurrent request threads
                     self.shed_total += 1
                 if self.metrics is not None:
                     self.metrics.record_shed()
-                raise Overloaded(self.shed_retry_after_s, projected * 1e3)
+                # report the EFFECTIVE wait the decision was made on, not
+                # the bare projection — an EWMA-driven shed right after a
+                # burst would otherwise claim a sub-budget wait exceeded
+                # the budget
+                raise Overloaded(
+                    self._admission.retry_after_jittered_s(),
+                    pressure * self.shed_budget_s * 1e3,
+                )
+            if decision == "degrade":
+                with self._rate_lock:
+                    self.degrade_total += 1
+                # the app layer answers from the popularity fallback
+                # (record_degraded("overload") happens there, next to the
+                # deadline/replica-loss reasons)
+                raise OverloadDegraded(pressure)
         pending = _Pending(
             seeds=seeds, future=Future(), t_enqueue=now, deadline=deadline
         )
@@ -442,11 +661,15 @@ class MicroBatcher:
                 except queue.Empty:
                     break
             with self._n_lock:
-                # idle fast path fires while ANY HEALTHY replica sits idle:
-                # waiting only buys amortization when every live device
-                # already has work (an ejected replica isn't capacity)
+                # idle fast path fires while ANY EFFECTIVE replica sits
+                # idle: waiting only buys amortization when every
+                # dependable device already has work (an ejected,
+                # half-open, or mid-failure-run replica isn't capacity —
+                # counting it here over-admitted during re-admission
+                # probes, dispatching real traffic windowless onto a
+                # replica still being auditioned)
                 device_idle = self._total_inflight_locked() < max(
-                    1, self._n_healthy_locked(self._n_replicas())
+                    1, self._n_effective_locked(self._n_replicas())
                 )
             if not device_idle:
                 # all replicas busy: the window buys amortization — keep
@@ -564,6 +787,12 @@ class MicroBatcher:
             if err is not None:
                 self._on_replica_failure(idx, batch, err)
                 continue
+            # the batch LEADER's measured queue wait grounds the admission
+            # controller's pressure estimate (it waited longest — the
+            # worst wait an admitted request actually paid)
+            self._admission.note_queue_wait(
+                t_dispatch - batch[0].t_enqueue, now=t_complete
+            )
             for pending, result in zip(batch, results):
                 if not pending.future.done():  # deadline may have expired it
                     pending.future.set_result(result)
@@ -703,6 +932,9 @@ class AsyncMicroBatcher:
         window_min_ms: float = 1.0,
         shed_queue_budget_ms: float = 0.0,
         shed_retry_after_s: float = 1.0,
+        shed_soft_ratio: float = 0.6,
+        shed_hard_ratio: float = 1.5,
+        shed_retry_jitter: float = 0.5,
         eject_threshold: int = 0,
         probe_interval_s: float = 5.0,
         redispatch_max: int = 2,
@@ -718,8 +950,16 @@ class AsyncMicroBatcher:
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
         self.shed_budget_s = shed_queue_budget_ms / 1e3
         self.shed_retry_after_s = shed_retry_after_s
+        self._admission = AdmissionController(
+            self.shed_budget_s,
+            soft_ratio=shed_soft_ratio,
+            hard_ratio=shed_hard_ratio,
+            retry_after_s=shed_retry_after_s,
+            retry_jitter=shed_retry_jitter,
+        )
         self.metrics = metrics
         self.shed_total = 0
+        self.degrade_total = 0
         # replica health (mirrors MicroBatcher; loop-confined, no locks)
         self.eject_threshold = eject_threshold
         self.probe_interval_s = probe_interval_s
@@ -764,6 +1004,16 @@ class AsyncMicroBatcher:
         if self.eject_threshold <= 0:
             return n
         return n - sum(1 for i in self._ejected if i < n)
+
+    def _n_effective(self, n: int) -> int:
+        """Mirrors MicroBatcher._n_effective_locked: capacity excludes
+        ejected, half-open-probing, AND mid-failure-run replicas."""
+        if self.eject_threshold <= 0:
+            return n
+        return n - sum(
+            1 for i in range(n)
+            if i in self._ejected or self._consec_failures.get(i, 0) > 0
+        )
 
     def _probe_due(self, n: int, now: float) -> bool:
         return any(
@@ -813,7 +1063,15 @@ class AsyncMicroBatcher:
         queued_batches = len(self._pending) / max(self.max_size, 1)
         return (
             (self._total_inflight() + queued_batches)
-            * device_s / max(1, self._n_healthy(self._n_replicas()))
+            * device_s / max(1, self._n_effective(self._n_replicas()))
+        )
+
+    def utilization(self) -> float:
+        """Mirrors MicroBatcher.utilization (loop-confined, no locks)."""
+        capacity = max(1, self._n_effective(self._n_replicas()))
+        occupancy = self._total_inflight() / (self.max_inflight * capacity)
+        return max(
+            occupancy, self._admission.pressure(self.projected_queue_wait_s())
         )
 
     def _arrival_gap_s(self) -> float | None:
@@ -852,12 +1110,21 @@ class AsyncMicroBatcher:
                     f"<= {self.probe_interval_s:.1f}s"
                 )
         if self.shed_budget_s > 0:
-            projected = self.projected_queue_wait_s()
-            if projected > self.shed_budget_s:
+            decision, pressure = self._admission.decide(
+                self.projected_queue_wait_s()
+            )
+            if decision == "shed":
                 self.shed_total += 1
                 if self.metrics is not None:
                     self.metrics.record_shed()
-                raise Overloaded(self.shed_retry_after_s, projected * 1e3)
+                # effective wait, mirroring the threaded twin
+                raise Overloaded(
+                    self._admission.retry_after_jittered_s(),
+                    pressure * self.shed_budget_s * 1e3,
+                )
+            if decision == "degrade":
+                self.degrade_total += 1
+                raise OverloadDegraded(pressure)
         future = loop.create_future()
         pending = _Pending(
             seeds=seeds, future=future, t_enqueue=now, deadline=deadline
@@ -892,9 +1159,11 @@ class AsyncMicroBatcher:
                         window, self._flush, loop
                     )
         elif self._total_inflight() < max(
-            1, self._n_healthy(self._n_replicas())
+            1, self._n_effective(self._n_replicas())
         ):
-            self._flush(loop)  # idle fast path: some healthy replica is free
+            # idle fast path: some EFFECTIVE replica is free (ejected,
+            # half-open, and mid-failure-run replicas aren't capacity)
+            self._flush(loop)
         elif self._flush_handle is None:
             self._flush_handle = loop.call_later(
                 self._busy_window_s(now), self._flush, loop
@@ -1019,6 +1288,12 @@ class AsyncMicroBatcher:
                 else (1 - _EWMA_ALPHA) * self._device_s_ewma
                 + _EWMA_ALPHA * device_s
             )
+            # leader's measured queue wait grounds the admission pressure
+            # (mirrors the threaded completer)
+            if batch:
+                self._admission.note_queue_wait(
+                    t_dispatch - batch[0].t_enqueue, now=t_complete
+                )
             for pending, result in zip(batch, results):
                 if not pending.future.done():
                     pending.future.set_result(result)

@@ -94,27 +94,53 @@ logger = logging.getLogger("kmlserver_tpu.serving")
 _HOST_STAGING_SAFE: bool | None = None
 
 
+def _staging_buffer(shape: tuple[int, int]) -> np.ndarray:
+    """int32 staging buffer at an address ≡ 4 (mod 64) — deliberately NOT
+    64-byte aligned. jax's CPU client ZERO-COPIES ``device_put`` of a
+    host array that meets XLA's alignment requirement (observed on
+    jax 0.4.37: 64-byte-aligned int32 buffers alias, anything less
+    copies), and an aliased device array turns staging-buffer reuse into
+    answer corruption: the next same-shape dispatch refills the buffer
+    the in-flight computation is still reading. ``np.empty`` leaves
+    alignment to allocator luck — page-aligned for large buffers, so
+    exactly the big batches aliased — which made the corruption a
+    once-in-a-while flake instead of a loud failure. Offsetting to
+    4 (mod 64) defeats every power-of-two alignment gate ≥ 8 while
+    keeping the 4-byte alignment the int32 view needs, so device_put
+    must copy; :func:`_staging_is_safe` probes THIS allocator so a
+    future jax that aliases anyway disables reuse instead of corrupting."""
+    n_bytes = int(np.prod(shape)) * 4
+    raw = np.empty(n_bytes + 68, dtype=np.uint8)
+    off = (4 - raw.ctypes.data) % 64
+    return raw[off:off + n_bytes].view(np.int32).reshape(shape)
+
+
 def _staging_is_safe() -> bool:
     """True when reusing one host staging buffer across dispatches is
     provably safe: the buffer is refilled while earlier transfers may
     still be in flight, so ``jax.device_put`` must have fully consumed it
     by the time it returns. Only the CPU backend qualifies — its
-    transfers are synchronous, and the probe below confirms the copy
-    (``jnp.asarray`` is zero-copy there, which is exactly why the staging
-    path goes through ``device_put``). On accelerators the transfer may
-    complete asynchronously AFTER device_put returns — a tiny probe
-    passing proves nothing about a larger buffer still in flight — so
-    reuse stays off and each dispatch allocates fresh (allocation is not
-    the bottleneck there; donation is the device-side win)."""
+    transfers are synchronous COPIES for the misaligned buffers
+    :func:`_staging_buffer` produces, and the probe below confirms the
+    copy against that same allocator at a realistic size (``jnp.asarray``
+    is zero-copy there, which is exactly why the staging path goes
+    through ``device_put``; a sufficiently ALIGNED buffer is zero-copied
+    even by device_put — the hazard the allocator's deliberate
+    misalignment defeats). On accelerators the transfer may complete
+    asynchronously AFTER device_put returns — a probe passing proves
+    nothing about a larger buffer still in flight — so reuse stays off
+    and each dispatch allocates fresh (allocation is not the bottleneck
+    there; donation is the device-side win)."""
     global _HOST_STAGING_SAFE
     if _HOST_STAGING_SAFE is None:
         if jax.default_backend() != "cpu":
             _HOST_STAGING_SAFE = False
             return False
-        probe = np.full((2, 2), -1, dtype=np.int32)
+        probe = _staging_buffer((2, 64))
+        probe.fill(-1)
         on_device = jax.device_put(probe)
         probe[0, 0] = 123
-        # kmls-verify: allow[hotpath] — one 2x2 probe, cached for the
+        # kmls-verify: allow[hotpath] — one 512-byte probe, cached for the
         # process lifetime; steady-state dispatches never reach this sync
         _HOST_STAGING_SAFE = int(np.asarray(on_device)[0, 0]) == -1
         if not _HOST_STAGING_SAFE:
@@ -930,8 +956,10 @@ class RecommendEngine:
             if _staging_is_safe():
                 arr = self._staging.get(shape)
                 if arr is None:
+                    # _staging_buffer, not np.empty: a 64-byte-aligned
+                    # buffer would be zero-copied (aliased) by device_put
                     arr = self._staging.setdefault(
-                        shape, np.empty(shape, dtype=np.int32)
+                        shape, _staging_buffer(shape)
                     )
                 arr.fill(-1)
             else:

@@ -20,6 +20,16 @@ import time
 # larger reservoir to mean anything (16384 samples → ~16 above p999)
 _QUANTILES = (0.50, 0.95, 0.99, 0.999)
 
+# The autoscaling signal (ISSUE 8): the gauge kubernetes/hpa.yaml scales
+# the API fleet on, derived by the batcher from its queue/device latency
+# attribution (max of pipeline occupancy and admission queue pressure;
+# 1.0 = at capacity, shedding begins above it). The app exposes it
+# through the robustness-state dict (serving/app.py _robustness_state,
+# key "utilization" → rendered with the kmls_ prefix below);
+# tests/test_deploy.py pins the HPA manifest to THIS name so the metric
+# the adapter queries can never drift from the one the server exports.
+UTILIZATION_SERIES = "kmls_utilization"
+
 
 class LatencyReservoir:
     """Fixed-size ring of recent latencies; cheap percentile reads."""

@@ -24,6 +24,24 @@ the epoch-keyed answer cache feeds on (default off, preserving the
 all-distinct legacy mix bit for bit). Targets that report cache outcomes
 (the in-process app path, or an HTTP server's ``X-KMLS-Cache`` header)
 get cached/uncached latency split out in the report.
+
+**Traffic shapes** (ISSUE 8): constant-rate Poisson is the only shape
+production traffic never has. :func:`shaped_arrivals` generates the
+arrival schedule for composable load shapes — ``constant`` (the legacy
+Poisson process, bit-identical), ``burst`` (trains of
+``burst_factor``× the base rate), ``ramp`` (linear rate ramp),
+``sine`` (one or more diurnal cycles) — selected by ``--shape`` /
+``KMLS_REPLAY_SHAPE`` and accepted by every replay driver via the
+``arrivals=`` parameter. Two shapes act on the *request mix* instead of
+(or as well as) the rate: :func:`flash_crowd_payloads` collapses a
+mid-run window of the payload list onto a tiny hot seed pool (all
+traffic lands on a handful of cache keys — the singleflight/shed
+interaction case), and the **epoch-flip** scenario keeps a hot Zipf mix
+but fires an ``events`` callback mid-run (``replay``/``replay_pooled``
+``events=[(index, fn)]``) that the harness points at a real bundle
+republication — every hot cache key invalidates at once mid-burst, the
+cache-invalidation worst case the epoch-keyed design must absorb
+without stampeding the batcher.
 """
 
 from __future__ import annotations
@@ -31,6 +49,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import random
 import threading
 import time
@@ -165,20 +184,172 @@ def sample_seed_sets(
     return [pool[int(i)] for i in picks]
 
 
+REPLAY_SHAPES = ("constant", "burst", "ramp", "sine")
+
+
+def shaped_arrivals(
+    n: int,
+    qps: float,
+    shape: str = "constant",
+    *,
+    rng_seed: int = 12345,
+    burst_factor: float = 10.0,
+    burst_fraction: float = 0.15,
+    n_bursts: int = 4,
+    ramp_start_factor: float = 0.1,
+    ramp_stop_factor: float = 2.0,
+    sine_amplitude: float = 0.75,
+    sine_cycles: float = 2.0,
+) -> np.ndarray:
+    """Arrival times (seconds from start) for ``n`` requests under a
+    non-homogeneous Poisson process whose rate follows ``shape``:
+
+    - ``constant``: rate ``qps`` throughout — BIT-identical to the
+      internal schedule every replay driver used before shapes existed
+      (same rng seed, same exponential stream), so un-shaped runs stay
+      comparable across rounds;
+    - ``burst``: ``n_bursts`` burst trains — ``burst_fraction`` of each
+      period at ``burst_factor × qps``, the rest at the base rate (a
+      10× burst is the overload-robustness acceptance shape);
+    - ``ramp``: rate climbs linearly ``ramp_start_factor × qps`` →
+      ``ramp_stop_factor × qps`` (the autoscaler's approach ramp);
+    - ``sine``: ``sine_cycles`` diurnal cycles of
+      ``qps·(1 ± sine_amplitude)``.
+
+    Thinning-free construction: unit-rate exponential gaps are divided
+    by the instantaneous rate at the current arrival time, so every
+    shape emits exactly ``n`` requests and an unknown shape never drops
+    traffic silently — it raises."""
+    if shape not in REPLAY_SHAPES:
+        raise ValueError(
+            f"unknown replay shape {shape!r}; expected one of "
+            f"{'/'.join(REPLAY_SHAPES)}"
+        )
+    rng = np.random.default_rng(rng_seed)
+    if shape == "constant":
+        # EXACTLY the legacy drivers' draw — scale passed to exponential(),
+        # not divided out afterwards: numpy computes scale·standard_exp, and
+        # gaps/qps differs from that in the last float bit at most rates,
+        # which would silently break the bit-identity (comparability)
+        # contract this branch exists for
+        return np.cumsum(rng.exponential(1.0 / qps, size=n))
+    unit_gaps = rng.exponential(1.0, size=n)
+    # nominal run length at the shape's MEAN rate — the rate functions
+    # are phased against it, so "4 bursts" means 4 bursts over the run
+    # regardless of n
+    if shape == "burst":
+        mean = qps * (1.0 + burst_fraction * (burst_factor - 1.0))
+    elif shape == "ramp":
+        mean = qps * (ramp_start_factor + ramp_stop_factor) / 2.0
+    else:  # sine
+        mean = qps
+    nominal_s = n / mean
+
+    def rate(t: float) -> float:
+        # past the nominal window (a slow target stretches real time)
+        # the shape holds its final value instead of wrapping
+        phase = min(t / nominal_s, 1.0) if nominal_s > 0 else 1.0
+        if shape == "burst":
+            if phase >= 1.0:
+                # each period ENDS at the base rate, but 1.0 % period == 0
+                # reads as burst onset — hold the base rate explicitly so
+                # the tail past the nominal window doesn't grow a fifth,
+                # undocumented burst
+                return qps
+            period = 1.0 / max(n_bursts, 1)
+            in_burst = (phase % period) < burst_fraction * period
+            return qps * burst_factor if in_burst else qps
+        if shape == "ramp":
+            return qps * (
+                ramp_start_factor
+                + (ramp_stop_factor - ramp_start_factor) * phase
+            )
+        # sine, floored at 5% of base so the process always advances
+        import math
+
+        return max(
+            qps * (1.0 + sine_amplitude
+                   * math.sin(2.0 * math.pi * sine_cycles * phase)),
+            0.05 * qps,
+        )
+
+    out = np.empty(n, dtype=np.float64)
+    t = 0.0
+    for i in range(n):
+        t += unit_gaps[i] / rate(t)
+        out[i] = t
+    return out
+
+
+def flash_crowd_payloads(
+    payloads: list[list[str]],
+    *,
+    window: tuple[float, float] = (0.4, 0.7),
+    hot_pool: int = 4,
+) -> list[list[str]]:
+    """The flash-crowd request mix: inside ``window`` (fractions of the
+    request stream) EVERY request collapses onto a ``hot_pool``-sized
+    set of seed payloads drawn from the window's own head — all traffic
+    lands on a handful of cache keys at once, which is exactly where
+    singleflight, the answer cache, and admission control interact.
+    Outside the window the mix is untouched. The hot pool comes from
+    INSIDE the window so the crowd's keys are cold at onset (never
+    pre-warmed by the preceding traffic) — the worst case."""
+    n = len(payloads)
+    lo, hi = int(window[0] * n), int(window[1] * n)
+    if hi <= lo:
+        return list(payloads)
+    # distinct pool entries (a Zipf mix repeats payloads): first
+    # hot_pool DISTINCT seed sets from the window's own slice
+    seen: dict[tuple, None] = {}
+    for p in payloads[lo:hi]:
+        seen.setdefault(tuple(p), None)
+        if len(seen) >= hot_pool:
+            break
+    pool = [list(p) for p in seen]
+    return [
+        list(pool[i % len(pool)]) if lo <= i < hi else list(payloads[i])
+        for i in range(n)
+    ]
+
+
+def _fire_events(events, i: int, fired: set) -> None:
+    """Run every not-yet-fired event whose trigger index <= i (pacing
+    thread only; an event that raises is the harness's bug, not a
+    request error — let it propagate)."""
+    if not events:
+        return
+    for j, (at_index, fn) in enumerate(events):
+        if j not in fired and i >= at_index:
+            fired.add(j)
+            fn()
+
+
 def replay(
     send,  # callable(list[str]) -> str (response source tag)
     payloads: list[list[str]],
     *,
     qps: float,
     max_concurrency: int = 256,
+    arrivals: np.ndarray | None = None,
+    events: list | None = None,
 ) -> ReplayReport:
     """Open-loop replay: request i is DISPATCHED at its Poisson arrival time
     regardless of whether earlier requests completed (up to
     ``max_concurrency`` in flight, beyond which arrivals count as errors —
-    an overloaded server must show up as drops/latency, not reduced load)."""
-    rng = np.random.default_rng(12345)
-    gaps = rng.exponential(1.0 / qps, size=len(payloads))
-    arrival = np.cumsum(gaps)
+    an overloaded server must show up as drops/latency, not reduced load).
+    ``arrivals`` overrides the internal constant-rate schedule with a
+    :func:`shaped_arrivals` one; ``events`` is ``[(index, fn)]`` — each
+    ``fn`` runs once on the pacing thread when dispatch reaches its index
+    (the epoch-flip harness hook)."""
+    arrival = (
+        arrivals if arrivals is not None
+        else np.cumsum(
+            np.random.default_rng(12345).exponential(
+                1.0 / qps, size=len(payloads)
+            )
+        )
+    )
 
     lat_ms: list[float] = []
     lat_cached: list[float] = []
@@ -206,12 +377,14 @@ def replay(
         finally:
             inflight.release()
 
+    fired: set = set()
     start = time.perf_counter()
     for i, seeds in enumerate(payloads):
         now = time.perf_counter() - start
         wait = arrival[i] - now
         if wait > 0:
             time.sleep(wait)
+        _fire_events(events, i, fired)
         if not inflight.acquire(blocking=False):
             with lock:
                 errors += 1
@@ -254,6 +427,8 @@ def replay_pooled(
     qps: float,
     n_workers: int = 64,
     max_queue: int = 512,
+    arrivals: np.ndarray | None = None,
+    events: list | None = None,
 ) -> ReplayReport:
     """Open-loop replay with a fixed worker pool and one persistent sender
     per worker (wrk-style). The thread-per-request :func:`replay` melts at
@@ -261,9 +436,17 @@ def replay_pooled(
     which measures the load generator, not the server; here arrivals are
     Poisson-paced into a bounded queue and latency runs from the scheduled
     ARRIVAL to completion — queue wait included — so an overloaded server
-    shows up as latency and drops, never as reduced offered load."""
-    rng = np.random.default_rng(12345)
-    arrival = np.cumsum(rng.exponential(1.0 / qps, size=len(payloads)))
+    shows up as latency and drops, never as reduced offered load.
+    ``arrivals``/``events`` as in :func:`replay`: a shaped arrival
+    schedule, and ``[(index, fn)]`` hooks fired on the pacing thread."""
+    arrival = (
+        arrivals if arrivals is not None
+        else np.cumsum(
+            np.random.default_rng(12345).exponential(
+                1.0 / qps, size=len(payloads)
+            )
+        )
+    )
 
     import queue as queue_mod
 
@@ -320,11 +503,13 @@ def replay_pooled(
     for w in workers:
         w.start()
 
+    fired: set = set()
     start = time.perf_counter()
     for i, seeds in enumerate(payloads):
         wait = arrival[i] - (time.perf_counter() - start)
         if wait > 0:
             time.sleep(wait)
+        _fire_events(events, i, fired)
         try:
             q.put_nowait((start + arrival[i], seeds))
         except queue_mod.Full:
@@ -589,7 +774,30 @@ def main() -> int:
              "payloads (0 = off, the all-distinct legacy mix; 1.1 models "
              "real playlist-seed traffic and feeds the answer cache)",
     )
+    parser.add_argument(
+        "--shape",
+        choices=REPLAY_SHAPES + ("flashcrowd",),
+        default=os.environ.get("KMLS_REPLAY_SHAPE") or "constant",
+        help="traffic shape: constant (legacy Poisson), burst "
+             "(--burst-factor trains), ramp, sine, or flashcrowd "
+             "(constant rate, mid-run payload collapse onto a hot seed "
+             "pool); default from KMLS_REPLAY_SHAPE. The epoch-flip "
+             "scenario needs a publication harness and lives in bench.py "
+             "and the chaos tests, not this CLI",
+    )
+    parser.add_argument(
+        "--burst-factor", type=float, default=10.0,
+        help="burst-shape rate multiplier over --qps",
+    )
     args = parser.parse_args()
+    if args.shape == "flashcrowd":
+        arrivals_for = lambda n: shaped_arrivals(n, args.qps)  # noqa: E731
+        reshape = flash_crowd_payloads
+    else:
+        arrivals_for = lambda n: shaped_arrivals(  # noqa: E731
+            n, args.qps, args.shape, burst_factor=args.burst_factor
+        )
+        reshape = lambda p: p  # noqa: E731
 
     if args.url:
         vocab = _local_vocab()
@@ -598,8 +806,12 @@ def main() -> int:
                 "NOTE: no local artifacts found (BASE_DIR); all seeds are "
                 "unknown — this measures the static-fallback path only",
             )
-        payloads = sample_seed_sets(vocab, args.requests, zipf_s=args.zipf_s)
-        if args.client == "async":
+        payloads = reshape(
+            sample_seed_sets(vocab, args.requests, zipf_s=args.zipf_s)
+        )
+        if args.client == "async" and args.shape in ("constant", "flashcrowd"):
+            # the pipelined client paces its own constant schedule; shaped
+            # RATES need the pooled driver's arrivals= parameter
             report = replay_async_http(
                 args.url, payloads, qps=args.qps,
                 n_conns=min(args.workers, 128),
@@ -608,6 +820,7 @@ def main() -> int:
             report = replay_pooled(
                 pooled_http_sender_factory(args.url), payloads,
                 qps=args.qps, n_workers=args.workers,
+                arrivals=arrivals_for(len(payloads)),
             )
         print(report.to_json())
         return 0
@@ -635,11 +848,13 @@ def main() -> int:
             recs, source, cached = app.recommend_direct(seeds)
             return source, cached
 
-        payloads = sample_seed_sets(
+        payloads = reshape(sample_seed_sets(
             app.engine.bundle.vocab, args.requests, zipf_s=args.zipf_s
-        )
+        ))
 
-    report = replay(send, payloads, qps=args.qps)
+    report = replay(
+        send, payloads, qps=args.qps, arrivals=arrivals_for(len(payloads))
+    )
     attach_attribution(report, metrics)
     if app.cache is not None:
         report.cache_hit_ratio = app.cache.hit_ratio()

@@ -8,13 +8,19 @@ import json
 import threading
 import time
 
+import jax
 import numpy as np
 import pytest
 
 from kmlserver_tpu.config import ServingConfig
 from kmlserver_tpu.io import artifacts
 from kmlserver_tpu.serving.app import RecommendApp
-from kmlserver_tpu.serving.batcher import MicroBatcher, Overloaded
+from kmlserver_tpu.serving.batcher import (
+    AdmissionController,
+    MicroBatcher,
+    Overloaded,
+    OverloadDegraded,
+)
 from kmlserver_tpu.serving.engine import RecommendEngine, _staging_is_safe
 from kmlserver_tpu.serving.metrics import ServingMetrics
 from kmlserver_tpu.serving.replay import replay, sample_seed_sets
@@ -93,6 +99,29 @@ class TestBucketedCompilation:
 
 
 class TestStagingReuse:
+    def test_staging_buffers_are_misaligned_so_device_put_copies(self):
+        """Regression for the reuse-corruption flake: jax's CPU client
+        ZERO-COPIES device_put of a 64-byte-aligned host array, so a
+        np.empty staging buffer that happened to land page-aligned was
+        aliased into the device array — the next same-shape dispatch's
+        refill corrupted the in-flight batch (answers swapped between
+        batches, allocator-luck-dependent). The allocator must produce
+        addresses that defeat every power-of-two alignment gate >= 8,
+        and device_put of its buffers must genuinely copy."""
+        from kmlserver_tpu.serving.engine import _staging_buffer
+
+        for shape in ((2, 2), (2, 64), (8, 128), (64, 256)):
+            arr = _staging_buffer(shape)
+            assert arr.shape == shape and arr.dtype == np.int32
+            addr = arr.ctypes.data
+            assert addr % 64 == 4, f"{shape}: addr % 64 == {addr % 64}"
+            arr.fill(-1)
+            on_device = jax.device_put(arr)
+            arr[0, 0] = 123
+            assert int(np.asarray(on_device)[0, 0]) == -1, (
+                f"{shape}: device_put aliased the staging buffer"
+            )
+
     def test_overlapping_same_shape_dispatches_stay_exact(self, mined_pvc):
         """The aliasing hazard the probe guards: two in-flight batches of
         the SAME padded shape share (refill) one staging buffer. Results
@@ -249,7 +278,7 @@ class TestLoadShedding:
         # evidence (a fully cold controller deliberately never sheds, and
         # its first-batch learning window would admit a deep queue)
         batcher.recommend(["warm"], timeout=10.0)
-        outcomes = {"ok": 0, "shed": 0, "other": 0}
+        outcomes = {"ok": 0, "shed": 0, "degraded": 0, "other": 0}
         lock = threading.Lock()
 
         def worker(i):
@@ -257,8 +286,13 @@ class TestLoadShedding:
                 batcher.recommend([f"s{i}"], timeout=30.0)
                 key = "ok"
             except Overloaded as exc:
-                assert exc.retry_after_s == 1.0
+                # Retry-After carries bounded jitter: base 1s ± 50%
+                assert 0.5 <= exc.retry_after_s <= 1.5
                 key = "shed"
+            except OverloadDegraded:
+                # the ladder rung before any 429: the app layer answers
+                # these from the popularity fallback with HTTP 200
+                key = "degraded"
             except Exception:
                 key = "other"
             with lock:
@@ -275,8 +309,12 @@ class TestLoadShedding:
         assert outcomes["other"] == 0
         assert outcomes["shed"] > 0, "overload never shed"
         assert outcomes["ok"] > 0, "shedding rejected everything"
+        # this workload drives pressure well past the budget, so the
+        # degrade band must have fired on the way up
+        assert outcomes["degraded"] > 0, "ladder never degraded"
         assert batcher.shed_total == outcomes["shed"]
         assert metrics.shed_total == outcomes["shed"]
+        assert batcher.degrade_total == outcomes["degraded"]
         # the point of shedding: ADMITTED requests keep a bounded queue
         # wait. Unshed, 150 requests at 4-per-50ms mean the last admitted
         # would wait ~1.9 s; with the budget the observed p99 stays within
@@ -303,7 +341,7 @@ class TestLoadShedding:
         class SheddingBatcher:
             def recommend(self, seeds, timeout=30.0):
                 raise Overloaded(
-                    retry_after_s=2.0, projected_wait_ms=500.0
+                    retry_after_s=1.3, projected_wait_ms=500.0
                 )
 
         app.batcher = SheddingBatcher()
@@ -311,9 +349,142 @@ class TestLoadShedding:
             "POST", "/api/recommend/", json.dumps({"songs": ["x"]}).encode()
         )
         assert status == 429
+        # RFC 9110 delay-seconds: integer ONLY (a decimal crashes
+        # urllib3's Retry.parse_retry_after); the batcher's sub-second
+        # jitter survives as a ceil onto adjacent whole seconds
         assert headers["Retry-After"] == "2"
         body = json.loads(payload)
         assert "overloaded" in body["detail"]
+
+    def test_app_degrades_overload_band_to_fallback(self, tmp_path):
+        """The ladder rung before any 429: OverloadDegraded from the
+        batcher answers 200 + X-KMLS-Degraded: overload from the
+        popularity fallback, and the degraded counter moves."""
+        from kmlserver_tpu.config import MiningConfig
+        from kmlserver_tpu.data.csv import write_tracks_csv
+        from kmlserver_tpu.mining.pipeline import run_mining_job
+
+        from .oracle import random_baskets
+        from .test_ops import table_from_baskets
+
+        rng = np.random.default_rng(21)
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        write_tracks_csv(
+            str(ds_dir / "2023_spotify_ds1.csv"),
+            table_from_baskets(
+                random_baskets(rng, n_playlists=40, n_tracks=12, mean_len=5)
+            ),
+        )
+        run_mining_job(MiningConfig(
+            base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+            min_support=0.05, k_max_consequents=16,
+            top_tracks_save_percentile=0.5,
+        ))
+        app = RecommendApp(ServingConfig(
+            base_dir=str(tmp_path), polling_wait_in_minutes=60.0,
+        ))
+        assert app.engine.load()
+
+        class DegradingBatcher:
+            def submit(self, seeds, deadline=None):
+                raise OverloadDegraded(0.8)
+
+            def recommend(self, seeds, timeout=30.0, deadline=None):
+                raise OverloadDegraded(0.8)
+
+        app.batcher = DegradingBatcher()
+        status, headers, payload = app.handle(
+            "POST", "/api/recommend/", json.dumps({"songs": ["x"]}).encode()
+        )
+        assert status == 200
+        assert headers.get("X-KMLS-Degraded") == "overload"
+        assert json.loads(payload)["songs"]
+        assert app.metrics.degraded_by_reason.get("overload", 0) == 1
+
+
+class TestAdmissionController:
+    """Unit coverage for the pressure ladder, the Retry-After jitter
+    bounds, and the queue-wait EWMA's time decay."""
+
+    def test_bands_admit_degrade_shed(self):
+        ctrl = AdmissionController(
+            1.0, soft_ratio=0.5, hard_ratio=2.0, rng=__import__(
+                "random").Random(7),
+        )
+        decision, pressure = ctrl.decide(0.2)  # below soft
+        assert decision == "admit" and pressure == 0.2
+        assert ctrl.decide(5.0)[0] == "shed"   # past hard
+        # mid-degrade band: over many draws, a MIX of admit and degrade,
+        # never a shed
+        mid = [ctrl.decide(0.75)[0] for _ in range(400)]
+        assert set(mid) == {"admit", "degrade"}
+        # between budget and hard: shed and degrade mix, never full admit
+        upper = [ctrl.decide(1.5)[0] for _ in range(400)]
+        assert set(upper) == {"degrade", "shed"}
+        # probability ramps: deeper into the band sheds more often
+        deep = [ctrl.decide(1.9)[0] for _ in range(400)]
+        assert deep.count("shed") > upper.count("shed")
+
+    def test_legacy_cliff_ratios(self):
+        # soft=hard=1.0 reproduces the pre-controller cliff exactly
+        ctrl = AdmissionController(1.0, soft_ratio=1.0, hard_ratio=1.0)
+        assert ctrl.decide(0.999)[0] == "admit"
+        assert ctrl.decide(1.0)[0] == "shed"
+
+    def test_retry_after_jitter_bounded_and_varied(self):
+        ctrl = AdmissionController(
+            1.0, retry_after_s=1.0, retry_jitter=0.5,
+            rng=__import__("random").Random(3),
+        )
+        draws = [ctrl.retry_after_jittered_s() for _ in range(200)]
+        assert all(0.5 <= d <= 1.5 for d in draws)
+        assert len({round(d, 3) for d in draws}) > 50, "jitter is constant"
+        # jitter off restores the constant hint
+        flat = AdmissionController(1.0, retry_after_s=2.0, retry_jitter=0.0)
+        assert flat.retry_after_jittered_s() == 2.0
+
+    def test_queue_wait_ewma_decays_after_burst(self):
+        ctrl = AdmissionController(0.1, soft_ratio=0.5, hard_ratio=1.5)
+        t0 = 100.0
+        ctrl.note_queue_wait(0.5, now=t0)  # 5x the budget: hard overload
+        assert ctrl.pressure(0.0, now=t0) > 1.5
+        # with no new completions, time alone brings pressure back down
+        # (half-life = max(budget, 0.25s))
+        assert ctrl.pressure(0.0, now=t0 + 2.0) < ctrl.pressure(0.0, now=t0)
+        assert ctrl.pressure(0.0, now=t0 + 30.0) < 0.05
+
+    def test_pressure_zero_with_shedding_off(self):
+        ctrl = AdmissionController(0.0)
+        ctrl.note_queue_wait(10.0, now=1.0)
+        assert ctrl.pressure(10.0, now=1.0) == 0.0
+
+    def test_utilization_signal_rises_with_inflight(self):
+        """The HPA signal: 0 idle, >0 with a batch in flight, and queue
+        pressure lifts it past occupancy alone."""
+        release = threading.Event()
+
+        class GateEngine:
+            def recommend_many_async(self, seed_sets):
+                def finish():
+                    release.wait(timeout=10.0)
+                    return [(list(s), "rules") for s in seed_sets]
+
+                return finish
+
+        batcher = MicroBatcher(
+            GateEngine(), max_size=2, window_ms=1.0, max_inflight=2,
+            shed_queue_budget_ms=100.0,
+        )
+        assert batcher.utilization() == 0.0
+        fut = batcher.submit(["x"])
+        deadline = time.perf_counter() + 2.0
+        while batcher.utilization() == 0.0 and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        busy = batcher.utilization()
+        assert busy > 0.0
+        release.set()
+        fut.result(timeout=5.0)
 
 
 class TestAttributionMetrics:
@@ -517,17 +688,21 @@ class TestAsyncMicroBatcher:
             )
             await batcher.submit(["warm"])  # teach the device-time EWMA
             futures = []
-            sheds = 0
+            sheds = degrades = 0
             for i in range(40):
                 try:
                     futures.append(batcher.submit([f"s{i}"]))
                 except Overloaded as exc:
-                    assert exc.retry_after_s == 1.0
+                    # Retry-After carries bounded jitter: base 1s ± 50%
+                    assert 0.5 <= exc.retry_after_s <= 1.5
                     sheds += 1
+                except OverloadDegraded:
+                    degrades += 1
             for f in futures:
                 await f
             assert sheds > 0
             assert batcher.shed_total == sheds == metrics.shed_total
+            assert batcher.degrade_total == degrades
 
         asyncio.run(scenario())
 

@@ -365,6 +365,29 @@ class TestTpuSuiteWiring:
             "full_s": 1.445, "interrupted_s": 1.298, "resume_s": 0.129,
             "saved_pct": 91.068, "identical": True, "platform": "cpu",
         },
+        "loadshape": {
+            "qps": 1000.0, "burst_factor": 10.0, "zipf_s": 1.1,
+            "requests": 8000,
+            "burst": {
+                "offered_qps": 2388.9, "achieved_qps": 2388.9,
+                "p50_ms": 0.7, "p99_ms": 4.7, "errors": 0, "http_5xx": 0,
+                "shed": 0, "degraded": 0, "ok": 8000,
+                "runs_p99_ms": [4.7, 5.1, 9.2],
+            },
+            "flash": {
+                "offered_qps": 1007.0, "achieved_qps": 1007.0,
+                "p50_ms": 0.8, "p99_ms": 26.3, "errors": 0, "http_5xx": 0,
+                "shed": 2, "degraded": 1, "ok": 3997,
+            },
+            "epochflip": {
+                "offered_qps": 1008.0, "achieved_qps": 1008.0,
+                "p50_ms": 1.2, "p99_ms": 32.0, "errors": 0, "http_5xx": 0,
+                "shed": 0, "degraded": 0, "ok": 4000,
+                "epoch_moved": 1, "singleflight_joins": 5,
+            },
+            "cache_hit_ratio": 0.983, "utilization_after": 0.01,
+            "platform": "cpu",
+        },
         "als-hybrid": {
             "als_train_s": 3.2, "als_rank": 32, "als_iters": 8,
             "emb_vocab": 2171, "qps": 1000.0, "achieved_qps": 999.0,
@@ -904,7 +927,8 @@ class TestBenchStateResume:
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
-            "mine_resume_cpu", "als_hybrid_cpu", "confserve_cpu",
+            "loadshape_cpu", "mine_resume_cpu", "als_hybrid_cpu",
+            "confserve_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -1142,6 +1166,65 @@ class TestCompactLine:
         assert parsed["replay10k_p99_ms"] == 4.881
         assert parsed["replay10k_cache_hit_ratio"] == 0.997
         assert parsed["replay10k_cached_p50_ms"] == 0.402
+
+    def test_record_loadshape_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-8 traffic-shape bracket's judged keys (burst p99 /
+        zero 5xx / zero errors, flash + epoch-flip 5xx, the epoch-moved
+        proof) must land in the compact line without regressing the
+        ≤1,800 budget."""
+        canned = {
+            "qps": 1000.0, "burst_factor": 10.0, "zipf_s": 1.1,
+            "requests": 8000,
+            "burst": {
+                "offered_qps": 2388.9, "achieved_qps": 2388.9,
+                "p50_ms": 0.713, "p99_ms": 4.745, "errors": 0,
+                "http_5xx": 0, "shed": 0, "degraded": 0, "ok": 8000,
+                "runs_p99_ms": [4.745, 5.1, 9.2],
+            },
+            "flash": {
+                "offered_qps": 1007.6, "achieved_qps": 1007.6,
+                "p50_ms": 0.801, "p99_ms": 26.299, "errors": 0,
+                "http_5xx": 0, "shed": 3, "degraded": 2, "ok": 3995,
+            },
+            "epochflip": {
+                "offered_qps": 1008.7, "achieved_qps": 1008.7,
+                "p50_ms": 1.153, "p99_ms": 32.04, "errors": 0,
+                "http_5xx": 0, "shed": 0, "degraded": 0, "ok": 4000,
+                "epoch_moved": 1, "singleflight_joins": 5,
+            },
+            "cache_hit_ratio": 0.983, "utilization_after": 0.01,
+            "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_loadshape(result)
+        assert result["loadshape_p99_ms"] == 4.745
+        assert result["loadshape_errors"] == 0
+        assert result["loadshape_http_5xx"] == 0
+        assert result["loadshape_flash_http_5xx"] == 0
+        assert result["loadshape_flip_http_5xx"] == 0
+        assert result["loadshape_flip_epoch_moved"] == 1
+        assert result["loadshape_flip_singleflight"] == 5
+        assert result["loadshape_burst_factor"] == 10.0
+        assert result["loadshape_platform"] == "cpu"
+        for key in ("loadshape_p99_ms", "loadshape_errors",
+                    "loadshape_http_5xx", "loadshape_shed",
+                    "loadshape_degraded", "loadshape_offered_qps",
+                    "loadshape_burst_factor", "loadshape_flash_http_5xx",
+                    "loadshape_flip_http_5xx",
+                    "loadshape_flip_epoch_moved"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["loadshape_p99_ms"] == 4.745
+        assert parsed["loadshape_http_5xx"] == 0
+        assert parsed["loadshape_flip_epoch_moved"] == 1
 
     def test_record_mine_resume_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-4 interruption bracket's keys must land in the

@@ -12,6 +12,7 @@ All tests here carry the ``chaos`` marker (a dedicated CI job runs
 
 import dataclasses
 import json
+import threading
 import time
 
 import pytest
@@ -377,6 +378,149 @@ class TestReplicaEjection:
             assert batcher.readmit_total == 1
 
         asyncio.run(scenario())
+
+
+class TestShedCapacityProjection:
+    """ISSUE 8 satellite regression: shed capacity must discount
+    DEGRADED (mid-failure-run) and HALF-OPEN (probing) replicas, not
+    just ejected ones — the old projection counted a replica at full
+    capacity right up to the batch that tripped its breaker, and the
+    idle fast path dispatched real traffic windowless onto a replica
+    still being auditioned by a re-admission probe."""
+
+    class _TwoReplicaEngine:
+        n_replicas = 2
+        host_kernel_active = False
+
+        def recommend_many_async(self, seed_sets, replica=None):
+            def finish():
+                return [(list(s), "rules") for s in seed_sets]
+
+            return finish
+
+    def _batcher(self):
+        return MicroBatcher(
+            self._TwoReplicaEngine(), max_size=4, window_ms=1.0,
+            eject_threshold=3, probe_interval_s=30.0,
+        )
+
+    def test_mid_failure_run_replica_discounted(self):
+        batcher = self._batcher()
+        # two batches in flight, 100ms device EWMA: with both replicas
+        # trusted the projected wait is one device-time per replica
+        batcher._device_s_ewma = 0.1
+        batcher._inflight_by_replica = {0: 1, 1: 1}
+        assert batcher.projected_queue_wait_s() == pytest.approx(0.1)
+        # replica 1 takes ONE failure — breaker not yet tripped (threshold
+        # 3), but it is mid-incident: capacity must halve NOW, before the
+        # ejection, doubling the projection
+        batcher._consec_failures[1] = 1
+        assert batcher._n_effective_locked(2) == 1
+        assert batcher._n_healthy_locked(2) == 2  # loss semantics unchanged
+        assert batcher.projected_queue_wait_s() == pytest.approx(0.2)
+
+    def test_half_open_probe_replica_discounted(self):
+        batcher = self._batcher()
+        batcher._device_s_ewma = 0.1
+        batcher._inflight_by_replica = {0: 1, 1: 1}
+        # replica 1 ejected and now under a half-open probe: one trial
+        # batch is out, but a replica being auditioned is NOT capacity
+        batcher._ejected[1] = time.perf_counter()
+        batcher._probing.add(1)
+        assert batcher._n_effective_locked(2) == 1
+        assert batcher.projected_queue_wait_s() == pytest.approx(0.2)
+
+    def test_async_twin_mirrors_effective_capacity(self):
+        from kmlserver_tpu.serving.batcher import AsyncMicroBatcher
+
+        batcher = AsyncMicroBatcher(
+            self._TwoReplicaEngine(), max_size=4, window_ms=1.0,
+            eject_threshold=3, probe_interval_s=30.0,
+        )
+        assert batcher._n_effective(2) == 2
+        batcher._consec_failures[1] = 2
+        assert batcher._n_effective(2) == 1
+        batcher._consec_failures[1] = 0
+        batcher._ejected[1] = time.perf_counter()
+        batcher._probing.add(1)
+        assert batcher._n_effective(2) == 1
+
+
+class TestEpochFlipStampede:
+    """ISSUE 8 satellite: the hot-key flip at an epoch boundary — every
+    hot cache key invalidates at once mid-burst (a bundle republication
+    moves the epoch, so no old-epoch key can ever match again). The
+    epoch-keyed cache + singleflight must collapse the resulting miss
+    wave onto ONE batcher slot per epoch generation, not stampede the
+    device with one dispatch per request."""
+
+    class _CountingEngine:
+        n_replicas = 1
+        host_kernel_active = False
+        bundle_epoch = 1
+        cache_value = "tok-1"
+
+        def __init__(self):
+            self.dispatched_requests = 0
+            self.dispatch_calls = 0
+
+        def recommend_many_async(self, seed_sets, replica=None):
+            self.dispatch_calls += 1
+            self.dispatched_requests += len(seed_sets)
+
+            def finish():
+                # slow enough that a whole request wave overlaps one
+                # in-flight answer — the window a stampede would exploit
+                time.sleep(0.08)
+                return [(list(s), "rules") for s in seed_sets]
+
+            return finish
+
+    def test_hot_key_invalidation_does_not_stampede_batcher(self, tmp_path):
+        from kmlserver_tpu.config import ServingConfig
+
+        engine = self._CountingEngine()
+        app = RecommendApp(
+            ServingConfig(base_dir=str(tmp_path)), engine=engine
+        )
+        assert app.cache is not None and app.batcher is not None
+        hot = ["hot-a", "hot-b"]
+        results: list = []
+        lock = threading.Lock()
+
+        def ask():
+            recs, source, cached = app.recommend_direct(list(hot))
+            with lock:
+                results.append((recs, source))
+
+        # wave 1: 24 concurrent identical requests under epoch 1
+        wave1 = [threading.Thread(target=ask) for _ in range(24)]
+        for t in wave1:
+            t.start()
+        time.sleep(0.03)  # mid-flight of wave 1's single batch
+        # THE FLIP: the bundle republishes — epoch moves, every hot key
+        # is now unreachable (exactly what engine.load() does after a
+        # successful swap)
+        engine.bundle_epoch = 2
+        engine.cache_value = "tok-2"
+        wave2 = [threading.Thread(target=ask) for _ in range(24)]
+        for t in wave2:
+            t.start()
+        for t in wave1 + wave2:
+            t.join(timeout=10.0)
+        assert len(results) == 48
+        assert all(recs == hot for recs, _ in results)
+        # the stampede bound: one singleflight leader per epoch
+        # generation (plus at most a couple of stragglers that raced the
+        # flip itself) — NOT one dispatch per request
+        assert engine.dispatched_requests <= 6, (
+            f"{engine.dispatched_requests} requests reached the batcher "
+            "for 48 identical asks across one epoch flip"
+        )
+        assert app.cache.singleflight_joins >= 40
+        # post-flip steady state: the new-epoch answer is cached
+        _, _, cached = app.recommend_direct(list(hot))
+        assert cached
 
 
 class TestDeadlineDegradation:

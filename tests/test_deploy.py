@@ -16,7 +16,7 @@ def _load(name):
 
 def test_all_manifests_parse():
     paths = glob.glob(os.path.join(REPO, "kubernetes", "*.yaml"))
-    assert len(paths) == 5
+    assert len(paths) == 6
     for p in paths + [os.path.join(REPO, "argocd_manifest.yaml")]:
         with open(p) as fh:
             # multi-doc manifests (job-multihost.yaml: Service + Job)
@@ -157,6 +157,59 @@ def test_deployment_env_contract_probes_and_tpu():
     } <= _env_names(container)
     assert container["resources"]["requests"]["google.com/tpu"]
     assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "fast-api-claim"
+
+
+def test_hpa_scales_on_exported_utilization_signal():
+    """The autoscaling loop (ISSUE 8): hpa.yaml must target the API
+    Deployment and scale on the EXACT utilization series the server
+    exports — the manifest's metric name is pinned to the code constant
+    so neither side can drift silently."""
+    from kmlserver_tpu.serving.metrics import UTILIZATION_SERIES
+
+    hpa = _load("hpa.yaml")
+    dep = _load("deployment.yaml")
+    assert hpa["kind"] == "HorizontalPodAutoscaler"
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    ref = hpa["spec"]["scaleTargetRef"]
+    assert (ref["kind"], ref["name"]) == (
+        "Deployment", dep["metadata"]["name"]
+    )
+    # floor matches the Deployment's static replica count; ceiling above
+    assert hpa["spec"]["minReplicas"] == dep["spec"]["replicas"]
+    assert hpa["spec"]["maxReplicas"] > hpa["spec"]["minReplicas"]
+    metrics = hpa["spec"]["metrics"]
+    pods = next(m for m in metrics if m["type"] == "Pods")
+    assert pods["pods"]["metric"]["name"] == UTILIZATION_SERIES
+    # the target must sit BELOW the shed budget (1.0 = at capacity) —
+    # scaling out must begin before the admission ladder starts
+    # degrading requests
+    target = pods["pods"]["target"]
+    assert target["type"] == "AverageValue"
+    millis = target["averageValue"]
+    assert millis.endswith("m") and 0 < int(millis[:-1]) < 1000
+    # burst shapes demand a fast scale-up and a slow, stable scale-down
+    behavior = hpa["spec"]["behavior"]
+    assert (
+        behavior["scaleUp"]["stabilizationWindowSeconds"]
+        < behavior["scaleDown"]["stabilizationWindowSeconds"]
+    )
+
+
+def test_utilization_signal_rendered_at_metrics():
+    """The server side of the HPA loop: a RecommendApp always renders
+    the kmls_utilization gauge (0.0 idle, no batcher included) so the
+    custom-metrics adapter's query never comes back empty."""
+    import tempfile
+
+    from kmlserver_tpu.config import ServingConfig
+    from kmlserver_tpu.serving.app import RecommendApp
+    from kmlserver_tpu.serving.metrics import UTILIZATION_SERIES
+
+    with tempfile.TemporaryDirectory() as base:
+        app = RecommendApp(ServingConfig(base_dir=base))
+        text = app.handle("GET", "/metrics", None)[2].decode()
+    assert f"# TYPE {UTILIZATION_SERIES} gauge" in text
+    assert f"\n{UTILIZATION_SERIES} 0" in text
 
 
 def test_service_nodeport():

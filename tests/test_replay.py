@@ -9,7 +9,15 @@ from kmlserver_tpu.config import MiningConfig, ServingConfig
 from kmlserver_tpu.mining.pipeline import run_mining_job
 from kmlserver_tpu.serving.batcher import MicroBatcher
 from kmlserver_tpu.serving.engine import RecommendEngine
-from kmlserver_tpu.serving.replay import ReplayReport, replay, sample_seed_sets
+from kmlserver_tpu.serving.replay import (
+    REPLAY_SHAPES,
+    ReplayReport,
+    flash_crowd_payloads,
+    replay,
+    replay_pooled,
+    sample_seed_sets,
+    shaped_arrivals,
+)
 
 from .oracle import random_baskets
 from .test_ops import table_from_baskets
@@ -93,6 +101,105 @@ def test_replay_counts_failures_as_errors():
     report = replay(send, [["ok"], ["boom"], ["ok"]], qps=500.0)
     assert report.n_errors == 1
     assert report.by_source == {"rules": 2}
+
+
+class TestTrafficShapes:
+    """ISSUE 8: composable load shapes for the replay drivers."""
+
+    def test_constant_shape_bit_identical_to_legacy_schedule(self):
+        # every pre-shape bench number paced with this exact stream —
+        # the constant shape must reproduce it bit for bit
+        legacy = np.cumsum(
+            np.random.default_rng(12345).exponential(1 / 800.0, size=400)
+        )
+        assert np.array_equal(shaped_arrivals(400, 800.0), legacy)
+
+    def test_all_shapes_monotonic_and_complete(self):
+        for shape in REPLAY_SHAPES:
+            arr = shaped_arrivals(3000, 1000.0, shape)
+            assert arr.shape == (3000,)
+            assert np.all(np.diff(arr) > 0), shape
+
+    def test_unknown_shape_raises_not_silently_drops(self):
+        with pytest.raises(ValueError, match="unknown replay shape"):
+            shaped_arrivals(10, 100.0, "diurnal-typo")
+
+    def test_burst_shape_is_bimodal_at_the_burst_factor(self):
+        arr = shaped_arrivals(
+            8000, 1000.0, "burst", burst_factor=10.0, burst_fraction=0.15,
+        )
+        gaps = np.diff(arr)
+        # inside a burst the mean gap is ~1/(10*qps); outside ~1/qps —
+        # the short-gap mass must sit an order of magnitude below the
+        # long-gap mass (a constant process has p10 ≈ p90 / ~20 at most)
+        p10, p90 = np.percentile(gaps, 10), np.percentile(gaps, 90)
+        assert p90 / p10 > 25.0, (p10, p90)
+        # burst trains raise the MEAN rate above base: 1 + 0.15*(10-1)
+        mean_rate = len(arr) / arr[-1]
+        assert 1.8 * 1000.0 < mean_rate < 3.2 * 1000.0
+
+    def test_ramp_shape_accelerates(self):
+        arr = shaped_arrivals(
+            4000, 1000.0, "ramp", ramp_start_factor=0.2, ramp_stop_factor=2.0,
+        )
+        # the second half of the run must arrive much faster than the first
+        mid = len(arr) // 2
+        first_half = arr[mid] - arr[0]
+        second_half = arr[-1] - arr[mid]
+        assert second_half < first_half / 1.5
+
+    def test_sine_shape_oscillates_around_base(self):
+        arr = shaped_arrivals(
+            6000, 1000.0, "sine", sine_amplitude=0.75, sine_cycles=2.0,
+        )
+        mean_rate = len(arr) / arr[-1]
+        assert 700.0 < mean_rate < 1400.0
+        gaps = np.diff(arr)
+        # the troughs (rate ~250/s) and crests (~1750/s) must both exist
+        assert np.percentile(gaps, 95) > 3 * np.percentile(gaps, 5)
+
+    def test_flash_crowd_collapses_window_onto_hot_pool(self):
+        payloads = [[f"s{i}"] for i in range(200)]
+        shaped = flash_crowd_payloads(
+            payloads, window=(0.4, 0.7), hot_pool=4
+        )
+        assert len(shaped) == 200
+        # outside the window: untouched
+        assert shaped[:80] == payloads[:80]
+        assert shaped[140:] == payloads[140:]
+        window = {tuple(p) for p in shaped[80:140]}
+        assert len(window) == 4
+        # the hot pool comes from INSIDE the window (cold at onset)
+        assert window <= {tuple(p) for p in payloads[80:140]}
+
+    def test_replay_accepts_shaped_arrivals_and_fires_events(self):
+        fired_at: list[int] = []
+        seen: list[int] = []
+
+        def send(seeds):
+            seen.append(1)
+            return "rules"
+
+        payloads = [["a"]] * 120
+        report = replay(
+            send, payloads, qps=4000.0,
+            arrivals=shaped_arrivals(120, 4000.0, "burst"),
+            events=[(60, lambda: fired_at.append(len(seen)))],
+        )
+        assert report.n_errors == 0
+        assert fired_at and 30 <= fired_at[0] <= 120
+
+    def test_replay_pooled_accepts_shaped_arrivals_and_fires_events(self):
+        fired: list[int] = []
+        report = replay_pooled(
+            lambda: (lambda seeds: ("rules", None)),
+            [["a"]] * 100, qps=4000.0,
+            arrivals=shaped_arrivals(100, 4000.0, "sine"),
+            events=[(50, lambda: fired.append(1))],
+        )
+        assert report.n_errors == 0
+        assert report.n_requests == 100
+        assert fired == [1]
 
 
 def test_replay_end_to_end_against_engine(tmp_path):
