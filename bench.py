@@ -369,6 +369,10 @@ _COMPACT_PRIORITY = (
     "replay_server_p50_ms", "replay_server_p95_ms", "replay_server_p99_ms",
     "serving_batch32_p50_ms", "serving_batch32_amortized_ms",
     "serving_batch256_p50_ms", "serving_batch256_amortized_ms",
+    # judged tracing claims (ratio ≤ 1.05, zero-cost began_off == 0),
+    # ranked below the TPU serving evidence; on/off/retained detail
+    # lives in the sidecar
+    "traceoverhead_p99_ratio", "traceoverhead_began_off",
     "mining_mfu_pct", "mining_mfu_peak_tops", "mining_matmul_gops_per_s",
     "config4_mine_s", "config4_rows_per_s", "scale_1m_x_100k_mine_s",
     "popcount_words_per_s", "sweep_points",
@@ -1640,6 +1644,119 @@ with tempfile.TemporaryDirectory(prefix="kmls_loadshape_") as base:
 # uninterrupted run. The full-run timing is taken on a SECOND, warm run so
 # jit compilation (paid once per process, amortized to zero by the
 # production job's PVC compilation cache) doesn't inflate the savings.
+_TRACEOVERHEAD_BENCH = r"""
+import dataclasses, json, os, sys, tempfile, time
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_TRACE_QPS", "1000"))
+n_req = int(os.environ.get("KMLS_BENCH_TRACE_REQUESTS", "6000"))
+with tempfile.TemporaryDirectory(prefix="kmls_traceov_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = MiningConfig(base_dir=base, datasets_dir=ds_dir, min_support=0.05)
+    run_mining_job(mcfg)
+
+    # two identical apps, one knob apart: tracing sampled at 0.01 vs
+    # disabled. Both are driven through app.handle (the full HTTP path
+    # minus the socket) with pre-encoded bodies — the json cost is paid
+    # identically on both sides, so the RATIO isolates the trace cost
+    # (begin + queue/device/compose spans + tail retention). The cache
+    # is OFF: a Zipf replay warmed through the cache would answer ~all
+    # hits and never reach the batcher's per-pending span recording —
+    # the dominant trace cost this bracket exists to bound.
+    def build(sample):
+        cfg = dataclasses.replace(
+            ServingConfig.from_env(), base_dir=base,
+            batch_max_size=64, trace_sample=sample, cache_enabled=False,
+        )
+        app = RecommendApp(cfg)
+        assert app.engine.load(), "mined artifacts must load"
+        return app
+
+    apps = {"on": build(0.01), "off": build(0.0)}
+    body_cache = {}
+
+    def body_of(seeds):
+        key = tuple(seeds)
+        b = body_cache.get(key)
+        if b is None:
+            b = json.dumps({"songs": seeds}).encode()
+            body_cache[key] = b
+        return b
+
+    def make_sender(app):
+        def make_send():
+            def send(seeds):
+                status, headers, _ = app.handle(
+                    "POST", "/api/recommend/", body_of(seeds),
+                )
+                if status >= 500:
+                    raise RuntimeError(f"HTTP {status}")
+                return ("ok" if status == 200 else "other"), None
+            return send
+        return make_send
+
+    vocab = apps["on"].engine.bundle.vocab
+    payloads = sample_seed_sets(vocab, n_req, rng_seed=47, zipf_s=1.1)
+    # steady-state warm per app (replay10k posture), then ALTERNATE the
+    # measured runs off/on/off/on so neighbor noise on this host drifts
+    # across both modes instead of biasing one
+    for app in apps.values():
+        send = make_sender(app)()
+        for p in {tuple(p): p for p in payloads}.values():
+            send(list(p))
+        replay_pooled(
+            make_sender(app), payloads[: min(2000, n_req)], qps=qps / 2,
+            n_workers=16,
+        )
+    p99s = {"on": [], "off": []}
+    p50s = {"on": [], "off": []}
+    for _ in range(2):
+        for mode in ("off", "on"):
+            rep = replay_pooled(
+                make_sender(apps[mode]), payloads, qps=qps,
+                n_workers=16, max_queue=16384,
+            )
+            assert rep.n_errors == 0, (mode, rep.n_errors)
+            p99s[mode].append(rep.p99_ms)
+            p50s[mode].append(rep.p50_ms)
+            print(
+                f"traceoverhead/{mode}: p50 {rep.p50_ms:.3f}ms "
+                f"p99 {rep.p99_ms:.3f}ms ({rep.achieved_qps:.0f} qps)",
+                file=sys.stderr, flush=True,
+            )
+    p99_on, p99_off = min(p99s["on"]), min(p99s["off"])
+    rec_on, rec_off = apps["on"].recorder, apps["off"].recorder
+    # the zero-cost contract: the disabled recorder never began a trace
+    assert rec_off.began == 0, rec_off.began
+    assert rec_on.began > 0 and rec_on.retained_total > 0
+    print(json.dumps({
+        "qps": qps,
+        "requests": n_req,
+        "p50_on_ms": round(min(p50s["on"]), 3),
+        "p50_off_ms": round(min(p50s["off"]), 3),
+        "p99_on_ms": round(p99_on, 3),
+        "p99_off_ms": round(p99_off, 3),
+        "p99_ratio": round(p99_on / max(p99_off, 1e-9), 4),
+        "began_on": rec_on.began,
+        "began_off": rec_off.began,
+        "retained_on": rec_on.retained_total,
+        "platform": dev.platform,
+    }))
+"""
+
 _MINE_RESUME_BENCH = r"""
 import json, os, sys, tempfile, time
 import jax
@@ -2841,6 +2958,13 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
     if "confserve_p99_ms" not in result:
         _record_confserve(result, bank="confserve_cpu", budget_s=200)
         em.checkpoint()
+
+    # tracing-overhead micro-bracket (ISSUE 9): CPU-measured by
+    # construction (self-labeled keys) — the ≤1.05 p99 claim must ride
+    # the TPU artifact too, same as every sibling bracket above
+    if "traceoverhead_p99_ratio" not in result:
+        _record_traceoverhead(result, bank="traceoverhead_cpu", budget_s=150)
+        em.checkpoint()
     return mining
 
 
@@ -2881,6 +3005,12 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # crowd / epoch-boundary hot-key flip through the admission
         # ladder — p99 < 10 ms and zero 5xx through the bursts
         _record_loadshape(result)
+        em.checkpoint()
+
+    if _remaining() > 120:
+        # tracing-overhead micro-bracket (ISSUE 9): sampled tracing p99
+        # within 5% of disabled; disabled recorder allocates nothing
+        _record_traceoverhead(result)
         em.checkpoint()
 
     if _remaining() > 120:
@@ -3182,6 +3312,39 @@ def _record_loadshape(
     for key, val in flat.items():
         if val is not None:
             result[key] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_traceoverhead(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The tracing-overhead micro-bracket (ISSUE 9): the same 1k-QPS
+    Zipf constant replay through two apps one knob apart —
+    KMLS_TRACE_SAMPLE=0.01 vs tracing disabled — alternated so host
+    noise drifts across both modes. Judged claims: p99_ratio ≤ 1.05
+    (sampled tracing inside 5% of disabled) and began_off == 0 (the
+    disabled recorder allocated NOTHING — the zero-cost contract the
+    compact line carries as traceoverhead_began_off)."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "traceoverhead", _TRACEOVERHEAD_BENCH, [], platform="cpu",
+            timeout=min(480, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"traceoverhead @ {res['qps']:.0f} QPS: p99 on {res['p99_on_ms']:.2f}ms "
+        f"vs off {res['p99_off_ms']:.2f}ms (ratio {res['p99_ratio']:.3f}); "
+        f"began off={res['began_off']} on={res['began_on']}, "
+        f"retained {res['retained_on']}"
+    )
+    for key in (
+        "p99_on_ms", "p99_off_ms", "p99_ratio", "p50_on_ms", "p50_off_ms",
+        "began_off", "retained_on",
+    ):
+        result[f"traceoverhead_{key}"] = res[key]
 
 
 def _record_mine_resume(

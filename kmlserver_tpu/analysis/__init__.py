@@ -2,7 +2,7 @@
 
 PRs 1–4 made the serving+mining stack fast and fault-tolerant; this
 package makes the invariants that correctness now rests on MACHINE-
-CHECKED instead of reviewer-remembered. Six checkers, each a pure-AST
+CHECKED instead of reviewer-remembered. Seven checkers, each a pure-AST
 pass (stdlib only — the analyzer must run in a bare CI job without jax):
 
 - ``hotpath``      — no host-sync constructs reachable from the serving
@@ -22,7 +22,13 @@ pass (stdlib only — the analyzer must run in a bare CI job without jax):
                      exercised by at least one chaos test;
 - ``exit-codes``   — the 0/64/75/76 contract in mining/job.py exactly
                      matches the ``podFailurePolicy`` rules in both Job
-                     manifests (PR 4's preemption contract).
+                     manifests (PR 4's preemption contract);
+- ``metrics``      — every exported Prometheus series (serving
+                     ``/metrics`` AND the mining ``job_metrics.prom``
+                     textfile) is declared in
+                     ``serving.metrics.METRIC_REGISTRY`` with a valid
+                     type+scope and a README row, orphans flagged both
+                     directions (ISSUE 9).
 
 Findings carry ``file:line``, a severity, an explanation, and a stable
 fingerprint; pre-existing accepted findings live in

@@ -83,6 +83,12 @@ class AnalysisConfig:
         # entry cannot silently take the sharded path out of the purity
         # check (ISSUE 7; the anchor-existence test fails on a rename)
         "kmlserver_tpu/serving/engine.py::RecommendEngine._stage_seeds",
+        # the span recorder's request-path halves (ISSUE 9): begin() runs
+        # at admission for every traced request, finish() on the
+        # completion side holding the retention lock — neither may ever
+        # grow file I/O, sleeps, or host syncs
+        "kmlserver_tpu/observability/trace.py::SpanRecorder.begin",
+        "kmlserver_tpu/observability/trace.py::SpanRecorder.finish",
     )
     # host-sync / blocking constructs forbidden on the dispatch path,
     # by resolved dotted name …
@@ -122,6 +128,8 @@ class AnalysisConfig:
         "RecommendCache._lock",
         "ServingMetrics._lock",
         "LatencyReservoir._lock",
+        "LatencyHistogram._lock",
+        "SpanRecorder._lock",
         "RankWatchdog._guard_lock",
         "_Server.active_lock",
         "kmlserver_tpu/faults.py::_lock",
@@ -185,6 +193,27 @@ class AnalysisConfig:
             "tool": (),
             "fault": (),
         }
+    )
+
+    # --- metric registry checker (ISSUE 9) ---
+    metrics_file: str = "kmlserver_tpu/serving/metrics.py"
+    metric_registry_name: str = "METRIC_REGISTRY"
+    # exposition module -> the scope its series must be registered under
+    metric_exposition_files: dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "kmlserver_tpu/serving/metrics.py": "serving",
+            "kmlserver_tpu/observability/jobmetrics.py": "mining",
+        }
+    )
+    # (function ref, rendered prefix, scope): dict keys / subscript stores
+    # in the function render as <prefix><key> series — the app's
+    # robustness-state dict reaches /metrics through the kmls_ prefix
+    metric_dynamic_sources: tuple[tuple[str, str, str], ...] = (
+        (
+            "kmlserver_tpu/serving/app.py::RecommendApp._robustness_state",
+            "kmls_",
+            "serving",
+        ),
     )
 
     # --- fault-site checker ---
@@ -561,7 +590,7 @@ def _pragma_suppressed(index: ProjectIndex, finding: Finding) -> bool:
 
 
 def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Finding]]]:
-    from . import atomicwrite, exitcodes, hotpath, locking, registries
+    from . import atomicwrite, exitcodes, hotpath, locking, metricsreg, registries
 
     return {
         "hotpath": hotpath.run,
@@ -570,6 +599,7 @@ def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Fi
         "knobs": registries.run_knobs,
         "fault-sites": registries.run_fault_sites,
         "exit-codes": exitcodes.run,
+        "metrics": metricsreg.run,
     }
 
 

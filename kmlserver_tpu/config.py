@@ -138,6 +138,16 @@ KNOB_REGISTRY: dict[str, str] = {
     # --- serving: hybrid rule∪embedding merge (second model family) ---
     "KMLS_HYBRID_MODE": "serving",
     "KMLS_HYBRID_BLEND_WEIGHT": "serving",
+    # --- serving: observability (ISSUE 9) ---
+    # span tracing: baseline sample rate for OK traces (0 = tracing off —
+    # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
+    # ALWAYS retained once tracing is on), ring capacity, slowest-N size
+    "KMLS_TRACE_SAMPLE": "serving",
+    "KMLS_TRACE_BUFFER": "serving",
+    "KMLS_TRACE_SLOW_N": "serving",
+    # event-loop-lag collector: peak-hold decay half-life (0 disables the
+    # collector AND its admission-pressure fold)
+    "KMLS_LOOP_LAG_HALF_LIFE_S": "serving",
     # --- mining: semantics / device dispatch ---
     "KMLS_MAX_ITEMSET_LEN": "mining",
     "KMLS_K_MAX_CONSEQUENTS": "mining",
@@ -166,6 +176,10 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_ALS_RANK": "mining",
     "KMLS_ALS_ITERS": "mining",
     "KMLS_ALS_REG": "mining",
+    # --- mining: telemetry (ISSUE 9) ---
+    # write pickles/job_metrics.prom (textfile-exporter format) as phases
+    # complete, so a fleet's Prometheus sees mining progress
+    "KMLS_JOB_METRICS": "mining",
     # --- mining: preemption-proofing / multi-host ---
     "KMLS_CKPT_ENABLED": "mining",
     "KMLS_CKPT_DIR": "mining",
@@ -216,6 +230,10 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_BENCH_LOADSHAPE_QPS": "tool",
     "KMLS_BENCH_LOADSHAPE_REQUESTS": "tool",
     "KMLS_BENCH_LOADSHAPE_BURST": "tool",
+    # tracing-overhead micro-phase (ISSUE 9): base rate / volume for the
+    # sampled-vs-disabled p99 comparison bracket
+    "KMLS_BENCH_TRACE_QPS": "tool",
+    "KMLS_BENCH_TRACE_REQUESTS": "tool",
     "KMLS_SWEEP_START": "tool",
     "KMLS_SWEEP_STOP": "tool",
     "KMLS_SWEEP_STEP": "tool",
@@ -346,6 +364,14 @@ class MiningConfig:
     # L2 regularization λ on both factor matrices.
     als_reg: float = 0.1
 
+    # --- mining telemetry (ISSUE 9) ---
+    # Write per-phase progress/duration/bytes counters to
+    # pickles/job_metrics.prom (node-exporter textfile-collector format)
+    # through the atomic-write path, rewritten as each phase completes —
+    # a preempted job leaves the telemetry of the phases it DID finish,
+    # and a resumed job reports the compute it skipped.
+    job_metrics: bool = True
+
     # --- preemption-proofing knobs (checkpoint / lease / watchdog) ---
     # Phase-level checkpointing: after each expensive phase (encode, mine,
     # rules) the writer rank persists an atomic, sha256-manifested
@@ -437,6 +463,7 @@ class MiningConfig:
             als_rank=_getenv_int("KMLS_ALS_RANK", 32),
             als_iters=_getenv_int("KMLS_ALS_ITERS", 8),
             als_reg=_getenv_float("KMLS_ALS_REG", 0.1),
+            job_metrics=_getenv_bool("KMLS_JOB_METRICS", True),
             checkpoint_enabled=_getenv_bool("KMLS_CKPT_ENABLED", True),
             checkpoint_dir=os.getenv("KMLS_CKPT_DIR", ""),
             checkpoint_quarantine_after=_getenv_int(
@@ -607,6 +634,25 @@ class ServingConfig:
     # of the popularity ranking (cheapest possible answer).
     fallback_budget_ms: float = 50.0
 
+    # --- observability (ISSUE 9): span tracing + runtime health ---
+    # Baseline retention probability for OK traces once tracing is on.
+    # 0 (default) disables tracing entirely: no trace context, no id
+    # generation, no per-request allocation anywhere on the hot path
+    # (the SpanRecorder's `began` counter proves it, compile-counter
+    # style). With any sample > 0, retention is TAIL-BASED: every shed/
+    # degraded/deadline-exceeded/error trace and the slowest-N OK traces
+    # are always kept; this knob only rates the representative baseline.
+    trace_sample: float = 0.0
+    # Ring capacity of retained traces served at GET /debug/traces.
+    trace_buffer: int = 512
+    # How many slowest-OK traces the tail-based policy always retains.
+    trace_slow_n: int = 32
+    # Event-loop-lag collector (closes the PR 8 inline-path blind spot):
+    # peak-hold decay half-life for the stall estimate exported as
+    # kmls_loop_lag_ms and folded into AdmissionController pressure.
+    # 0 disables the collector and the pressure fold.
+    loop_lag_half_life_s: float = 1.0
+
     # --- second model family: hybrid rule∪embedding serving ---
     # How the two model families combine when an embedding artifact is
     # published: "rules" ignores embeddings entirely (the legacy path),
@@ -676,4 +722,10 @@ class ServingConfig:
             fallback_budget_ms=_getenv_float("KMLS_FALLBACK_BUDGET_MS", 50.0),
             hybrid_mode=_getenv_hybrid_mode(),
             hybrid_blend_weight=_getenv_float("KMLS_HYBRID_BLEND_WEIGHT", 0.5),
+            trace_sample=_getenv_float("KMLS_TRACE_SAMPLE", 0.0),
+            trace_buffer=_getenv_int("KMLS_TRACE_BUFFER", 512),
+            trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),
+            loop_lag_half_life_s=_getenv_float(
+                "KMLS_LOOP_LAG_HALF_LIFE_S", 1.0
+            ),
         )

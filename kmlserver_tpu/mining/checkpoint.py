@@ -283,9 +283,22 @@ class CheckpointStore:
         saved = float(entry.get("saved_at", 0.0))
         return max(time.time() - saved, 0.0) if saved else 0.0
 
-    def save(self, phase: str, payload: Any) -> str | None:
+    def duration_s(self, phase: str) -> float:
+        """The ORIGINAL compute duration annotated at save time (ISSUE 9:
+        the span annotation that lets a resumed job report the compute it
+        skipped in job_metrics.prom). 0.0 for checkpoints written before
+        the annotation existed — the field is additive, so older stores
+        keep resuming."""
+        entry = self._state["phases"].get(phase) or {}
+        return float(entry.get("duration_s", 0.0))
+
+    def save(
+        self, phase: str, payload: Any, duration_s: float | None = None
+    ) -> str | None:
         """Persist the phase payload atomically + manifest it. Writer rank
-        only (no-op otherwise). The ``ckpt.corrupt`` fault site corrupts
+        only (no-op otherwise). ``duration_s`` is the phase's measured
+        compute wall clock, carried in the manifest entry as a span
+        annotation. The ``ckpt.corrupt`` fault site corrupts
         the BYTES here (digest recorded over the corrupt bytes), modeling
         a writer that silently produced garbage — the next load then
         passes integrity but fails parsing, the two-strike path."""
@@ -308,6 +321,7 @@ class CheckpointStore:
             "sha256": hashlib.sha256(data).hexdigest(),
             "saved_at": time.time(),
             "load_failures": 0,
+            "duration_s": round(max(float(duration_s or 0.0), 0.0), 6),
         }
         self._write_state()
         return path

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 
 import jax
 
@@ -47,6 +48,7 @@ from .. import faults
 from ..config import BASE_INDEX, MiningConfig
 from ..data.csv import read_tracks
 from ..io import artifacts, registry
+from ..observability.jobmetrics import JobMetrics
 from ..utils.timeutil import get_current_time_str, get_current_time_str_precise
 from . import checkpoint as ckpt_mod
 from . import vocab as vocab_mod
@@ -166,10 +168,23 @@ def run_mining_job(
     store = ckpt_mod.open_store(cfg, selected, run_index, writer=is_writer)
     resumed: list[str] = []
 
+    # mining-side telemetry (ISSUE 9): per-phase progress/duration/bytes
+    # rewritten atomically to pickles/job_metrics.prom as phases complete
+    # — a preempted job leaves the telemetry of what it DID finish.
+    # Writer rank only, same discipline as every other PVC write.
+    jm = (
+        JobMetrics(cfg.pickles_dir)
+        if is_writer and cfg.job_metrics
+        else None
+    )
+
     def phase(name: str, compute):
         """Resume ``name`` from its checkpoint or compute + persist it.
         The crash fault site fires AFTER the save — exactly where a
-        preemption that already banked the phase would land."""
+        preemption that already banked the phase would land. Either way
+        the phase's compute duration reaches the telemetry file: a
+        resumed phase reports the ORIGINAL duration from the
+        checkpoint's span annotation, flagged resumed=1."""
         payload = store.load(name) if store is not None else None
         if payload is not None:
             resumed.append(name)
@@ -177,10 +192,16 @@ def run_mining_job(
                 f"Resumed phase {name!r} from checkpoint "
                 f"({store.age_s(name):.0f}s old)"
             )
+            if jm is not None:
+                jm.phase_done(name, store.duration_s(name), resumed=True)
             return payload
+        t_phase = time.perf_counter()
         payload = compute()
+        duration_s = time.perf_counter() - t_phase
         if store is not None:
-            store.save(name, payload)
+            store.save(name, payload, duration_s=duration_s)
+        if jm is not None:
+            jm.phase_done(name, duration_s)
         _crash_site(name)
         return payload
 
@@ -212,6 +233,13 @@ def run_mining_job(
         result: MiningResult = phase("mine", _mine)
         _report_mining(result, cfg)
         tensors = result.tensors
+        if jm is not None:
+            jm.set_dataset(
+                rows=encoded["n_rows"],
+                playlists=result.n_playlists,
+                tracks=result.n_tracks,
+            )
+            jm.write()
 
         rules_dict = phase(
             "rules", lambda: tensors.to_rules_dict(result.vocab_names)
@@ -348,9 +376,43 @@ def run_mining_job(
             if store is not None:
                 # published: the next rotation run must start fresh
                 store.clear()
+            if jm is not None:
+                # success telemetry LAST: artifact sizes of the set just
+                # published, the fencing token that fenced it, success=1
+                # + the freshness timestamp dashboards alert on. Broad
+                # guard like the abort path below: publication already
+                # succeeded, so nothing from telemetry (write() is
+                # best-effort on OSError; registry-drift KeyError is the
+                # other escape) may fail the job or skip lease.release()
+                # — the abort handler would overwrite this very telemetry
+                # with success=0 for a run that actually published.
+                try:
+                    for artifact_name, artifact_path in paths.items():
+                        jm.note_artifact(artifact_name, artifact_path)
+                    jm.finish(
+                        True,
+                        rule_generation_s=result.duration_s,
+                        fencing_token=lease.fencing_token if lease else None,
+                    )
+                except Exception as exc:
+                    print(
+                        f"WARNING: success telemetry skipped "
+                        f"({jm.path}): {exc!r}"
+                    )
             if lease is not None:
                 lease.release()
     except BaseException:
+        if jm is not None:
+            try:
+                # the abort itself is telemetry: success=0 with the
+                # completed phases' durations still on the PVC. write()
+                # is already best-effort on OSError; the broad guard is
+                # for anything else (registry-drift KeyError) — nothing
+                # from telemetry may mask the real abort cause or keep
+                # the lease release below from running.
+                jm.finish(False)
+            except Exception:
+                pass
         if lease is not None:
             # a Python-level abort releases: this process writes nothing
             # more, and the replacement pod must not wait out the TTL.

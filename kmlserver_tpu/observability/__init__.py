@@ -1,0 +1,13 @@
+"""Observability layer (ISSUE 9): per-request span tracing with
+tail-based retention (``trace``), runtime-health collection — event-loop
+lag + inline-kernel stalls — feeding the admission ladder (``runtime``),
+and mining-side textfile telemetry (``jobmetrics``). Serving metrics
+exposition itself stays in ``serving/metrics.py``; everything here joins
+its ``METRIC_REGISTRY``."""
+
+from __future__ import annotations
+
+from .runtime import LoopLagMonitor
+from .trace import SpanRecorder, TraceContext
+
+__all__ = ["LoopLagMonitor", "SpanRecorder", "TraceContext"]

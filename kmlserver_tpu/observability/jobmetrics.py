@@ -1,0 +1,173 @@
+"""Mining-side telemetry: ``pickles/job_metrics.prom`` (textfile format).
+
+The mining job is a batch pod — there is no ``/metrics`` endpoint to
+scrape because there is no server; the reference's only telemetry is
+stdout log lines (SURVEY.md §5) and the rebuild added ``PhaseTimer``
+log lines, which a fleet cannot aggregate. The standard k8s answer is
+the node-exporter *textfile collector*: the job writes a Prometheus
+text-format file to a path a sidecar/exporter watches, and the fleet's
+Prometheus sees mining progress/duration/bytes like any other series.
+
+This writer follows the repo's artifact discipline:
+
+- every render goes through :func:`~..io.artifacts.atomic_write_text`
+  (tmp + ``os.replace``), so a scrape can never read a torn file — the
+  same atomic-write invariant kmls-verify enforces for every PVC write;
+- the file is rewritten after every phase, so a preempted job leaves
+  behind the telemetry of the phases it DID finish, and a resumed job
+  (mining/checkpoint.py duration annotations) reports the compute it
+  skipped as ``kmls_job_phase_resumed`` — observability of the resume
+  itself, not just the fresh run;
+- every series name is looked up in
+  :data:`~..serving.metrics.METRIC_REGISTRY` at render time (KeyError =
+  a series someone forgot to register), and kmls-verify's ``metrics``
+  checker enforces the same statically, so the textfile can't drift
+  from the registry any more than ``/metrics`` can.
+
+The file deliberately stays OUT of ``artifacts.manifest.json``: the
+manifest checksums the *served* artifact set frozen at publication,
+while this file keeps changing across the run — manifesting it would
+make every mid-run scrape look like a torn publication.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from ..io import artifacts
+from ..serving.metrics import METRIC_REGISTRY
+
+logger = logging.getLogger("kmlserver_tpu.mining")
+
+JOB_METRICS_FILENAME = "job_metrics.prom"
+
+
+def _fmt(value: float) -> str:
+    # Prometheus floats; integers render without a trailing .0 for
+    # byte/flag series readability
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class JobMetrics:
+    """One mining run's counters, rewritten atomically as they move.
+    Writer-rank only (the pipeline never constructs one on non-zero
+    ranks — same discipline as artifact writes)."""
+
+    def __init__(self, pickles_dir: str):
+        self.path = os.path.join(pickles_dir, JOB_METRICS_FILENAME)
+        self.t_start = time.time()
+        # phase -> {"duration_s": float, "resumed": bool}
+        self.phases: dict[str, dict] = {}
+        self.dataset: dict[str, float] = {}
+        self.artifact_bytes: dict[str, int] = {}
+        self.rule_generation_s: float | None = None
+        self.fencing_token: int | None = None
+        self.success = 0
+
+    # ---------- accumulation ----------
+
+    def phase_done(
+        self, name: str, duration_s: float, resumed: bool = False
+    ) -> None:
+        """Record one pipeline phase (computed or checkpoint-resumed; a
+        resumed phase reports the ORIGINAL compute duration from the
+        checkpoint's span annotation, flagged ``resumed=1``), then
+        persist — a preemption right after this call still leaves the
+        phase's telemetry on the PVC."""
+        self.phases[name] = {
+            "duration_s": max(duration_s, 0.0), "resumed": bool(resumed),
+        }
+        self.write()
+
+    def set_dataset(
+        self, rows: int, playlists: int, tracks: int
+    ) -> None:
+        self.dataset = {
+            "kmls_job_rows": rows,
+            "kmls_job_playlists": playlists,
+            "kmls_job_tracks": tracks,
+        }
+
+    def note_artifact(self, name: str, path: str) -> None:
+        try:
+            self.artifact_bytes[name] = os.path.getsize(path)
+        except OSError:
+            pass
+
+    def finish(
+        self,
+        success: bool,
+        rule_generation_s: float | None = None,
+        fencing_token: int | None = None,
+    ) -> None:
+        self.success = int(bool(success))
+        if rule_generation_s is not None:
+            self.rule_generation_s = rule_generation_s
+        if fencing_token is not None:
+            self.fencing_token = fencing_token
+        self.write()
+
+    # ---------- exposition ----------
+
+    @staticmethod
+    def _type_of(name: str) -> str:
+        # "counter:mining" / "gauge:mining" — KeyError here means an
+        # unregistered series, the exact drift the registry forbids
+        return METRIC_REGISTRY[name].split(":", 1)[0]
+
+    def render(self) -> str:
+        lines: list[str] = []
+
+        def series(name: str, value: float, labels: str = "") -> None:
+            if not any(line.startswith(f"# TYPE {name} ") for line in lines):
+                lines.append(f"# TYPE {name} {self._type_of(name)}")
+            lines.append(f"{name}{labels} {_fmt(value)}")
+
+        for phase in sorted(self.phases):
+            entry = self.phases[phase]
+            series(
+                "kmls_job_phase_duration_seconds",
+                entry["duration_s"], f'{{phase="{phase}"}}',
+            )
+        for phase in sorted(self.phases):
+            series(
+                "kmls_job_phase_resumed",
+                int(self.phases[phase]["resumed"]), f'{{phase="{phase}"}}',
+            )
+        for name, value in self.dataset.items():
+            series(name, value)
+        for artifact in sorted(self.artifact_bytes):
+            series(
+                "kmls_job_artifact_bytes",
+                self.artifact_bytes[artifact],
+                f'{{artifact="{artifact}"}}',
+            )
+        if self.rule_generation_s is not None:
+            series(
+                "kmls_job_rule_generation_seconds", self.rule_generation_s
+            )
+        if self.fencing_token is not None:
+            series("kmls_job_fencing_token", self.fencing_token)
+        series("kmls_job_duration_seconds", time.time() - self.t_start)
+        series("kmls_job_success", self.success)
+        if self.success:
+            series("kmls_job_last_success_timestamp_seconds", time.time())
+        return "\n".join(lines) + "\n"
+
+    def write(self) -> None:
+        # KeyError from an unregistered series must propagate (that's the
+        # registry's drift protection) — render OUTSIDE the guard.
+        text = self.render()
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            artifacts.atomic_write_text(self.path, text)
+        except OSError as exc:
+            # Telemetry is best-effort BY CONTRACT: a transient PVC error
+            # (ENOSPC, EIO, stale NFS handle) on this file must never fail
+            # a mining run whose real artifacts are fine — especially not
+            # finish(True), which runs AFTER publication succeeded.
+            logger.warning("job_metrics write skipped (%s): %s", self.path, exc)

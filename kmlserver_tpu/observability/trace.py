@@ -1,0 +1,242 @@
+"""Per-request span tracing with tail-based retention.
+
+The serving stack's metrics (serving/metrics.py) can say WHERE a latency
+percentile lives (queue vs device vs e2e) but not WHY one specific p999
+request was slow — the reservoirs aggregate away the request identity.
+This module is the per-request view: a :class:`TraceContext` rides a
+request from the HTTP front end through cache → admission → batcher
+queue → replica/shard dispatch → kernel → compose, accumulating named
+spans, and a :class:`SpanRecorder` keeps the *interesting* traces in a
+bounded ring exposed at ``GET /debug/traces``.
+
+Retention is TAIL-BASED, the only sampling policy that answers tail
+questions: head-based sampling at p=0.01 keeps one in a hundred of the
+*shed* requests too, so the trace buffer is statistically empty exactly
+where the incident is. Here the retention decision happens at FINISH
+time, when the outcome is known:
+
+- every non-OK trace (shed / degraded / deadline-exceeded / error) is
+  always retained;
+- the slowest-N OK traces seen so far are retained (a min-heap of the
+  N largest durations — a new tail entrant evicts the fastest member);
+- the remaining OK traces are retained with probability
+  ``KMLS_TRACE_SAMPLE`` (the baseline that keeps the buffer
+  representative of normal traffic).
+
+Zero-cost when off: ``KMLS_TRACE_SAMPLE=0`` (the default) makes
+:attr:`SpanRecorder.enabled` False, and every call site checks that one
+attribute before allocating anything — no context object, no id
+generation, no per-request work. The ``began`` counter proves it the
+same way the compile counter proves zero-compile serving: a test drives
+traffic with tracing off and asserts the counter never moved.
+
+The trace id travels in the ``X-KMLS-Trace`` header (request:
+``<trace_id>`` or ``<trace_id>:<parent_id>``; response echoes the trace
+id), so a replay/bench client can join its client-side timing to the
+server-side span breakdown for the same request.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import random
+import threading
+import time
+
+# ids are [-A-Za-z0-9_.]{1,64}: anything else in the header is treated
+# as absent (a hostile or corrupted header must not flow into JSON
+# output verbatim beyond this charset)
+_ID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_."
+)
+_MAX_ID_LEN = 64
+
+
+def _valid_id(s: str) -> bool:
+    return 0 < len(s) <= _MAX_ID_LEN and all(c in _ID_OK for c in s)
+
+
+class TraceContext:
+    """One request's spans. Append-only; list.append is GIL-atomic, so
+    the batcher's completion thread and the HTTP thread can both record
+    without a lock (the same benign-race budget the batcher's in-flight
+    counters run on — on the normal path spans are recorded before the
+    future resolves, so the finishing thread observes a complete list).
+    When the app thread finishes a trace EARLY (deadline expiry, shed),
+    the completer may still be running — ``finished`` makes its late
+    span() a no-op (best-effort; the check is unsynchronized). The hard
+    immutability guarantee lives in :class:`SpanRecorder`, which retains
+    a trace as its rendered dict frozen at finish time."""
+
+    __slots__ = (
+        "trace_id", "parent_id", "t0", "wall_start",
+        "spans", "attrs", "status", "duration_s", "finished",
+    )
+
+    def __init__(self, trace_id: str, parent_id: str | None, t0: float):
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.t0 = t0  # perf_counter at begin
+        self.wall_start = time.time()
+        self.spans: list[tuple[str, float, float, dict | None]] = []
+        self.attrs: dict[str, object] = {}
+        self.status = "open"
+        self.duration_s = 0.0
+        self.finished = False
+
+    def span(
+        self, name: str, t_start: float, t_end: float,
+        attrs: dict | None = None,
+    ) -> None:
+        """Record a named span (perf_counter endpoints). No-op once the
+        trace is finished: a deadline-expired request is retained at
+        resolve time, and the kernel's eventual completion must not
+        rewrite what ``/debug/traces`` already served."""
+        if self.finished:
+            return
+        self.spans.append((name, t_start, t_end, attrs))
+
+    def annotate(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "parent_id": self.parent_id,
+            "status": self.status,
+            "start_unix": round(self.wall_start, 6),
+            "duration_ms": round(self.duration_s * 1e3, 4),
+            "attrs": dict(self.attrs),
+            "spans": [
+                {
+                    "name": name,
+                    "start_ms": round((t_start - self.t0) * 1e3, 4),
+                    "duration_ms": round((t_end - t_start) * 1e3, 4),
+                    **({"attrs": attrs} if attrs else {}),
+                }
+                for name, t_start, t_end, attrs in list(self.spans)
+            ],
+        }
+
+
+class SpanRecorder:
+    """Bounded ring of finished traces with tail-based retention.
+
+    ``sample <= 0`` disables the recorder entirely (``enabled`` False);
+    call sites must check ``enabled`` before :meth:`begin` so the
+    disabled hot path does literally nothing. The retention lock is
+    taken at most twice per FINISHED request (never per span) and guards
+    only ring + heap mutation — no I/O, no rendering, no blocking calls
+    ever run under it."""
+
+    def __init__(
+        self,
+        sample: float = 0.0,
+        capacity: int = 512,
+        slow_n: int = 32,
+        rng: random.Random | None = None,
+    ):
+        self.sample = min(max(sample, 0.0), 1.0)
+        self.capacity = max(1, capacity)
+        self.slow_n = max(0, slow_n)
+        self.enabled = self.sample > 0.0
+        # contexts created — the zero-cost proof counter (compile-counter
+        # discipline: must stay 0 while tracing is disabled)
+        self.began = 0
+        self.retained_total = 0
+        # retained traces are stored PRE-RENDERED (to_dict at finish
+        # time): the live TraceContext stays reachable from the batcher
+        # completer, and its `finished` no-op guard on span() is only
+        # best-effort (an unsynchronized check the completer can have
+        # already passed) — freezing the rendered form is what actually
+        # guarantees a scraped trace never changes between scrapes
+        self._buf: "collections.deque[dict]" = collections.deque(
+            maxlen=self.capacity
+        )
+        # min-heap of the N largest OK durations retained so far: the
+        # root is the admission bar a new trace must clear to count as
+        # "slowest-N"
+        self._slow: list[float] = []
+        self._lock = threading.Lock()
+        self._rng = rng or random.Random()
+
+    # ---------- lifecycle ----------
+
+    def begin(self, header: str | None = None) -> TraceContext | None:
+        """Open a trace for one request; ``header`` is the raw
+        ``X-KMLS-Trace`` request value (``id`` or ``id:parent``). Only
+        called when :attr:`enabled` — returns None defensively so a
+        miswired call site degrades to untraced rather than crashing."""
+        if not self.enabled:
+            return None
+        self.began += 1  # benign race: diagnostic counter, GIL-coalesced
+        trace_id = ""
+        parent_id: str | None = None
+        if header:
+            head, _, tail = header.partition(":")
+            head = head.strip()
+            tail = tail.strip()
+            if _valid_id(head):
+                trace_id = head
+            if tail and _valid_id(tail):
+                parent_id = tail
+        if not trace_id:
+            trace_id = f"{self._rng.getrandbits(64):016x}"
+        return TraceContext(trace_id, parent_id, time.perf_counter())
+
+    def finish(
+        self, trace: TraceContext, status: str, duration_s: float
+    ) -> bool:
+        """Close the trace and decide retention → whether it was kept.
+        ``status``: ``"ok"`` | ``"shed"`` | ``"degraded"`` | ``"error"``
+        (degraded traces carry the reason in ``attrs["reason"]``)."""
+        trace.status = status
+        trace.duration_s = duration_s
+        trace.finished = True  # best-effort: stops further span() appends
+        with self._lock:
+            keep = status != "ok"
+            if not keep and self.slow_n > 0:
+                # slowest-N admission: the heap root is the bar
+                if len(self._slow) < self.slow_n:
+                    heapq.heappush(self._slow, duration_s)
+                    keep = True
+                elif duration_s > self._slow[0]:
+                    heapq.heapreplace(self._slow, duration_s)
+                    keep = True
+            if not keep:
+                keep = self._rng.random() < self.sample
+        if keep:
+            # render OUTSIDE the lock (allocation-heavy), then append the
+            # frozen dict: a completer thread racing past the `finished`
+            # check mutates only the live context, never the retained form
+            frozen = trace.to_dict()
+            with self._lock:
+                self._buf.append(frozen)
+                self.retained_total += 1
+        return keep
+
+    # ---------- exposition ----------
+
+    def retained(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self) -> list[dict]:
+        """Retained traces, oldest first (JSON-ready; frozen at finish —
+        callers must not mutate the returned dicts)."""
+        with self._lock:
+            return list(self._buf)
+
+    def debug_payload(self) -> dict:
+        """The ``GET /debug/traces`` response body."""
+        traces = self.snapshot() if self.enabled else []
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "capacity": self.capacity,
+            "slow_n": self.slow_n,
+            "began": self.began,
+            "retained_total": self.retained_total,
+            "traces": traces,
+        }

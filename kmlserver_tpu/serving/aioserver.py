@@ -178,6 +178,7 @@ class _Conn(asyncio.Protocol):
                 return
             content_length = 0
             close_after = False
+            trace_header: str | None = None
             for line in header_block.split(b"\r\n"):
                 key, _, value = line.partition(b":")
                 lowered = key.strip().lower()
@@ -189,6 +190,11 @@ class _Conn(asyncio.Protocol):
                         return
                 elif lowered == b"connection":
                     close_after = value.strip().lower() == b"close"
+                elif lowered == b"x-kmls-trace":
+                    # span-trace propagation (ISSUE 9): the raw value;
+                    # the recorder validates the charset before any byte
+                    # of it can reach JSON output
+                    trace_header = value.strip().decode("latin1")
             if content_length > _MAX_BODY:
                 self._bad_request("body too large")
                 return
@@ -197,7 +203,7 @@ class _Conn(asyncio.Protocol):
                 return  # body still arriving
             body = self.buf[end + 4: total] or None
             self.buf = self.buf[total:]
-            self._dispatch(method, path, body, close_after)
+            self._dispatch(method, path, body, close_after, trace_header)
 
     def _bad_request(self, detail: str) -> None:
         seq = self._next_seq
@@ -213,7 +219,8 @@ class _Conn(asyncio.Protocol):
     # ---------- dispatch ----------
 
     def _dispatch(
-        self, method: str, path: str, body: bytes | None, close_after: bool
+        self, method: str, path: str, body: bytes | None, close_after: bool,
+        trace_header: str | None = None,
     ) -> None:
         state = self.state
         app = state.app
@@ -227,7 +234,8 @@ class _Conn(asyncio.Protocol):
                     # batching disabled: the blocking engine call must
                     # still stay off the loop
                     task = state.engine_pool.submit(
-                        app.handle, method, path, body, self.peer_host
+                        app.handle, method, path, body, self.peer_host,
+                        trace_header,
                     )
                     task.add_done_callback(
                         lambda f: self.loop.call_soon_threadsafe(
@@ -235,14 +243,16 @@ class _Conn(asyncio.Protocol):
                         )
                     )
                     return
-                response, future, t0 = app.submit_recommend(body)
+                response, future, t0, trace = app.submit_recommend(
+                    body, trace_header
+                )
                 if response is None:
                     if isinstance(future, asyncio.Future):
                         # loop-native batcher: resolved ON the loop, the
                         # callback is already loop-scheduled
                         future.add_done_callback(
                             lambda f: self._finish_recommend(
-                                seq, f, t0, close_after
+                                seq, f, t0, close_after, trace
                             )
                         )
                     else:
@@ -251,7 +261,7 @@ class _Conn(asyncio.Protocol):
                         future.add_done_callback(
                             lambda f: self.loop.call_soon_threadsafe(
                                 self._finish_recommend, seq, f, t0,
-                                close_after,
+                                close_after, trace,
                             )
                         )
                     return
@@ -270,10 +280,10 @@ class _Conn(asyncio.Protocol):
         state.leave()
 
     def _finish_recommend(
-        self, seq: int, future, t0: float, close_after: bool
+        self, seq: int, future, t0: float, close_after: bool, trace=None
     ) -> None:
         if not self.closed:
-            response = self.state.app.finish_recommend(future, t0)
+            response = self.state.app.finish_recommend(future, t0, trace=trace)
             self._stage(seq, response, close_after)
         self.state.leave()
         if not self.closed:
@@ -365,7 +375,14 @@ async def run_async(app: RecommendApp, port: int, ready=None) -> int:
             probe_interval_s=cfg.replica_probe_interval_s,
             redispatch_max=cfg.redispatch_max_retries,
             metrics=app.metrics,
+            lag_monitor=app.loop_lag,
         )
+    if app.loop_lag is not None:
+        # arm the drift tick on THIS loop: timer-due minus timer-ran is
+        # the time something blocked the loop (kmls_loop_lag_ms at
+        # /metrics, and the admission ladder's runtime-health term —
+        # closing the PR 8 inline-path blind spot)
+        app.loop_lag.start_on_loop(loop)
     state = _ServerState(app)
     server = await loop.create_server(
         lambda: _Conn(state), "0.0.0.0", port, backlog=256,

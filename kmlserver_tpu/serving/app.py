@@ -34,6 +34,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config import ServingConfig
+from ..observability import LoopLagMonitor, SpanRecorder
 from .batcher import (
     DeadlineExceeded,
     NoHealthyReplicas,
@@ -89,6 +90,23 @@ class RecommendApp:
         self.engine = engine or RecommendEngine(cfg)
         self.metrics = ServingMetrics()
         self.batcher = None
+        # per-request span tracing (ISSUE 9): disabled by default
+        # (KMLS_TRACE_SAMPLE=0 → recorder.enabled False, and every call
+        # site checks that before allocating anything)
+        self.recorder = SpanRecorder(
+            sample=cfg.trace_sample,
+            capacity=cfg.trace_buffer,
+            slow_n=cfg.trace_slow_n,
+        )
+        # event-loop/scheduler stall collector: constructed here (the
+        # robustness exposition reads it) but DRIVEN by the transports —
+        # aioserver arms the loop drift tick, the threaded entrypoint a
+        # sleep-drift thread — so in-process test apps spawn nothing
+        self.loop_lag = (
+            LoopLagMonitor(half_life_s=cfg.loop_lag_half_life_s)
+            if cfg.loop_lag_half_life_s > 0
+            else None
+        )
         # epoch-keyed answer cache in front of the batcher (serving/cache
         # .py): a bundle hot swap invalidates it wholesale because the
         # engine's epoch is the key prefix — no flush coordination needed
@@ -117,6 +135,7 @@ class RecommendApp:
                 probe_interval_s=cfg.replica_probe_interval_s,
                 redispatch_max=cfg.redispatch_max_retries,
                 metrics=self.metrics,
+                lag_monitor=self.loop_lag,
             )
         # template/static roots honor APP_PATH_FROM_ROOT like the reference
         # (rest_api/app/main.py:44-48 resolves its template/static dirs from
@@ -143,10 +162,11 @@ class RecommendApp:
     def handle(
         self, method: str, path: str, body: bytes | None,
         client_host: str | None = None,
+        trace_header: str | None = None,
     ) -> Response:
         path = path.split("?", 1)[0]
         if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
-            return self._post_recommend(body)
+            return self._post_recommend(body, trace_header)
         if method == "POST" and path == "/metrics/reset":
             # measurement-harness hook: windows the latency percentiles to
             # one replay run (VERDICT r4 #7). Guarded to loopback — a None
@@ -192,6 +212,12 @@ class RecommendApp:
                 return _json_response(
                     503, {"status": "awaiting first artifacts"}
                 )
+            if path == "/debug/traces":
+                # retained traces, JSON: the per-request WHY behind a
+                # /metrics percentile (tail-based retention — see
+                # observability/trace.py). Bounded payload: the ring caps
+                # at KMLS_TRACE_BUFFER entries.
+                return _json_response(200, self.recorder.debug_payload())
             if path == "/metrics":
                 text = self.metrics.render(
                     self.engine.reload_counter, self.engine.finished_loading,
@@ -252,6 +278,21 @@ class RecommendApp:
         state["admission_degrade_total"] = getattr(
             self.batcher, "degrade_total", 0
         )
+        # runtime health (ISSUE 9): the decayed loop/scheduler stall
+        # estimate the admission ladder also folds into pressure — 0.0
+        # with the collector disabled so the series always exists
+        state["loop_lag_ms"] = (
+            round(self.loop_lag.lag_s() * 1e3, 3)
+            if self.loop_lag is not None
+            else 0.0
+        )
+        # span-tracing bookkeeping: began is the zero-cost proof counter
+        # (must stay 0 while KMLS_TRACE_SAMPLE=0)
+        state["traces_began_total"] = self.recorder.began
+        state["traces_retained_total"] = self.recorder.retained_total
+        state["trace_buffer_entries"] = (
+            self.recorder.retained() if self.recorder.enabled else 0
+        )
         return state
 
     _STATIC_TYPES = {
@@ -308,6 +349,25 @@ class RecommendApp:
             return _json_response(400, {"detail": "Request with no songs"}), None
         return None, songs
 
+    # ---------- span tracing (ISSUE 9) ----------
+
+    def _trace_begin(self, header: str | None):
+        """→ a TraceContext for this request, or None. The one
+        ``enabled`` check is the ENTIRE per-request cost with tracing
+        disabled (KMLS_TRACE_SAMPLE=0): no context, no id generation,
+        no allocation — the recorder's ``began`` counter proves it."""
+        rec = self.recorder
+        return rec.begin(header) if rec.enabled else None
+
+    def _trace_finish(self, trace, status: str, headers: dict) -> None:
+        """Close the trace (tail-based retention decides whether it is
+        kept) and echo ``X-KMLS-Trace`` so a replay/bench client can join
+        its client-side timing to the server-side span breakdown."""
+        if trace is None:
+            return
+        self.recorder.finish(trace, status, time.perf_counter() - trace.t0)
+        headers["X-KMLS-Trace"] = trace.trace_id
+
     # ---------- degradation (the fault-tolerance contract) ----------
 
     def _deadline_for(self, t0: float) -> float | None:
@@ -332,7 +392,7 @@ class RecommendApp:
         return None
 
     def _degraded_response(
-        self, t0: float, songs: list[str], reason: str
+        self, t0: float, songs: list[str], reason: str, trace=None
     ) -> Response:
         """200 with the latency-budgeted popularity fallback and an
         ``X-KMLS-Degraded: <reason>`` header — the degradation contract:
@@ -355,6 +415,14 @@ class RecommendApp:
             },
         )
         headers["X-KMLS-Degraded"] = reason
+        if trace is not None:
+            # the ladder decision rides a span attribute: "overload" IS
+            # the admission controller's degrade rung; deadline/replica-
+            # loss degradations carry their reason the same way
+            trace.annotate("reason", reason)
+            if reason == "overload":
+                trace.annotate("admission", "degrade")
+            self._trace_finish(trace, "degraded", headers)
         return status, headers, payload
 
     def degraded_reasons(self) -> list[str]:
@@ -379,7 +447,7 @@ class RecommendApp:
                 reasons.append(f"replicas ejected: {ejected}")
         return reasons
 
-    def _recommend_error_response(self, exc: Exception) -> Response:
+    def _recommend_error_response(self, exc: Exception, trace=None) -> Response:
         if isinstance(exc, Overloaded):
             # visible backpressure, not an error: the queue projection says
             # this request would outwait the shed budget — tell the client
@@ -396,14 +464,28 @@ class RecommendApp:
             # ceils to a spread across adjacent whole seconds instead of
             # rounding every draw back to the same synchronized value
             headers["Retry-After"] = str(math.ceil(max(exc.retry_after_s, 0.0)))
+            if trace is not None:
+                trace.annotate("admission", "shed")
+                trace.annotate("retry_after_s", round(exc.retry_after_s, 3))
+                self._trace_finish(trace, "shed", headers)
             return status, headers, payload
         logger.error("recommendation failed", exc_info=exc)
         self.metrics.record_error()
-        return _json_response(500, {"detail": "Internal Server Error"})
+        status, headers, payload = _json_response(
+            500, {"detail": "Internal Server Error"}
+        )
+        if trace is not None:
+            trace.annotate("error", type(exc).__name__)
+            self._trace_finish(trace, "error", headers)
+        return status, headers, payload
 
     def _recommend_result_response(
-        self, t0: float, recs: list[str], source: str, cached: bool = False
+        self, t0: float, recs: list[str], source: str, cached: bool = False,
+        trace=None,
     ) -> Response:
+        # compose span: answer-available (the future just resolved — the
+        # caller invokes this immediately after) → response bytes built
+        t_compose = time.perf_counter() if trace is not None else 0.0
         self.metrics.record(source, time.perf_counter() - t0)
         status, headers, payload = _json_response(
             200,
@@ -417,6 +499,14 @@ class RecommendApp:
             # lets load harnesses (serving/replay.py) split cached vs
             # computed latency without guessing from timing
             headers["X-KMLS-Cache"] = "hit"
+        if trace is not None:
+            trace.span(
+                "compose", t_compose, time.perf_counter(),
+                {"source": source},
+            )
+            if cached:
+                trace.annotate("cached", True)
+            self._trace_finish(trace, "ok", headers)
         return status, headers, payload
 
     def _cache_key(self, songs: list[str]) -> tuple:
@@ -424,7 +514,9 @@ class RecommendApp:
             self.engine.bundle_epoch, songs, self.cfg.max_seed_tracks
         )
 
-    def _cache_lookup_or_lead(self, songs: list[str], deadline: float | None = None):
+    def _cache_lookup_or_lead(
+        self, songs: list[str], deadline: float | None = None, trace=None,
+    ):
         """The ONE copy of the cache front half, shared by both
         transports → ``("hit", (songs, source))`` | ``("flight",
         future)`` | ``("off", None)``. A miss joins the in-flight
@@ -442,14 +534,32 @@ class RecommendApp:
         ):
             return "off", None
         key = self._cache_key(songs)
-        hit = self.cache.get(key)
+        if trace is not None:
+            t_cache = time.perf_counter()
+            hit = self.cache.get(key)
+            trace.span(
+                "cache", t_cache, time.perf_counter(),
+                {"hit": hit is not None},
+            )
+        else:
+            hit = self.cache.get(key)
         if hit is not None:
             return "hit", hit
-        if deadline is not None:
+        if trace is not None:
+            # traced requests always use the kwarg form (test doubles
+            # with a bare submit(seeds) only run with tracing off)
+            lead = lambda: self.batcher.submit(  # noqa: E731
+                songs, deadline=deadline, trace=trace
+            )
+        elif deadline is not None:
             lead = lambda: self.batcher.submit(songs, deadline=deadline)  # noqa: E731
         else:
             lead = lambda: self.batcher.submit(songs)  # noqa: E731
         future, joined = self.cache.join_or_lead(key, lead)
+        if joined and trace is not None:
+            # a joiner shares the leader's batch slot: it gets no
+            # queue/device spans of its own (it never dispatched)
+            trace.annotate("singleflight", "joined")
         if not joined:
             cache = self.cache
             future.add_done_callback(lambda f: cache.finish(key, f))
@@ -463,14 +573,14 @@ class RecommendApp:
         return "flight", future
 
     def recommend_direct(
-        self, songs: list[str]
+        self, songs: list[str], trace=None
     ) -> tuple[list[str], str, bool]:
         """Blocking cached recommend → ``(songs, source, cache_hit)``.
         Used by the threaded POST path and the in-process replay harness;
         raises (Overloaded, DeadlineExceeded, NoHealthyReplicas included)
         like the underlying batcher/engine."""
         deadline = self._deadline_for(time.perf_counter())
-        state, payload = self._cache_lookup_or_lead(songs, deadline)
+        state, payload = self._cache_lookup_or_lead(songs, deadline, trace)
         if state == "hit":
             return payload[0], payload[1], True
         if state == "flight":
@@ -487,7 +597,11 @@ class RecommendApp:
                 raise
             return recs, source, False
         if self.batcher is not None:
-            if deadline is not None and hasattr(self.batcher, "submit"):
+            if trace is not None and hasattr(self.batcher, "submit"):
+                recs, source = self.batcher.recommend(
+                    songs, deadline=deadline, trace=trace
+                )
+            elif deadline is not None and hasattr(self.batcher, "submit"):
                 recs, source = self.batcher.recommend(songs, deadline=deadline)
             else:
                 recs, source = self.batcher.recommend(songs)
@@ -497,30 +611,39 @@ class RecommendApp:
             self.cache.put(self._cache_key(songs), (recs, source))
         return recs, source, False
 
-    def _post_recommend(self, body: bytes | None) -> Response:
+    def _post_recommend(
+        self, body: bytes | None, trace_header: str | None = None
+    ) -> Response:
         t0 = time.perf_counter()
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err
+        # trace begins AFTER validation: malformed bodies never allocate
+        trace = self._trace_begin(trace_header)
         try:
-            recs, source, cached = self.recommend_direct(songs)
+            recs, source, cached = self.recommend_direct(songs, trace=trace)
         except Exception as exc:
             reason = self._degrade_reason(exc)
             if reason is not None:
                 # deadline exhausted or every replica ejected: answer
                 # from the popularity fallback (X-KMLS-Degraded), not 5xx
-                return self._degraded_response(t0, songs, reason)
-            return self._recommend_error_response(exc)
-        return self._recommend_result_response(t0, recs, source, cached=cached)
+                return self._degraded_response(t0, songs, reason, trace=trace)
+            return self._recommend_error_response(exc, trace=trace)
+        return self._recommend_result_response(
+            t0, recs, source, cached=cached, trace=trace
+        )
 
     # ---------- async-transport entry points ----------
 
-    def submit_recommend(self, body: bytes | None):
+    def submit_recommend(self, body: bytes | None, trace_header: str | None = None):
         """Non-blocking twin of :meth:`_post_recommend` for the asyncio
-        transport: → ``(response, None, t0)`` when the answer is immediate
-        (validation error, cache hit, shed, or the unbatched path), else
-        ``(None, future, t0)`` — resolve the future off-loop and build the
-        reply with :meth:`finish_recommend`.
+        transport: → ``(response, None, t0, trace)`` when the answer is
+        immediate (validation error, cache hit, shed, or the unbatched
+        path), else ``(None, future, t0, trace)`` — resolve the future
+        off-loop and build the reply with :meth:`finish_recommend`.
+        ``trace`` rides the TUPLE, not the future: singleflight shares
+        one future across joined connections, and each connection's trace
+        is its own.
 
         Cache semantics mirror :meth:`recommend_direct`: hit → immediate
         response; miss → singleflight through the batcher, so concurrent
@@ -531,44 +654,63 @@ class RecommendApp:
         t0 = time.perf_counter()
         err, songs = self._validate_recommend(body)
         if err is not None:
-            return err, None, t0
+            return err, None, t0, None
+        trace = self._trace_begin(trace_header)
         deadline = self._deadline_for(t0)
         if self.batcher is None:
             try:
-                recs, source, cached = self.recommend_direct(songs)
+                recs, source, cached = self.recommend_direct(songs, trace=trace)
             except Exception as exc:
                 reason = self._degrade_reason(exc)
                 if reason is not None:
-                    return self._degraded_response(t0, songs, reason), None, t0
-                return self._recommend_error_response(exc), None, t0
+                    return (
+                        self._degraded_response(t0, songs, reason, trace=trace),
+                        None, t0, None,
+                    )
+                return (
+                    self._recommend_error_response(exc, trace=trace),
+                    None, t0, None,
+                )
             return (
-                self._recommend_result_response(t0, recs, source, cached=cached),
-                None, t0,
+                self._recommend_result_response(
+                    t0, recs, source, cached=cached, trace=trace
+                ),
+                None, t0, None,
             )
         try:
-            state, payload = self._cache_lookup_or_lead(songs, deadline)
+            state, payload = self._cache_lookup_or_lead(songs, deadline, trace)
             if state == "off":
-                if deadline is not None:
+                if trace is not None:
+                    future = self.batcher.submit(
+                        songs, deadline=deadline, trace=trace
+                    )
+                elif deadline is not None:
                     future = self.batcher.submit(songs, deadline=deadline)
                 else:
                     future = self.batcher.submit(songs)
                 future._kmls_seeds = songs
-                return None, future, t0
+                return None, future, t0, trace
         except Exception as exc:  # Overloaded / NoHealthyReplicas land here
             reason = self._degrade_reason(exc)
             if reason is not None:
-                return self._degraded_response(t0, songs, reason), None, t0
-            return self._recommend_error_response(exc), None, t0
+                return (
+                    self._degraded_response(t0, songs, reason, trace=trace),
+                    None, t0, None,
+                )
+            return (
+                self._recommend_error_response(exc, trace=trace),
+                None, t0, None,
+            )
         if state == "hit":
             return (
                 self._recommend_result_response(
-                    t0, payload[0], payload[1], cached=True
+                    t0, payload[0], payload[1], cached=True, trace=trace
                 ),
-                None, t0,
+                None, t0, None,
             )
-        return None, payload, t0
+        return None, payload, t0, trace
 
-    def finish_recommend(self, future, t0: float) -> Response:
+    def finish_recommend(self, future, t0: float, trace=None) -> Response:
         """Build the response for a completed :meth:`submit_recommend`
         future (which is done — ``result()`` never blocks here). A future
         resolved to DeadlineExceeded/NoHealthyReplicas degrades to the
@@ -579,9 +721,9 @@ class RecommendApp:
             reason = self._degrade_reason(exc)
             if reason is not None:
                 songs = getattr(future, "_kmls_seeds", None) or []
-                return self._degraded_response(t0, songs, reason)
-            return self._recommend_error_response(exc)
-        return self._recommend_result_response(t0, recs, source)
+                return self._degraded_response(t0, songs, reason, trace=trace)
+            return self._recommend_error_response(exc, trace=trace)
+        return self._recommend_result_response(t0, recs, source, trace=trace)
 
     def _get_client(self) -> Response:
         """Render the HTML test client with a sampled seed + static sample
@@ -803,6 +945,7 @@ def make_handler(app: RecommendApp):
                     status, headers, payload = app.handle(
                         method, self.path, body,
                         client_host=self.client_address[0],
+                        trace_header=self.headers.get("X-KMLS-Trace"),
                     )
                 except Exception:
                     logger.exception("unhandled error for %s %s", method, self.path)

@@ -191,6 +191,7 @@ class AdmissionController:
         retry_after_s: float = 1.0,
         retry_jitter: float = 0.5,
         rng: random.Random | None = None,
+        lag_source=None,
     ):
         self.budget_s = budget_s
         self.soft_ratio = max(0.0, soft_ratio)
@@ -203,6 +204,15 @@ class AdmissionController:
         # decay half-life: one budget width (floored so a sub-ms budget
         # doesn't make the memory vanish between completions)
         self._half_life_s = max(budget_s, 0.25)
+        # runtime-health fold (ISSUE 9, closing the PR 8 inline-path
+        # blind spot): an optional zero-arg callable returning the
+        # current event-loop/scheduler stall estimate in SECONDS
+        # (observability.runtime.LoopLagMonitor.lag_s). A wedged loop
+        # means requests are ALREADY waiting at least that long in the
+        # socket backlog where the queue projection cannot see them, so
+        # the stall is an effective-wait floor — it escalates the
+        # degrade→shed ladder exactly like a saturated queue.
+        self._lag_source = lag_source
 
     def note_queue_wait(self, wait_s: float, now: float | None = None) -> None:
         """Completion-side: fold an admitted request's MEASURED queue wait
@@ -229,11 +239,17 @@ class AdmissionController:
         return self._wait_ewma * math.exp(-age * math.log(2) / self._half_life_s)
 
     def pressure(self, projected_s: float, now: float | None = None) -> float:
-        """Effective queue wait over the budget (0 with shedding off)."""
+        """Effective queue wait over the budget (0 with shedding off).
+        The effective wait is the max of the instantaneous projection,
+        the measured queue-wait EWMA, and — when a lag source is wired —
+        the decayed event-loop stall estimate."""
         if self.budget_s <= 0.0:
             return 0.0
         now = time.perf_counter() if now is None else now
-        return max(projected_s, self._decayed_wait(now)) / self.budget_s
+        wait = max(projected_s, self._decayed_wait(now))
+        if self._lag_source is not None:
+            wait = max(wait, self._lag_source())
+        return wait / self.budget_s
 
     def decide(self, projected_s: float) -> tuple[str, float]:
         """→ ``(decision, pressure)`` for a request seeing ``projected_s``
@@ -288,6 +304,10 @@ class _Pending:
     # request has been re-dispatched after a replica failure
     deadline: float | None = None
     retries: int = 0
+    # per-request TraceContext (observability.trace) riding the pipeline
+    # so completion can record queue/device spans; None = untraced — the
+    # default, costing nothing (tracing-off requests never construct one)
+    trace: object | None = None
 
 
 class MicroBatcher:
@@ -309,6 +329,7 @@ class MicroBatcher:
         probe_interval_s: float = 5.0,
         redispatch_max: int = 2,
         metrics=None,
+        lag_monitor=None,
     ):
         self.engine = engine
         self.max_size = max_size
@@ -317,12 +338,17 @@ class MicroBatcher:
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
         self.shed_budget_s = shed_queue_budget_ms / 1e3
         self.shed_retry_after_s = shed_retry_after_s
+        # runtime-health signal (observability.runtime.LoopLagMonitor):
+        # folded into admission pressure so a host-scheduling stall the
+        # queue projection can't see still escalates the ladder
+        self.lag_monitor = lag_monitor
         self._admission = AdmissionController(
             self.shed_budget_s,
             soft_ratio=shed_soft_ratio,
             hard_ratio=shed_hard_ratio,
             retry_after_s=shed_retry_after_s,
             retry_jitter=shed_retry_jitter,
+            lag_source=lag_monitor.lag_s if lag_monitor is not None else None,
         )
         self.metrics = metrics
         self.shed_total = 0
@@ -555,12 +581,16 @@ class MicroBatcher:
             span = self._arrivals[-1] - self._arrivals[0]
         return span / (n - 1)
 
-    def submit(self, seeds: list[str], deadline: float | None = None) -> Future:
+    def submit(
+        self, seeds: list[str], deadline: float | None = None, trace=None,
+    ) -> Future:
         """Non-blocking admission: shed-or-enqueue, → the request's
         Future. The async transport resolves it via a done-callback; the
         threaded transport blocks on it in :meth:`recommend`.
         ``deadline`` (perf_counter seconds) rides the pending entry
-        through collection and dispatch."""
+        through collection and dispatch; ``trace`` (a TraceContext, None
+        when tracing is off) rides it so completion can record the
+        queue/device spans."""
         now = time.perf_counter()
         with self._rate_lock:
             self._arrivals.append(now)
@@ -606,16 +636,17 @@ class MicroBatcher:
                 # deadline/replica-loss reasons)
                 raise OverloadDegraded(pressure)
         pending = _Pending(
-            seeds=seeds, future=Future(), t_enqueue=now, deadline=deadline
+            seeds=seeds, future=Future(), t_enqueue=now, deadline=deadline,
+            trace=trace,
         )
         self._queue.put((1, next(self._seq), pending))
         return pending.future
 
     def recommend(
         self, seeds: list[str], timeout: float = 30.0,
-        deadline: float | None = None,
+        deadline: float | None = None, trace=None,
     ) -> tuple[list[str], str]:
-        future = self.submit(seeds, deadline=deadline)
+        future = self.submit(seeds, deadline=deadline, trace=trace)
         if deadline is not None:
             timeout = max(deadline - time.perf_counter(), 0.0)
         try:
@@ -793,6 +824,18 @@ class MicroBatcher:
             self._admission.note_queue_wait(
                 t_dispatch - batch[0].t_enqueue, now=t_complete
             )
+            # span recording BEFORE the futures resolve: the finishing
+            # thread (app layer) must observe a complete span list when
+            # the result lands (TraceContext's documented ordering)
+            for pending in batch:
+                if pending.trace is not None:
+                    pending.trace.span(
+                        "queue", pending.t_enqueue, t_dispatch,
+                        {"batch": len(batch)},
+                    )
+                    pending.trace.span(
+                        "device", t_dispatch, t_complete, {"replica": idx},
+                    )
             for pending, result in zip(batch, results):
                 if not pending.future.done():  # deadline may have expired it
                     pending.future.set_result(result)
@@ -939,6 +982,7 @@ class AsyncMicroBatcher:
         probe_interval_s: float = 5.0,
         redispatch_max: int = 2,
         metrics=None,
+        lag_monitor=None,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
@@ -950,12 +994,20 @@ class AsyncMicroBatcher:
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
         self.shed_budget_s = shed_queue_budget_ms / 1e3
         self.shed_retry_after_s = shed_retry_after_s
+        # runtime health: the inline native path computes ON the loop, so
+        # a stalled kernel blocks the loop itself and backpressure piles
+        # into the socket backlog where the queue projection is blind
+        # (the PR 8 postmortem). The inline branch reports its measured
+        # in-line compute time here — the synchronous ground truth — and
+        # the controller folds the decayed peak into pressure.
+        self.lag_monitor = lag_monitor
         self._admission = AdmissionController(
             self.shed_budget_s,
             soft_ratio=shed_soft_ratio,
             hard_ratio=shed_hard_ratio,
             retry_after_s=shed_retry_after_s,
             retry_jitter=shed_retry_jitter,
+            lag_source=lag_monitor.lag_s if lag_monitor is not None else None,
         )
         self.metrics = metrics
         self.shed_total = 0
@@ -1095,7 +1147,7 @@ class AsyncMicroBatcher:
     # ---------- admission (loop thread only) ----------
 
     def submit(
-        self, seeds: list[str], deadline: float | None = None
+        self, seeds: list[str], deadline: float | None = None, trace=None,
     ) -> "asyncio.Future":
         import asyncio
 
@@ -1127,7 +1179,8 @@ class AsyncMicroBatcher:
                 raise OverloadDegraded(pressure)
         future = loop.create_future()
         pending = _Pending(
-            seeds=seeds, future=future, t_enqueue=now, deadline=deadline
+            seeds=seeds, future=future, t_enqueue=now, deadline=deadline,
+            trace=trace,
         )
         self._pending.append(pending)
         if deadline is not None:
@@ -1249,6 +1302,13 @@ class AsyncMicroBatcher:
                 outcome = (finish(), None)
             except Exception as exc:
                 outcome = (None, exc)
+            if self.lag_monitor is not None:
+                # direct stall note: this finish() just blocked the loop
+                # for exactly this long — report it NOW (the drift tick
+                # only sees it one loop iteration later), so a 200 ms
+                # kernel stall escalates admission before the next
+                # request is even parsed
+                self.lag_monitor.note(time.perf_counter() - t_dispatch)
             self._resolve(batch, outcome, t_dispatch, loop, idx)
             return
 
@@ -1294,6 +1354,17 @@ class AsyncMicroBatcher:
                 self._admission.note_queue_wait(
                     t_dispatch - batch[0].t_enqueue, now=t_complete
                 )
+            # spans recorded before the futures resolve (mirrors the
+            # threaded completer's ordering contract)
+            for pending in batch:
+                if pending.trace is not None:
+                    pending.trace.span(
+                        "queue", pending.t_enqueue, t_dispatch,
+                        {"batch": len(batch)},
+                    )
+                    pending.trace.span(
+                        "device", t_dispatch, t_complete, {"replica": idx},
+                    )
             for pending, result in zip(batch, results):
                 if not pending.future.done():
                     pending.future.set_result(result)

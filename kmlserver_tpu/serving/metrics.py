@@ -13,12 +13,98 @@ instead of only that one exists.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 
 # every summary rendered below carries these quantiles; p999 needs the
 # larger reservoir to mean anything (16384 samples → ~16 above p999)
 _QUANTILES = (0.50, 0.95, 0.99, 0.999)
+
+# ---------------------------------------------------------------------------
+# The metric-series registry — THE declaration point for every exported
+# Prometheus series, serving (`GET /metrics`) and mining (the
+# `pickles/job_metrics.prom` textfile) alike. Values are "<type>:<scope>"
+# with type ∈ counter/gauge/summary/histogram and scope ∈ serving/mining.
+#
+# kmls-verify's `metrics` checker (kmlserver_tpu/analysis/metricsreg.py)
+# enforces, in CI: every series name spelled in the exposition modules
+# (this file, observability/jobmetrics.py, and the app's dynamically
+# rendered robustness keys) is declared here with a valid type+scope and
+# has a README row; and the inverse — a registry entry nothing renders is
+# an orphan. The mining textfile writer additionally looks its names up
+# HERE at render time, so the two exposition surfaces can never drift
+# from one declaration the way KNOB_REGISTRY keeps env knobs honest.
+# Adding a series = render it, add an entry here, and a README table row
+# — or CI's verify job rejects the diff, naming exactly what is missing.
+# ---------------------------------------------------------------------------
+METRIC_REGISTRY: dict[str, str] = {
+    # --- serving: request counters ---
+    "kmls_requests_total": "counter:serving",
+    "kmls_request_errors_total": "counter:serving",
+    "kmls_requests_shed_total": "counter:serving",
+    "kmls_requests_by_source": "counter:serving",
+    # --- serving: latency (reservoir summaries for bench windowing,
+    # fixed-bucket histograms for fleet aggregation — see ISSUE 9) ---
+    "kmls_request_latency_seconds": "summary:serving",
+    "kmls_queue_wait_ms": "summary:serving",
+    "kmls_device_ms": "summary:serving",
+    "kmls_e2e_ms": "summary:serving",
+    "kmls_queue_wait_seconds": "histogram:serving",
+    "kmls_device_seconds": "histogram:serving",
+    "kmls_e2e_seconds": "histogram:serving",
+    # --- serving: recommendation cache ---
+    "kmls_cache_hits_total": "counter:serving",
+    "kmls_cache_misses_total": "counter:serving",
+    "kmls_cache_evictions_total": "counter:serving",
+    "kmls_cache_singleflight_joins_total": "counter:serving",
+    "kmls_cache_entries": "gauge:serving",
+    "kmls_cache_hit_ratio": "gauge:serving",
+    # --- serving: dispatch / layout ---
+    "kmls_device_dispatch_total": "counter:serving",
+    "kmls_shard_dispatch_total": "counter:serving",
+    "kmls_model_shards": "gauge:serving",
+    # --- serving: fault tolerance / overload ---
+    "kmls_degraded_total": "counter:serving",
+    "kmls_degraded_by_reason": "counter:serving",
+    "kmls_replica_ejections_total": "counter:serving",
+    "kmls_replica_readmissions_total": "counter:serving",
+    "kmls_redispatch_total": "counter:serving",
+    "kmls_artifact_quarantines_total": "counter:serving",
+    "kmls_reload_failures_total": "counter:serving",
+    "kmls_reload_consecutive_failures": "gauge:serving",
+    "kmls_embedding_active": "gauge:serving",
+    "kmls_embedding_load_failures_total": "counter:serving",
+    "kmls_replicas_ejected": "gauge:serving",
+    "kmls_utilization": "gauge:serving",
+    "kmls_admission_degrade_total": "counter:serving",
+    # --- serving: observability (ISSUE 9) ---
+    # peak-hold event-loop/scheduler stall estimate, decayed — the
+    # runtime-health signal the admission ladder also folds in
+    "kmls_loop_lag_ms": "gauge:serving",
+    "kmls_traces_began_total": "counter:serving",
+    "kmls_traces_retained_total": "counter:serving",
+    "kmls_trace_buffer_entries": "gauge:serving",
+    # --- serving: lifecycle ---
+    "kmls_reloads_total": "counter:serving",
+    "kmls_finished_loading": "gauge:serving",
+    "kmls_uptime_seconds": "gauge:serving",
+    # --- mining: the job_metrics.prom textfile (observability/
+    # jobmetrics.py — node-exporter textfile-collector format; gauges
+    # because a batch job's file restarts from scratch every run, so
+    # counter delta semantics would lie across runs) ---
+    "kmls_job_phase_duration_seconds": "gauge:mining",
+    "kmls_job_phase_resumed": "gauge:mining",
+    "kmls_job_rows": "gauge:mining",
+    "kmls_job_playlists": "gauge:mining",
+    "kmls_job_tracks": "gauge:mining",
+    "kmls_job_artifact_bytes": "gauge:mining",
+    "kmls_job_rule_generation_seconds": "gauge:mining",
+    "kmls_job_fencing_token": "gauge:mining",
+    "kmls_job_duration_seconds": "gauge:mining",
+    "kmls_job_success": "gauge:mining",
+    "kmls_job_last_success_timestamp_seconds": "gauge:mining",
+}
 
 # The autoscaling signal (ISSUE 8): the gauge kubernetes/hpa.yaml scales
 # the API fleet on, derived by the batcher from its queue/device latency
@@ -45,10 +131,16 @@ class LatencyReservoir:
             self._n += 1
 
     def percentiles(self, *qs: float) -> list[float]:
+        # COPY under the lock, sort OUTSIDE it (ISSUE 9 satellite): the
+        # sort is O(n log n) over up to 16384 floats — holding the observe
+        # lock through it would stall every request thread mid-record on
+        # each scrape. The slice is a snapshot; a concurrent observe
+        # racing the copy costs at most one sample's visibility.
         with self._lock:
-            live = sorted(self._buf[: min(self._n, len(self._buf))])
+            live = self._buf[: min(self._n, len(self._buf))]
         if not live:
             return [0.0 for _ in qs]
+        live.sort()
         return [live[min(int(q * len(live)), len(live) - 1)] for q in qs]
 
     def reset(self) -> int:
@@ -57,6 +149,86 @@ class LatencyReservoir:
             n = self._n
             self._n = 0
         return n
+
+
+# default latency buckets (seconds): sub-ms resolution where the serving
+# p50 lives (0.4–5 ms on the CPU replay record), decade coverage out to
+# the deadline/backoff regime. Shared across every replica of a fleet —
+# fixed buckets are the whole point: per-pod `_bucket` counters SUM
+# across replicas, which per-pod reservoir quantiles never can.
+LATENCY_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket Prometheus histogram (`_bucket`/`_sum`/`_count`).
+
+    The reservoirs above answer "what is THIS pod's p99 right now"
+    (bench windowing — they reset per run); this histogram answers the
+    fleet question: bucket counters are cumulative and additive across
+    replicas, so `histogram_quantile(0.99, sum(rate(..._bucket[5m])) by
+    (le))` is the aggregation the ROADMAP's millions-of-users fleet
+    needs and reservoir quantiles mathematically cannot provide.
+    Deliberately NOT reset by the bench's `/metrics/reset` — counters
+    keep scrape-delta semantics."""
+
+    def __init__(self, buckets: tuple[float, ...] = LATENCY_BUCKETS_S):
+        self.buckets = tuple(buckets)
+        # counts[i] = observations <= buckets[i]; counts[-1] = +Inf band
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        idx = bisect.bisect_left(self.buckets, seconds)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += seconds
+            self._count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def quantile(self, q: float) -> float:
+        """Bucket-derived quantile (histogram_quantile semantics: linear
+        interpolation inside the winning bucket; the +Inf band answers
+        its finite lower edge). Used by the test pinning histogram
+        quantiles against reservoir quantiles — and by nothing on any
+        hot path."""
+        counts, _total_sum, n = self.snapshot()
+        if n == 0:
+            return 0.0
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                cum += c
+                continue
+            if cum + c >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i] if i < len(self.buckets) else lo
+                frac = (target - cum) / c
+                return lo + frac * (hi - lo)
+            cum += c
+        return self.buckets[-1]
+
+    def render(self, name: str) -> list[str]:
+        counts, total_sum, n = self.snapshot()
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for bound, count in zip(self.buckets, counts):
+            cum += count
+            lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
+        lines += [
+            f'{name}_bucket{{le="+Inf"}} {n}',
+            f"{name}_sum {total_sum:.6f}",
+            f"{name}_count {n}",
+        ]
+        return lines
 
 
 class ServingMetrics:
@@ -84,6 +256,11 @@ class ServingMetrics:
         self.queue_wait = LatencyReservoir()
         self.device = LatencyReservoir()
         self.e2e = LatencyReservoir()
+        # the same attributions as fixed-bucket histograms: reservoirs
+        # window per-pod bench runs, histograms aggregate across a fleet
+        self.queue_wait_hist = LatencyHistogram()
+        self.device_hist = LatencyHistogram()
+        self.e2e_hist = LatencyHistogram()
         self._lock = threading.Lock()
 
     def record(self, source: str, seconds: float) -> None:
@@ -126,6 +303,9 @@ class ServingMetrics:
         self.queue_wait.observe(queue_wait_s)
         self.device.observe(device_s)
         self.e2e.observe(e2e_s)
+        self.queue_wait_hist.observe(queue_wait_s)
+        self.device_hist.observe(device_s)
+        self.e2e_hist.observe(e2e_s)
 
     def reset_latency(self) -> int:
         """Clear the latency + attribution reservoirs (→ request-latency
@@ -133,7 +313,10 @@ class ServingMetrics:
 
         Lets a measurement harness window the percentiles to one replay
         run (VERDICT r4 #7). The Prometheus counters stay cumulative —
-        resetting counters would break scrape-delta semantics."""
+        resetting counters would break scrape-delta semantics — and the
+        attribution HISTOGRAMS stay with the counters: their buckets ARE
+        counters (fleet aggregation depends on scrape deltas), so only
+        the reservoirs window."""
         n = self.latency.reset()
         self.queue_wait.reset()
         self.device.reset()
@@ -186,6 +369,13 @@ class ServingMetrics:
         lines += self._summary_ms("kmls_queue_wait_ms", self.queue_wait)
         lines += self._summary_ms("kmls_device_ms", self.device)
         lines += self._summary_ms("kmls_e2e_ms", self.e2e)
+        # the same attributions as fixed-bucket histograms (seconds):
+        # `_bucket` counters sum across replicas, so the fleet's
+        # histogram_quantile works where per-pod reservoir quantiles
+        # cannot aggregate (ISSUE 9)
+        lines += self.queue_wait_hist.render("kmls_queue_wait_seconds")
+        lines += self.device_hist.render("kmls_device_seconds")
+        lines += self.e2e_hist.render("kmls_e2e_seconds")
         if cache is not None:
             # epoch-keyed recommendation cache: hit/miss/evict counters +
             # the hit-ratio gauge the 10k-QPS claim is judged on
@@ -246,13 +436,6 @@ class ServingMetrics:
             "# TYPE kmls_redispatch_total counter",
             f"kmls_redispatch_total {redispatches}",
         ]
-        if robustness:
-            for name, value in robustness.items():
-                mtype = "counter" if name.endswith("_total") else "gauge"
-                lines += [
-                    f"# TYPE kmls_{name} {mtype}",
-                    f"kmls_{name} {value}",
-                ]
         lines += [
             "# TYPE kmls_reloads_total counter",
             f"kmls_reloads_total {reload_counter}",
@@ -261,4 +444,29 @@ class ServingMetrics:
             "# TYPE kmls_uptime_seconds gauge",
             f"kmls_uptime_seconds {uptime:.1f}",
         ]
+        if robustness:
+            # dedupe by series name (ISSUE 9 satellite): a robustness key
+            # colliding with a statically rendered series (e.g. a
+            # `degraded_total` entry vs kmls_degraded_total above) must
+            # not emit a second `# TYPE` line — duplicate TYPE for one
+            # name is invalid exposition and breaks strict scrapers. The
+            # static rendering wins; the colliding dynamic entry is
+            # dropped whole (its VALUE would be a second unlabeled sample
+            # of the same series, equally invalid). The dynamic block
+            # renders LAST so this set covers every static series.
+            typed = {
+                line.split(" ", 3)[2]
+                for line in lines
+                if line.startswith("# TYPE ")
+            }
+            for name, value in robustness.items():
+                full = f"kmls_{name}"
+                if full in typed:
+                    continue
+                typed.add(full)
+                mtype = "counter" if name.endswith("_total") else "gauge"
+                lines += [
+                    f"# TYPE {full} {mtype}",
+                    f"{full} {value}",
+                ]
         return "\n".join(lines) + "\n"

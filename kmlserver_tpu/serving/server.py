@@ -66,6 +66,11 @@ def main() -> int:
         from .aioserver import run_async
 
         return asyncio.run(run_async(app, cfg.port))
+    if app.loop_lag is not None:
+        # sleep-drift thread: the threaded transport's analogue of the
+        # async drift tick — host-scheduling stalls (CPU starvation, GIL
+        # convoy) surface as the same kmls_loop_lag_ms signal
+        app.loop_lag.start_thread()
     server = serve(app)
     host, port = server.server_address[:2]
     log.info("serving on %s:%d (version %s)", host, port, cfg.version)

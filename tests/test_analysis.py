@@ -545,6 +545,170 @@ def test_exit_codes_flags_new_code_without_policy(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# checker 7: metric-series registry (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+_METRICS_GOOD = '''
+    """Docstring naming kmls_prose_only_series must demand nothing."""
+
+    METRIC_REGISTRY: dict[str, str] = {
+        "kmls_good_total": "counter:serving",
+        "kmls_lat_seconds": "histogram:serving",
+        "kmls_job_thing": "gauge:mining",
+        "kmls_dyn_state": "gauge:serving",
+    }
+
+    def render(n):
+        return "\\n".join([
+            "# TYPE kmls_good_total counter",
+            f"kmls_good_total {n}",
+            # histogram children are implementation suffixes, never
+            # their own declarations
+            "# TYPE kmls_lat_seconds histogram",
+            'kmls_lat_seconds_bucket{le="+Inf"} 1',
+            "kmls_lat_seconds_sum 0.5",
+            "kmls_lat_seconds_count 1",
+        ])
+    '''
+
+_JOBM_GOOD = """
+    def render(v):
+        return f"# TYPE kmls_job_thing gauge\\nkmls_job_thing {v}"
+    """
+
+_DYN_APP_GOOD = """
+    class App:
+        def state(self):
+            out = {"dyn_state": 1.0}
+            return out
+    """
+
+
+def _metrics_cfg(**overrides):
+    return fixture_cfg(
+        metrics_file="pkg/metrics.py",
+        metric_exposition_files={
+            "pkg/metrics.py": "serving",
+            "pkg/jobm.py": "mining",
+        },
+        metric_dynamic_sources=(
+            ("pkg/app.py::App.state", "kmls_", "serving"),
+        ),
+        **overrides,
+    )
+
+
+def _metrics_tree(tmp_path, metrics=_METRICS_GOOD, jobm=_JOBM_GOOD,
+                  app=_DYN_APP_GOOD,
+                  readme="kmls_good_total kmls_lat_seconds "
+                         "kmls_job_thing kmls_dyn_state"):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/metrics.py": metrics,
+            "pkg/jobm.py": jobm,
+            "pkg/app.py": app,
+            "README.md": readme + "\n",
+        },
+    )
+
+
+def test_metrics_quiet_when_registry_and_exposition_agree(tmp_path):
+    _metrics_tree(tmp_path)
+    result = run_fixture(tmp_path, _metrics_cfg(), ["metrics"])
+    assert result["findings"] == []
+
+
+def test_metrics_flags_unregistered_orphan_and_undocumented(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        metrics=_METRICS_GOOD.replace(
+            '"kmls_good_total": "counter:serving",',
+            '"kmls_orphan_gauge": "gauge:serving",',
+        ),
+        readme="kmls_lat_seconds kmls_job_thing kmls_dyn_state "
+               "kmls_orphan_gauge",
+    )
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert "unregistered:kmls_good_total" in got
+    assert "orphan:kmls_orphan_gauge" in got
+    # registered + rendered but missing its README row
+    _metrics_tree(tmp_path, readme="kmls_lat_seconds kmls_job_thing "
+                                   "kmls_dyn_state")
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert got == {"undocumented:kmls_good_total"}
+
+
+def test_metrics_flags_malformed_entry_and_swapped_scope(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        metrics=_METRICS_GOOD.replace(
+            '"kmls_job_thing": "gauge:mining",',
+            '"kmls_job_thing": "gauge:serving",\n'
+            '        "kmls_bad": "histo:everywhere",',
+        ),
+        readme="kmls_good_total kmls_lat_seconds kmls_job_thing "
+               "kmls_dyn_state kmls_bad",
+    )
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert "bad-entry:kmls_bad" in got
+    # the mining textfile module renders a series registered as serving
+    assert "scope-mismatch:kmls_job_thing" in got
+
+
+def test_metrics_flags_mismatch_on_second_exposition_surface(tmp_path):
+    """A series BOTH surfaces render is checked at each surface: the
+    serving-registered series leaking into the mining textfile must be
+    flagged even though the serving module renders it first (and
+    legitimately)."""
+    _metrics_tree(
+        tmp_path,
+        jobm='''
+    def render(v):
+        return (f"# TYPE kmls_job_thing gauge\\nkmls_job_thing {v}\\n"
+                "# TYPE kmls_good_total counter\\nkmls_good_total 0")
+    ''',
+    )
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert got == {"scope-mismatch:kmls_good_total"}
+
+
+def test_metrics_sees_dynamically_rendered_dict_keys(tmp_path):
+    """The robustness-dict path: a key added to the dynamic source's
+    dict is an exported series (prefixed at render time) and must be
+    registered like any literal."""
+    _metrics_tree(
+        tmp_path,
+        app=_DYN_APP_GOOD.replace(
+            'out = {"dyn_state": 1.0}',
+            'out = {"dyn_state": 1.0}\n'
+            '            out["dyn_rogue"] = 2.0',
+        ),
+    )
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert got == {"unregistered:kmls_dyn_rogue"}
+
+
+def test_metrics_registry_keys_do_not_keep_themselves_alive(tmp_path):
+    """The registry dict's own span is excluded from exposition
+    collection — an entry whose only mention is its own key line is an
+    orphan, not a live series."""
+    _metrics_tree(
+        tmp_path,
+        metrics=_METRICS_GOOD.replace(
+            '"kmls_dyn_state": "gauge:serving",',
+            '"kmls_dyn_state": "gauge:serving",\n'
+            '        "kmls_self_ref": "gauge:serving",',
+        ),
+        app=_DYN_APP_GOOD,
+        readme="kmls_good_total kmls_lat_seconds kmls_job_thing "
+               "kmls_dyn_state kmls_self_ref",
+    )
+    got = keys(run_fixture(tmp_path, _metrics_cfg(), ["metrics"]), "metrics")
+    assert got == {"orphan:kmls_self_ref"}
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip + CLI gate
 # ---------------------------------------------------------------------------
 
@@ -756,6 +920,27 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     assert {
         "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact"
     } <= sites
+    # checker 7 anchors (ISSUE 9): the registry parses without import,
+    # both exposition modules are indexed, and the dynamic robustness
+    # source still resolves — a rename would silently hollow the checker
+    from kmlserver_tpu.analysis.metricsreg import (
+        collect_exposed_series,
+        parse_metric_registry,
+    )
+
+    entries, _lines, _line = parse_metric_registry(index, cfg)
+    assert len(entries) >= 40, sorted(entries)
+    refs = collect_exposed_series(index, cfg)
+    assert set(refs) == set(entries), (
+        set(refs) ^ set(entries)
+    )  # the real tree has no orphans in either direction
+    for ref, _prefix, _scope in cfg.metric_dynamic_sources:
+        assert index.function(ref) is not None, ref
+    assert any(
+        relpath == "kmlserver_tpu/observability/jobmetrics.py"
+        for surfaces in refs.values()
+        for relpath, _line2, _scope in surfaces
+    ), "the mining textfile exposition module fell out of the index"
 
 
 def test_cli_exit_codes(tmp_path):
@@ -781,7 +966,8 @@ def test_cli_exit_codes(tmp_path):
 
 @pytest.mark.parametrize(
     "checker",
-    ["hotpath", "locks", "atomic-write", "knobs", "fault-sites", "exit-codes"],
+    ["hotpath", "locks", "atomic-write", "knobs", "fault-sites",
+     "exit-codes", "metrics"],
 )
 def test_every_checker_registered(checker):
     from kmlserver_tpu.analysis.core import all_checkers

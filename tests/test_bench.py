@@ -401,6 +401,12 @@ class TestTpuSuiteWiring:
             "max_itemset_len": 3, "confidence_mode": "confidence",
             "platform": "cpu",
         },
+        "traceoverhead": {
+            "qps": 1000.0, "requests": 6000, "p99_on_ms": 5.1,
+            "p99_off_ms": 5.0, "p99_ratio": 1.02, "p50_on_ms": 1.1,
+            "p50_off_ms": 1.1, "began_off": 0, "began_on": 60,
+            "retained_on": 48, "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -924,6 +930,7 @@ class TestBenchStateResume:
         assert bench.run_tpu_suite(em, str(npz1)) == canned["mining"]
         banked = json.loads(Path(state_path).read_text())["phases"]
         assert set(banked) == {
+            "traceoverhead_cpu",
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
@@ -1225,6 +1232,40 @@ class TestCompactLine:
         assert parsed["loadshape_p99_ms"] == 4.745
         assert parsed["loadshape_http_5xx"] == 0
         assert parsed["loadshape_flip_epoch_moved"] == 1
+
+    def test_record_traceoverhead_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-9 tracing-overhead bracket's judged keys (sampled
+        p99 within 5% of disabled, the disabled recorder's began==0
+        zero-cost proof) must land in the compact line without
+        regressing the ≤1,800 budget."""
+        canned = {
+            "qps": 1000.0, "requests": 6000,
+            "p50_on_ms": 0.412, "p50_off_ms": 0.401,
+            "p99_on_ms": 4.981, "p99_off_ms": 4.902,
+            "p99_ratio": 1.0161,
+            "began_on": 6000, "began_off": 0, "retained_on": 97,
+            "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_traceoverhead(result)
+        assert result["traceoverhead_p99_ratio"] == 1.0161
+        assert result["traceoverhead_began_off"] == 0
+        assert result["traceoverhead_retained_on"] == 97
+        # only the judged claims ride the compact line (the TPU-suite
+        # line is at capacity; on/off/retained detail is sidecar-only)
+        for key in ("traceoverhead_p99_ratio", "traceoverhead_began_off"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["traceoverhead_p99_ratio"] == 1.0161
+        assert parsed["traceoverhead_began_off"] == 0
 
     def test_record_mine_resume_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-4 interruption bracket's keys must land in the

@@ -228,8 +228,8 @@ class TestAppCaching:
 
         async def scenario():
             app.batcher = AsyncMicroBatcher(app.engine, max_size=8)
-            r1, f1, t1 = app.submit_recommend(body)
-            r2, f2, t2 = app.submit_recommend(body)
+            r1, f1, t1, _tr1 = app.submit_recommend(body)
+            r2, f2, t2, _tr2 = app.submit_recommend(body)
             assert r1 is None and r2 is None
             assert f1 is f2  # singleflight: same underlying future
             await f1
@@ -242,7 +242,7 @@ class TestAppCaching:
             for _ in range(3):
                 await asyncio.sleep(0)
             # now cached: immediate response, marked
-            r3, f3, _ = app.submit_recommend(body)
+            r3, f3, _, _ = app.submit_recommend(body)
             assert f3 is None and r3[0] == 200
             assert r3[1].get("X-KMLS-Cache") == "hit"
             assert r3[2] == resp1[2]

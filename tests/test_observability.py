@@ -1,0 +1,1049 @@
+"""ISSUE 9 observability layer: span tracing with tail-based retention,
+trace propagation through both HTTP front ends, fixed-bucket latency
+histograms pinned against the reservoirs, exposition validity (one TYPE
+per name, valid charset, no NaN), the scrape-never-blocks-observe
+reservoir contract, the event-loop-lag admission fold that closes the
+PR 8 inline-path blind spot, and the mining job_metrics.prom textfile.
+"""
+
+import bisect
+import dataclasses
+import json
+import math
+import os
+import random
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kmlserver_tpu import faults
+from kmlserver_tpu.config import MiningConfig, ServingConfig  # noqa: F401
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.observability import LoopLagMonitor, SpanRecorder
+from kmlserver_tpu.observability.jobmetrics import (
+    JOB_METRICS_FILENAME,
+    JobMetrics,
+)
+from kmlserver_tpu.serving.app import RecommendApp, serve
+from kmlserver_tpu.serving.batcher import (
+    AdmissionController,
+    AsyncMicroBatcher,
+    DeadlineExceeded,
+    Overloaded,
+    OverloadDegraded,
+)
+from kmlserver_tpu.serving.metrics import (
+    LATENCY_BUCKETS_S,
+    METRIC_REGISTRY,
+    LatencyHistogram,
+    LatencyReservoir,
+    ServingMetrics,
+)
+
+from .test_batching import _rule_seeds
+from .test_serving import mined_pvc  # noqa: F401  (fixture re-export)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _post(app, songs, trace_header=None):
+    return app.handle(
+        "POST", "/api/recommend/", json.dumps({"songs": songs}).encode(),
+        trace_header=trace_header,
+    )
+
+
+def _traces_of(app):
+    status, _, payload = app.handle("GET", "/debug/traces", None)
+    assert status == 200
+    return json.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# exposition validity (satellite): parse Prometheus text strictly
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (\S+)$"
+)
+
+
+def parse_exposition(text: str) -> tuple[dict[str, str], list[str]]:
+    """Strictly parse Prometheus text format → (name -> type, sample
+    names). Asserts: unique TYPE per name, valid name charset, valid
+    non-NaN sample values, and every sample covered by a TYPE line
+    (histogram `_bucket`/`_sum`/`_count` children map to their base)."""
+    types: dict[str, str] = {}
+    samples: list[str] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4, f"malformed TYPE line: {line!r}"
+            name, mtype = parts[2], parts[3]
+            assert _NAME_RE.match(name), name
+            assert name not in types, f"duplicate # TYPE for {name}"
+            assert mtype in ("counter", "gauge", "summary", "histogram"), line
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        value = float(m.group(3))  # raises on garbage
+        assert not math.isnan(value), f"NaN sample: {line!r}"
+        samples.append(m.group(1))
+    for name in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name[: -len(suffix)] if name.endswith(suffix) else ""
+            if stripped and types.get(stripped) == "histogram":
+                base = stripped
+        assert base in types, f"sample {name} has no # TYPE line"
+    return types, samples
+
+
+class TestExpositionValidity:
+    def test_live_metrics_output_is_valid_and_registry_backed(
+        self, mined_pvc
+    ):
+        """The full /metrics output of a serving app that has seen
+        traffic parses strictly AND agrees with METRIC_REGISTRY: every
+        rendered series is declared with the exact type it renders as."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(dataclasses.replace(cfg, trace_sample=0.5))
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)
+        for s in seeds[:3]:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        _post(app, ["no-such-track-anywhere"])
+        status, _, payload = app.handle("GET", "/metrics", None)
+        assert status == 200
+        types, samples = parse_exposition(payload.decode())
+        for name, mtype in types.items():
+            assert name in METRIC_REGISTRY, (
+                f"{name} rendered but not in METRIC_REGISTRY"
+            )
+            declared = METRIC_REGISTRY[name].split(":", 1)[0]
+            assert mtype == declared, (name, mtype, declared)
+        # the new surfaces are actually present
+        for required in (
+            "kmls_queue_wait_seconds", "kmls_device_seconds",
+            "kmls_e2e_seconds", "kmls_loop_lag_ms",
+            "kmls_traces_began_total",
+        ):
+            assert required in types, required
+
+    def test_robustness_key_colliding_with_static_series_dedupes(self):
+        """Satellite: a robustness dict key that collides with a
+        statically rendered series must not emit a second # TYPE line
+        (invalid exposition) — the static rendering wins, the colliding
+        dynamic entry is dropped whole."""
+        metrics = ServingMetrics()
+        text = metrics.render(
+            7, True,
+            robustness={
+                "degraded_total": 999,
+                "utilization": 0.25,
+                # collides with a lifecycle series rendered AFTER the
+                # robustness block — dedupe must look ahead, not just
+                # at lines already emitted
+                "reloads_total": 888,
+            },
+        )
+        for series, static_sample in (
+            ("kmls_degraded_total", "kmls_degraded_total 0"),
+            ("kmls_reloads_total", "kmls_reloads_total 7"),
+        ):
+            type_lines = [
+                line for line in text.splitlines()
+                if line.startswith(f"# TYPE {series} ")
+            ]
+            assert len(type_lines) == 1, series
+            sample_lines = [
+                line for line in text.splitlines()
+                if line.startswith(f"{series} ")
+            ]
+            # one sample, and it is the static one, not the impostor
+            assert sample_lines == [static_sample]
+        # the non-colliding dynamic key still renders
+        assert "kmls_utilization 0.25" in text
+        parse_exposition(text)
+
+    def test_job_metrics_textfile_is_valid_and_mining_scoped(self, tmp_path):
+        jm = JobMetrics(str(tmp_path))
+        jm.phase_done("encode", 1.25)
+        jm.phase_done("mine", 4.5, resumed=True)
+        jm.set_dataset(rows=100, playlists=40, tracks=16)
+        jm.note_artifact("rules", __file__)
+        jm.finish(True, rule_generation_s=4.5, fencing_token=2)
+        types, _ = parse_exposition(jm.render())
+        for name, mtype in types.items():
+            declared_type, _, scope = METRIC_REGISTRY[name].partition(":")
+            assert mtype == declared_type, name
+            assert scope == "mining", (
+                f"{name} rendered by the mining textfile but "
+                f"registered {scope!r}"
+            )
+
+    def test_job_metrics_refuses_unregistered_series(self, tmp_path, monkeypatch):
+        """The textfile writer looks every name up in METRIC_REGISTRY at
+        render time — an unregistered series is a KeyError, not silent
+        drift."""
+        jm = JobMetrics(str(tmp_path))
+        jm.finish(True)
+        monkeypatch.delitem(METRIC_REGISTRY, "kmls_job_success")
+        with pytest.raises(KeyError):
+            jm.render()
+
+
+# ---------------------------------------------------------------------------
+# fixed-bucket histograms (tentpole a)
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyHistogram:
+    def test_render_shape_and_cumulative_buckets(self):
+        hist = LatencyHistogram()
+        for v in (0.0004, 0.002, 0.002, 0.03, 20.0):
+            hist.observe(v)
+        lines = hist.render("kmls_e2e_seconds")
+        assert lines[0] == "# TYPE kmls_e2e_seconds histogram"
+        buckets = [
+            line for line in lines if line.startswith("kmls_e2e_seconds_bucket")
+        ]
+        # one line per finite bucket + the +Inf band
+        assert len(buckets) == len(LATENCY_BUCKETS_S) + 1
+        counts = [int(line.rsplit(" ", 1)[1]) for line in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        assert buckets[-1] == 'kmls_e2e_seconds_bucket{le="+Inf"} 5'
+        assert "kmls_e2e_seconds_count 5" in lines
+        # the 20 s observation lands only in +Inf
+        assert counts[-2] == 4
+
+    def test_bucket_counters_sum_across_replicas(self):
+        """The fleet-aggregation property reservoirs lack: two pods'
+        bucket counters added elementwise ARE the fleet histogram."""
+        rng = random.Random(5)
+        pod_a, pod_b, fleet = (
+            LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+        )
+        for _ in range(500):
+            v = rng.lognormvariate(-6.0, 1.2)
+            pod = pod_a if rng.random() < 0.5 else pod_b
+            pod.observe(v)
+            fleet.observe(v)
+        counts_a, sum_a, n_a = pod_a.snapshot()
+        counts_b, sum_b, n_b = pod_b.snapshot()
+        counts_f, sum_f, n_f = fleet.snapshot()
+        assert [a + b for a, b in zip(counts_a, counts_b)] == counts_f
+        assert n_a + n_b == n_f
+        assert sum_a + sum_b == pytest.approx(sum_f)
+
+    @pytest.mark.parametrize("q", [0.50, 0.95, 0.99, 0.999])
+    def test_histogram_quantiles_pinned_against_reservoir(self, q):
+        """Tentpole test: histogram-derived quantiles agree with the
+        reservoir's exact quantiles to within the winning bucket — the
+        resolution the fixed buckets promise."""
+        rng = random.Random(11)
+        reservoir = LatencyReservoir()
+        hist = LatencyHistogram()
+        for _ in range(4000):
+            # latency-shaped: lognormal body + a heavy tail excursion
+            v = rng.lognormvariate(-6.2, 1.0)
+            if rng.random() < 0.01:
+                v += rng.uniform(0.05, 0.8)
+            reservoir.observe(v)
+            hist.observe(v)
+        (exact,) = reservoir.percentiles(q)
+        derived = hist.quantile(q)
+        idx = bisect.bisect_left(LATENCY_BUCKETS_S, exact)
+        lo = LATENCY_BUCKETS_S[idx - 1] if idx > 0 else 0.0
+        hi = (
+            LATENCY_BUCKETS_S[idx]
+            if idx < len(LATENCY_BUCKETS_S)
+            else LATENCY_BUCKETS_S[-1]
+        )
+        assert lo * 0.999 <= derived <= hi * 1.001, (q, exact, derived)
+
+    def test_metrics_reset_windows_reservoirs_not_histograms(self, mined_pvc):
+        """/metrics/reset clears the reservoirs (bench windowing) but the
+        histograms are counters — scrape-delta semantics survive."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        for s in _rule_seeds(cfg)[:2]:
+            _post(app, [s])
+        _, _, before_count = app.metrics.e2e_hist.snapshot()
+        assert before_count > 0
+        status, _, _ = app.handle(
+            "POST", "/metrics/reset", None, client_host="127.0.0.1"
+        )
+        assert status == 200
+        _, _, after_count = app.metrics.e2e_hist.snapshot()
+        assert after_count == before_count
+        assert app.metrics.e2e.percentiles(0.5) == [0.0]
+
+
+# ---------------------------------------------------------------------------
+# reservoir scrape-under-load (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _GateValue:
+    """A comparable whose FIRST comparison blocks until released —
+    planted in the reservoir so a concurrent percentiles() call is
+    provably inside its sort when observe() runs."""
+
+    sorting = threading.Event()
+    release = threading.Event()
+
+    def __init__(self, v: float):
+        self.v = v
+
+    def __lt__(self, other):
+        _GateValue.sorting.set()
+        assert _GateValue.release.wait(timeout=10.0)
+        return self.v < other.v
+
+
+class TestReservoirScrapeUnderLoad:
+    def test_observe_never_blocked_by_concurrent_scrape(self):
+        """Satellite: percentiles() copies under the lock and sorts
+        OUTSIDE it. With a scraper deterministically frozen mid-sort,
+        observe() must still complete immediately — under the old
+        sort-under-lock code this observe blocked until the sort
+        finished."""
+        _GateValue.sorting.clear()
+        _GateValue.release.clear()
+        reservoir = LatencyReservoir()
+        for i in range(64):
+            reservoir.observe(_GateValue(float(i)))
+
+        result: list = []
+        scraper = threading.Thread(
+            target=lambda: result.append(reservoir.percentiles(0.5)),
+            daemon=True,
+        )
+        scraper.start()
+        assert _GateValue.sorting.wait(timeout=10.0)
+        # the scraper is now blocked inside live.sort(); the observe
+        # lock must be free
+        t0 = time.perf_counter()
+        reservoir.observe(0.001)
+        observe_s = time.perf_counter() - t0
+        assert not _GateValue.release.is_set()
+        _GateValue.release.set()
+        scraper.join(timeout=10.0)
+        assert not scraper.is_alive() and result
+        assert observe_s < 0.5, (
+            f"observe() took {observe_s:.3f}s while a scrape was sorting "
+            "— the sort is back under the observe lock"
+        )
+
+
+# ---------------------------------------------------------------------------
+# span recorder: tail-based retention + zero-cost-off (tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanRecorder:
+    def _ctx(self, rec, header=None):
+        trace = rec.begin(header)
+        assert trace is not None
+        return trace
+
+    def test_disabled_recorder_does_nothing(self):
+        rec = SpanRecorder(sample=0.0)
+        assert not rec.enabled
+        assert rec.begin("abc") is None
+        assert rec.began == 0
+        payload = rec.debug_payload()
+        assert payload["enabled"] is False and payload["traces"] == []
+
+    def test_header_parsing_and_charset_guard(self):
+        rec = SpanRecorder(sample=1.0, rng=random.Random(0))
+        t = self._ctx(rec, "req-01:parent-9")
+        assert t.trace_id == "req-01" and t.parent_id == "parent-9"
+        # hostile bytes never reach output: invalid charset → fresh id
+        t = self._ctx(rec, 'x" }\n<script>:<b>')
+        assert re.fullmatch(r"[0-9a-f]{16}", t.trace_id)
+        assert t.parent_id is None
+        # an invalid trace id with a clean parent keeps just the parent
+        t = self._ctx(rec, 'x" }:p')
+        assert re.fullmatch(r"[0-9a-f]{16}", t.trace_id)
+        assert t.parent_id == "p"
+        # over-long ids rejected the same way
+        t = self._ctx(rec, "a" * 65)
+        assert re.fullmatch(r"[0-9a-f]{16}", t.trace_id)
+
+    def test_non_ok_always_retained_regardless_of_sample(self):
+        rec = SpanRecorder(sample=1e-9, slow_n=0, rng=random.Random(1))
+        for status in ("shed", "degraded", "error") * 20:
+            assert rec.finish(self._ctx(rec), status, 0.001)
+        assert rec.retained() == 60
+
+    def test_slowest_n_retained_and_bar_rises(self):
+        rec = SpanRecorder(sample=1e-9, slow_n=4, rng=random.Random(2))
+        kept = [
+            rec.finish(self._ctx(rec), "ok", d)
+            for d in (0.010, 0.020, 0.030, 0.040)
+        ]
+        assert all(kept)  # heap not full: everything is slowest-N
+        assert not rec.finish(self._ctx(rec), "ok", 0.005)  # under the bar
+        assert rec.finish(self._ctx(rec), "ok", 0.050)  # new tail entrant
+        assert not rec.finish(self._ctx(rec), "ok", 0.012)  # bar rose to 20ms
+
+    def test_baseline_sampling_is_probabilistic(self):
+        rec = SpanRecorder(sample=0.5, slow_n=0, rng=random.Random(3))
+        # identical durations so slowest-N can't interfere (slow_n=0)
+        kept = sum(
+            rec.finish(self._ctx(rec), "ok", 0.001) for _ in range(400)
+        )
+        assert 120 < kept < 280  # ~200 at p=0.5, seeded rng
+
+    def test_ring_capacity_bounds_the_buffer(self):
+        rec = SpanRecorder(sample=1.0, capacity=8, rng=random.Random(4))
+        for i in range(50):
+            t = self._ctx(rec)
+            t.annotate("i", i)
+            rec.finish(t, "shed", 0.001)
+        assert rec.retained() == 8
+        payload = rec.debug_payload()
+        assert [t["attrs"]["i"] for t in payload["traces"]] == list(
+            range(42, 50)
+        )  # oldest evicted, oldest-first order
+
+    def test_span_and_annotation_round_trip_to_json(self):
+        rec = SpanRecorder(sample=1.0, rng=random.Random(5))
+        t = self._ctx(rec, "rt-1")
+        t0 = t.t0
+        t.span("queue", t0, t0 + 0.002, {"batch": 3})
+        t.span("device", t0 + 0.002, t0 + 0.004, {"replica": 0})
+        t.annotate("admission", "degrade")
+        rec.finish(t, "degraded", 0.005)
+        (trace,) = rec.debug_payload()["traces"]
+        json.dumps(trace)  # JSON-clean
+        assert trace["trace_id"] == "rt-1"
+        assert trace["status"] == "degraded"
+        assert trace["attrs"]["admission"] == "degrade"
+        assert [s["name"] for s in trace["spans"]] == ["queue", "device"]
+        assert trace["spans"][0]["attrs"] == {"batch": 3}
+        assert trace["spans"][0]["duration_ms"] == pytest.approx(2.0, abs=0.1)
+
+
+class TestZeroCostWhenDisabled:
+    def test_began_counter_never_moves_with_tracing_off(self, mined_pvc):
+        """Acceptance: KMLS_TRACE_SAMPLE=0 (the default) adds zero
+        hot-path work — the compile-counter-style proof: real requests
+        (even carrying a trace header) never construct a context, never
+        generate an id, never touch the recorder."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        assert app.cfg.trace_sample == 0.0 and not app.recorder.enabled
+        for s in _rule_seeds(cfg)[:3]:
+            status, headers, _ = _post(app, [s], trace_header="want-a-trace")
+            assert status == 200
+            assert "X-KMLS-Trace" not in headers
+        assert app.recorder.began == 0
+        assert app.recorder.retained_total == 0
+        status, _, payload = app.handle("GET", "/metrics", None)
+        text = payload.decode()
+        assert "kmls_traces_began_total 0" in text
+        assert "kmls_trace_buffer_entries 0" in text
+        payload = _traces_of(app)
+        assert payload["enabled"] is False and payload["traces"] == []
+
+
+# ---------------------------------------------------------------------------
+# trace propagation through both front ends (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _assert_traced_breakdown(doc: dict, trace_id: str, parent_id=None):
+    by_id = {t["trace_id"]: t for t in doc["traces"]}
+    assert trace_id in by_id, sorted(by_id)
+    trace = by_id[trace_id]
+    assert trace["parent_id"] == parent_id
+    assert trace["status"] == "ok"
+    names = [s["name"] for s in trace["spans"]]
+    for required in ("queue", "device", "compose"):
+        assert required in names, names
+    span_sum = sum(s["duration_ms"] for s in trace["spans"])
+    e2e = trace["duration_ms"]
+    # spans must fit inside the request and account for most of it; the
+    # uncovered remainder is validation + completion handoff (bounded
+    # generously for noisy CI hosts)
+    assert span_sum <= e2e * 1.05 + 0.5, (span_sum, e2e)
+    assert e2e - span_sum < 80.0, (span_sum, e2e)
+    for span in trace["spans"]:
+        assert span["duration_ms"] >= 0.0
+        assert -0.1 <= span["start_ms"] <= e2e + 0.1
+    return trace
+
+
+class TestTracePropagationThreaded:
+    def test_injected_id_rides_to_debug_traces(self, mined_pvc):
+        """Satellite: a request with an injected X-KMLS-Trace id through
+        the real threaded HTTP server appears in /debug/traces with
+        queue/device/compose spans that sum to ~its e2e latency, and the
+        response echoes the id."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(dataclasses.replace(cfg, trace_sample=1.0))
+        assert app.engine.load()
+        server = serve(app, port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            seeds = _rule_seeds(cfg)[:2]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/recommend/",
+                data=json.dumps({"songs": seeds}).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-KMLS-Trace": "threaded-cli-1:bench-run-7",
+                },
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-KMLS-Trace"] == "threaded-cli-1"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+            trace = _assert_traced_breakdown(
+                doc, "threaded-cli-1", parent_id="bench-run-7"
+            )
+            # batcher path annotated its dispatch
+            device = next(
+                s for s in trace["spans"] if s["name"] == "device"
+            )
+            assert "replica" in device["attrs"]
+        finally:
+            server.shutdown()
+
+    def test_cache_hit_trace_marks_cached_no_device_span(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(dataclasses.replace(cfg, trace_sample=1.0))
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:1]
+        assert _post(app, seeds, trace_header="warm-1")[0] == 200
+        status, headers, _ = _post(app, seeds, trace_header="hit-1")
+        assert status == 200 and headers.get("X-KMLS-Cache") == "hit"
+        assert headers["X-KMLS-Trace"] == "hit-1"
+        by_id = {t["trace_id"]: t for t in _traces_of(app)["traces"]}
+        hit = by_id["hit-1"]
+        assert hit["attrs"].get("cached") is True
+        names = [s["name"] for s in hit["spans"]]
+        assert "device" not in names and "compose" in names
+
+
+class TestTracePropagationAsync:
+    @pytest.fixture
+    def served(self, mined_pvc):
+        import asyncio
+        from kmlserver_tpu.serving.aioserver import run_async
+
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(cfg, trace_sample=1.0), defer_batcher=True
+        )
+        app.engine.load()
+        port_box: list[int] = []
+        ready = threading.Event()
+
+        def runner():
+            asyncio.run(
+                run_async(
+                    app, 0,
+                    ready=lambda p: (port_box.append(p), ready.set()),
+                )
+            )
+
+        threading.Thread(target=runner, daemon=True).start()
+        assert ready.wait(timeout=30)
+        return app, port_box[0]
+
+    def test_injected_id_rides_to_debug_traces(self, served):
+        import http.client
+
+        app, port = served
+        seeds = _rule_seeds(app.cfg)[:2]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request(
+            "POST", "/api/recommend/",
+            body=json.dumps({"songs": seeds}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-KMLS-Trace": "aio-cli-1",
+            },
+        )
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        assert resp.headers["X-KMLS-Trace"] == "aio-cli-1"
+        conn.request("GET", "/debug/traces")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200
+        _assert_traced_breakdown(doc, "aio-cli-1")
+        # the loop-lag drift tick is armed on the serving loop
+        assert app.loop_lag is not None
+        deadline = time.time() + 10
+        while app.loop_lag.ticks == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert app.loop_lag.ticks > 0
+
+
+# ---------------------------------------------------------------------------
+# tail-based retention under failure (acceptance)
+# ---------------------------------------------------------------------------
+
+
+class _LadderScriptBatcher:
+    """Replays the admission ladder deterministically: each recommend()
+    raises the scripted outcome — exactly what the real batcher raises
+    under a burst (shed / overload-degrade) or a stalled kernel
+    (deadline)."""
+
+    def __init__(self, script):
+        self._script = list(script)
+
+    def submit(self, seeds, deadline=None, trace=None):  # hasattr probe
+        raise NotImplementedError
+
+    def recommend(self, seeds, deadline=None, trace=None, timeout=None):
+        exc = self._script.pop(0)
+        if exc is not None:
+            raise exc
+        return [f"rec-for-{seeds[0]}"], "rules"
+
+
+class TestTailRetentionUnderChaos:
+    def test_every_shed_degraded_deadline_trace_retained(self, mined_pvc):
+        """Acceptance: with a vanishingly small baseline sample, every
+        shed, overload-degraded, and deadline-exceeded request is still
+        retained in /debug/traces, with the ladder decision recorded in
+        a span attribute."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(
+                cfg, trace_sample=1e-9, cache_enabled=False,
+            ),
+            defer_batcher=True,
+        )
+        assert app.engine.load()
+        app.recorder.slow_n = 0  # isolate the always-keep rule
+        app.batcher = _LadderScriptBatcher([
+            Overloaded(1.4, 105.0),
+            OverloadDegraded(0.9),
+            DeadlineExceeded("deadline exhausted in queue"),
+            None,
+        ])
+        outcomes = []
+        for i in range(4):
+            status, headers, _ = _post(
+                app, [f"seed-{i}"], trace_header=f"chaos-{i}"
+            )
+            outcomes.append((status, headers.get("X-KMLS-Degraded")))
+        assert outcomes[0] == (429, None)
+        assert outcomes[1] == (200, "overload")
+        assert outcomes[2] == (200, "deadline")
+        assert outcomes[3] == (200, None)
+
+        by_id = {t["trace_id"]: t for t in _traces_of(app)["traces"]}
+        shed = by_id["chaos-0"]
+        assert shed["status"] == "shed"
+        assert shed["attrs"]["admission"] == "shed"
+        assert shed["attrs"]["retry_after_s"] == pytest.approx(1.4)
+        degraded = by_id["chaos-1"]
+        assert degraded["status"] == "degraded"
+        assert degraded["attrs"]["admission"] == "degrade"
+        assert degraded["attrs"]["reason"] == "overload"
+        deadline = by_id["chaos-2"]
+        assert deadline["status"] == "degraded"
+        assert deadline["attrs"]["reason"] == "deadline"
+        # the OK request at sample≈0 with slow_n=0 is NOT retained — the
+        # tail policy kept exactly the interesting three
+        assert "chaos-3" not in by_id
+        assert app.recorder.retained_total == 3
+
+    def test_real_kernel_stall_deadline_trace_retained(self, mined_pvc):
+        """The PR 3 kernel-delay repro with tracing on: the degraded
+        answer's trace lands in the buffer with reason=deadline."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(
+                cfg, request_deadline_ms=80.0, trace_sample=1e-9,
+            )
+        )
+        assert app.engine.load()
+        app.recorder.slow_n = 0
+        seeds = app.engine.bundle.vocab[:2]
+        faults.inject("replica.kernel", replica=0, delay_s=0.5, times=-1)
+        status, headers, _ = _post(app, seeds, trace_header="stall-1")
+        assert status == 200
+        assert headers.get("X-KMLS-Degraded") == "deadline"
+        assert headers["X-KMLS-Trace"] == "stall-1"
+        by_id = {t["trace_id"]: t for t in _traces_of(app)["traces"]}
+        assert by_id["stall-1"]["status"] == "degraded"
+        assert by_id["stall-1"]["attrs"]["reason"] == "deadline"
+        faults.clear()
+        time.sleep(0.6)  # let the stalled batch drain
+
+
+# ---------------------------------------------------------------------------
+# runtime health: loop-lag collector + admission fold (tentpole b)
+# ---------------------------------------------------------------------------
+
+
+class TestLoopLagMonitor:
+    def test_peak_hold_and_decay(self):
+        mon = LoopLagMonitor(half_life_s=1.0)
+        now = 100.0
+        mon.note(0.2, now=now)
+        assert mon.lag_s(now=now) == pytest.approx(0.2)
+        # a smaller stall does not dilute the held peak
+        mon.note(0.01, now=now + 0.1)
+        assert mon.lag_s(now=now + 0.1) > 0.15
+        # one half-life later the estimate has halved
+        assert mon.lag_s(now=now + 1.0) == pytest.approx(0.1, rel=0.05)
+        # a larger stall replaces the decayed peak immediately
+        mon.note(0.5, now=now + 2.0)
+        assert mon.lag_s(now=now + 2.0) == pytest.approx(0.5)
+        mon.note(0.0, now=now + 2.1)  # no-op
+        assert mon.lag_s(now=now + 2.1) < 0.5
+
+    def test_drift_tick_sees_a_blocked_loop(self):
+        import asyncio
+
+        mon = LoopLagMonitor(interval_s=0.01, half_life_s=5.0)
+
+        async def scenario():
+            mon.start_on_loop(asyncio.get_running_loop())
+            await asyncio.sleep(0.05)  # let ticks establish a baseline
+            time.sleep(0.15)  # block the LOOP (deliberately not await)
+            await asyncio.sleep(0.05)  # the overdue tick runs and notes
+            return mon.lag_s()
+
+        lag = asyncio.run(scenario())
+        assert mon.ticks > 0
+        assert lag > 0.05, f"drift tick missed a 150ms loop stall ({lag})"
+
+    def test_thread_driver_is_reentry_safe(self):
+        mon = LoopLagMonitor(interval_s=0.01)
+        before = {
+            t for t in threading.enumerate() if t.name == "kmls-loop-lag"
+        }
+        first = mon.start_thread()
+        # the daemon thread is immortal — a second call must hand back
+        # the existing driver, not spawn a tick-double-counting twin
+        assert first is not None and mon.start_thread() is first
+        spawned = {
+            t for t in threading.enumerate() if t.name == "kmls-loop-lag"
+        } - before
+        assert spawned == {first}
+
+    def test_admission_pressure_folds_lag_as_wait_floor(self):
+        mon = LoopLagMonitor(half_life_s=10.0)
+        ctl = AdmissionController(budget_s=0.1, lag_source=mon.lag_s)
+        assert ctl.pressure(0.0) == pytest.approx(0.0, abs=1e-6)
+        mon.note(0.3)
+        # 0.3s stall over a 0.1s budget: pressure 3.0 — past the hard
+        # ratio, exactly like a 3x-budget queue projection
+        assert ctl.pressure(0.0) > 1.5
+        decision, pressure = ctl.decide(0.0)
+        assert decision == "shed" and pressure > 1.5
+        # identical controller without the fold stays blind
+        blind = AdmissionController(budget_s=0.1)
+        assert blind.decide(0.0)[0] == "admit"
+
+
+class _InlineStallEngine:
+    """The PR 8 repro engine: the native host kernel computing ON the
+    loop, with the injected delay fired at the real fault site name.
+    Carries the two fallback hooks the degraded response path reads."""
+
+    host_kernel_active = True
+    cache_value = "fake-model-date"
+
+    def recommend_many_async(self, seed_sets):
+        def finish():
+            faults.fire("replica.kernel", replica=0)
+            return [([f"rec-{s[0]}"], "rules") for s in seed_sets]
+
+        return finish
+
+    def static_recommendation(self, songs, deadline=None):
+        return ["popular-1", "popular-2"]
+
+
+class TestInlinePathBlindSpotClosed:
+    def test_inline_kernel_stall_escalates_ladder_no_5xx(self, tmp_path):
+        """Acceptance: the PR 8 repro — a 200 ms injected kernel delay on
+        the inline native CPU path — now escalates the admission ladder
+        through the loop-lag term instead of answering everything late:
+        follow-up requests degrade/shed (200+header / 429), and nothing
+        is a 5xx."""
+        import asyncio
+
+        cfg = ServingConfig(
+            base_dir=str(tmp_path), shed_queue_budget_ms=50.0,
+            cache_enabled=False, trace_sample=1.0,
+        )
+        app = RecommendApp.__new__(RecommendApp)  # no artifacts needed
+        app.cfg = cfg
+        app.recorder = SpanRecorder(sample=1.0, rng=random.Random(9))
+        app.loop_lag = LoopLagMonitor(half_life_s=0.4)
+        app.cache = None
+        app.metrics = ServingMetrics()
+        app.engine = _InlineStallEngine()  # the fallback the degrade rung answers from
+        faults.inject("replica.kernel", replica=0, delay_s=0.2, times=1)
+
+        async def scenario():
+            app.batcher = AsyncMicroBatcher(
+                _InlineStallEngine(), max_size=4, window_ms=1.0,
+                shed_queue_budget_ms=50.0, lag_monitor=app.loop_lag,
+            )
+            body = json.dumps({"songs": ["warm"]}).encode()
+            response, future, t0, trace = app.submit_recommend(body)
+            assert response is None
+            await future  # the inline finish() stalls the loop 200 ms
+            app.finish_recommend(future, t0, trace=trace)
+            # the direct stall note landed the instant the loop unblocked
+            assert app.loop_lag.lag_s() > 0.1
+            statuses = []
+            for i in range(6):
+                body = json.dumps({"songs": [f"s{i}"]}).encode()
+                response, future, t0, trace = app.submit_recommend(body)
+                if future is not None:
+                    await future
+                    response = app.finish_recommend(future, t0, trace=trace)
+                statuses.append(
+                    (response[0], response[1].get("X-KMLS-Degraded"))
+                )
+            return statuses
+
+        statuses = asyncio.run(scenario())
+        assert all(code < 500 for code, _ in statuses), statuses
+        escalated = [
+            (code, why) for code, why in statuses
+            if code == 429 or why == "overload"
+        ]
+        assert escalated, f"ladder never engaged: {statuses}"
+        # the ladder decisions are traced (tail retention keeps them all)
+        retained = {
+            (t["status"], t["attrs"].get("admission"))
+            for t in app.recorder.debug_payload()["traces"]
+        }
+        assert ("shed", "shed") in retained or (
+            "degraded", "degrade") in retained
+
+    def test_without_lag_monitor_the_blind_spot_is_blind(self):
+        """The control arm: the identical stall with no lag monitor never
+        escalates — proving the new term is what closes the gap."""
+        import asyncio
+
+        faults.inject("replica.kernel", replica=0, delay_s=0.2, times=1)
+
+        async def scenario():
+            batcher = AsyncMicroBatcher(
+                _InlineStallEngine(), max_size=4, window_ms=1.0,
+                shed_queue_budget_ms=50.0,
+            )
+            await batcher.submit(["warm"])
+            results = []
+            for i in range(4):
+                results.append(await batcher.submit([f"s{i}"]))
+            return results
+
+        results = asyncio.run(scenario())
+        assert len(results) == 4  # everything admitted — answered late
+
+
+# ---------------------------------------------------------------------------
+# mining-side telemetry (tentpole c)
+# ---------------------------------------------------------------------------
+
+
+def _mining_pvc(base, **overrides) -> MiningConfig:
+    import numpy as np
+
+    from kmlserver_tpu.data.csv import write_tracks_csv
+
+    from .oracle import random_baskets
+    from .test_pipeline import table_with_metadata
+
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir, exist_ok=True)
+    rng = np.random.default_rng(7)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds1.csv"),
+        table_with_metadata(
+            random_baskets(rng, n_playlists=40, n_tracks=16, mean_len=5)
+        ),
+    )
+    return MiningConfig(
+        base_dir=base, datasets_dir=ds_dir, min_support=0.1, **overrides
+    )
+
+
+class TestJobMetricsTextfile:
+    def test_successful_run_writes_complete_telemetry(self, tmp_path):
+        cfg = _mining_pvc(str(tmp_path))
+        summary = run_mining_job(cfg)
+        path = os.path.join(cfg.pickles_dir, JOB_METRICS_FILENAME)
+        assert os.path.exists(path)
+        with open(path) as fh:
+            text = fh.read()
+        types, samples = parse_exposition(text)
+        for name in types:
+            assert METRIC_REGISTRY[name].endswith(":mining"), name
+        assert "kmls_job_success 1" in text
+        assert f"kmls_job_fencing_token {summary.fencing_token}" in text
+        for phase in ("encode", "mine", "rules"):
+            assert f'kmls_job_phase_duration_seconds{{phase="{phase}"}}' in text
+            assert f'kmls_job_phase_resumed{{phase="{phase}"}} 0' in text
+        assert "kmls_job_playlists 40" in text
+        assert "kmls_job_tracks 16" in text
+        # published artifact sizes, nonzero
+        artifact_lines = [
+            line for line in text.splitlines()
+            if line.startswith("kmls_job_artifact_bytes")
+        ]
+        assert artifact_lines
+        assert all(int(line.rsplit(" ", 1)[1]) > 0 for line in artifact_lines)
+        # deliberately NOT part of the publication manifest (mid-run
+        # rewrites would read as torn publications)
+        from kmlserver_tpu.io import artifacts
+
+        manifest = artifacts.load_manifest(cfg.pickles_dir)
+        assert JOB_METRICS_FILENAME not in manifest.get("files", {})
+
+    def test_preempted_run_leaves_partial_then_resume_reports_skips(
+        self, tmp_path
+    ):
+        """A job killed after the mine phase leaves success=0 telemetry
+        for the phases it DID finish; the resumed job reports those
+        phases with resumed=1 and the ORIGINAL compute duration from the
+        checkpoint's span annotation."""
+        cfg = _mining_pvc(str(tmp_path))
+        path = os.path.join(cfg.pickles_dir, JOB_METRICS_FILENAME)
+        faults.inject("mine.crash.mine", times=1)
+        with pytest.raises(faults.FaultInjected):
+            run_mining_job(cfg)
+        faults.clear()
+        with open(path) as fh:
+            interrupted = fh.read()
+        parse_exposition(interrupted)
+        assert "kmls_job_success 0" in interrupted
+        assert 'kmls_job_phase_duration_seconds{phase="mine"}' in interrupted
+        assert "kmls_job_last_success_timestamp_seconds" not in interrupted
+        mine_duration = float(next(
+            line.rsplit(" ", 1)[1]
+            for line in interrupted.splitlines()
+            if line.startswith('kmls_job_phase_duration_seconds{phase="mine"}')
+        ))
+        assert mine_duration > 0.0
+
+        run_mining_job(cfg)
+        with open(path) as fh:
+            resumed = fh.read()
+        parse_exposition(resumed)
+        assert "kmls_job_success 1" in resumed
+        assert 'kmls_job_phase_resumed{phase="encode"} 1' in resumed
+        assert 'kmls_job_phase_resumed{phase="mine"} 1' in resumed
+        # rules was never checkpointed before the crash: computed fresh
+        assert 'kmls_job_phase_resumed{phase="rules"} 0' in resumed
+        resumed_duration = float(next(
+            line.rsplit(" ", 1)[1]
+            for line in resumed.splitlines()
+            if line.startswith('kmls_job_phase_duration_seconds{phase="mine"}')
+        ))
+        # the resumed entry reports the original compute, not the
+        # (near-zero) checkpoint-load time
+        assert resumed_duration == pytest.approx(mine_duration, rel=0.01)
+
+    def test_success_telemetry_failure_cannot_fail_a_published_run(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """Registry drift (KeyError from render) at the SUCCESS-path
+        finish must not abort a job whose publication already succeeded
+        — the abort handler would rewrite the telemetry as success=0 and
+        the exit-code contract would report a phantom failure. The job
+        completes, the token is published, and the lease is released."""
+        cfg = _mining_pvc(str(tmp_path))
+
+        def drifted_finish(self, success, **kw):
+            raise KeyError("kmls_job_not_registered")
+
+        monkeypatch.setattr(JobMetrics, "finish", drifted_finish)
+        summary = run_mining_job(cfg)
+        assert summary.token  # published: invalidation token rewritten
+        assert "success telemetry skipped" in capsys.readouterr().out
+        # the success path still releases the lease (released marker,
+        # token retained); a masked abort would have left it live for
+        # the TTL
+        with open(os.path.join(cfg.pickles_dir, "publish.lease.json")) as fh:
+            assert json.load(fh)["released"] is True
+
+    def test_knob_disables_the_writer(self, tmp_path):
+        cfg = _mining_pvc(str(tmp_path), job_metrics=False)
+        run_mining_job(cfg)
+        assert not os.path.exists(
+            os.path.join(cfg.pickles_dir, JOB_METRICS_FILENAME)
+        )
+
+    def test_writes_are_atomic(self, tmp_path, monkeypatch):
+        """Every rewrite goes through the atomic tmp+replace path — the
+        same invariant kmls-verify enforces statically."""
+        from kmlserver_tpu.io import artifacts
+
+        calls = []
+        real = artifacts.atomic_write_text
+
+        def spy(path, text):
+            calls.append(path)
+            return real(path, text)
+
+        monkeypatch.setattr(artifacts, "atomic_write_text", spy)
+        jm = JobMetrics(str(tmp_path))
+        jm.phase_done("encode", 0.5)
+        jm.finish(True)
+        assert len(calls) == 2
+        assert all(c.endswith(JOB_METRICS_FILENAME) for c in calls)
+
+    def test_write_failure_is_best_effort_never_raises(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        """A transient PVC error on the telemetry file must never fail the
+        run — especially finish(True), which runs AFTER publication. Only
+        OSError is survivable: a registry KeyError (drift) still raises."""
+        from kmlserver_tpu.io import artifacts
+
+        def boom(path, text):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(artifacts, "atomic_write_text", boom)
+        jm = JobMetrics(str(tmp_path))
+        with caplog.at_level("WARNING", logger="kmlserver_tpu.mining"):
+            jm.phase_done("mine", 1.5)
+            jm.finish(True)
+        assert not os.path.exists(os.path.join(str(tmp_path), JOB_METRICS_FILENAME))
+        assert any("job_metrics" in r.message for r in caplog.records)
+        # drift protection is NOT best-effort: unregistered series raises
+        jm.dataset = {"kmls_job_not_registered": 1}
+        with pytest.raises(KeyError):
+            jm.write()
