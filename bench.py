@@ -373,6 +373,13 @@ _COMPACT_PRIORITY = (
     # ranked below the TPU serving evidence; on/off/retained detail
     # lives in the sidecar
     "traceoverhead_p99_ratio", "traceoverhead_began_off",
+    # judged freshness claims (ISSUE 10): delta vs full-path speedup
+    # (≥ 5x), zero 5xx through the in-place apply, and the 3-replica
+    # fleet hit-ratio multiplier — ranked with traceoverhead below the
+    # TPU serving evidence (CPU-measured by construction); path/cache
+    # detail is sidecar-only, the compact line sits at its budget
+    "freshness_speedup", "freshness_http_5xx", "freshness_errors",
+    "freshness_publish_to_applied_ms", "freshness_fleet_multiplier",
     "mining_mfu_pct", "mining_mfu_peak_tops", "mining_matmul_gops_per_s",
     "config4_mine_s", "config4_rows_per_s", "scale_1m_x_100k_mine_s",
     "popcount_words_per_s", "sweep_points",
@@ -1392,6 +1399,241 @@ with tempfile.TemporaryDirectory(prefix="kmls_chaos_") as base:
     }))
 """
 
+# the continuous-freshness phase (ISSUE 10): the delta path's whole
+# reason to exist is freshness lag — how long after new rows land does
+# serving answer from them? Three judged brackets in one in-process run
+# (CPU-platform by construction, self-labeled):
+#   full path  — a second FULL re-mine + full reload on the ds2 shape:
+#                the baseline freshness lag (mine + republish + swap);
+#   delta path — append ~2% new rows, run the SAME pipeline entry (it
+#                takes the delta route), and measure publish→applied
+#                into the live engine through the production poll loop,
+#                with a 1k-QPS-class Zipf replay running THROUGH the
+#                apply: freshness_speedup = full_path_s / delta_path_s
+#                (acceptance: ≥ 5x) and zero 5xx mid-apply;
+#   fleet      — the 3-replica effective-hit-ratio multiplier from
+#                freshness/ring.py's simulated topology (affinity vs
+#                round-robin over the same key stream) — the ROADMAP's
+#                measure-before-committing decision number.
+# Selective invalidation is judged by the hit ratio: the delta touches a
+# handful of vocab rows, so the Zipf head's cache entries must SURVIVE
+# the apply (a wholesale epoch bump would re-compute all of them).
+_FRESHNESS_BENCH = r"""
+import dataclasses, json, os, sys, tempfile, threading, time
+import numpy as np
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+from kmlserver_tpu.freshness.ring import fleet_multiplier, seeds_key
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_FRESHNESS_QPS", "800"))
+n_req = int(os.environ.get("KMLS_BENCH_FRESHNESS_REQUESTS", "6000"))
+with tempfile.TemporaryDirectory(prefix="kmls_fresh_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    csv_path = os.path.join(ds_dir, "2023_spotify_ds2.csv")
+    write_tracks_csv(csv_path, synthetic_table(**DS2_SHAPE, seed=123))
+    mcfg = MiningConfig(
+        base_dir=base, datasets_dir=ds_dir, min_support=0.05,
+        delta_enabled=True,
+    )
+    run_mining_job(mcfg)  # base generation (arms the freshness state)
+    cfg = dataclasses.replace(
+        ServingConfig.from_env(), base_dir=base, delta_enabled=True,
+        batch_max_size=64, shed_queue_budget_ms=0.0,
+    )
+    app = RecommendApp(cfg)
+    assert app.engine.load(), "mined artifacts must load"
+
+    # ---- full path baseline: re-mine everything + full reload (warm
+    # jit; delta off — with it on, an unchanged dataset is a designed
+    # no-op). This is exactly what the pre-delta GitOps posture pays on
+    # EVERY sync cadence tick. Median of 3 — single-shot wall clocks on
+    # a shared host are noisy enough to swing the speedup ratio 2x
+    # (same discipline as loadshape's runs_p99_ms).
+    full_runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_mining_job(dataclasses.replace(mcfg, delta_enabled=False))
+        assert app.engine.is_data_stale(), "full publication must rewrite the token"
+        assert app.engine.load(), "full reload must succeed"
+        full_runs.append(time.perf_counter() - t0)
+    full_path_s = sorted(full_runs)[1]
+
+    # re-arm the freshness base at the CURRENT generation (the baseline
+    # run above retired the old base state by rewriting the token): the
+    # delta route detects the mismatch and falls through to a full
+    # re-mine that saves a fresh base. Untimed — arming, not the race.
+    run_mining_job(mcfg)
+    assert app.engine.load(), "re-arm reload must succeed"
+
+    # appended rows concentrate on a ~128-track slice of the catalog —
+    # the locality real incremental feeds have (uniform appends would
+    # touch nearly every vocab column and degenerate the delta into a
+    # full recount, which run_delta_job would do correctly but slowly)
+    rng = np.random.default_rng(7)
+    n_tracks = DS2_SHAPE["n_tracks"]
+    def append_rows(first_pid, lo):
+        lines = []
+        for p in range(24):
+            pid = first_pid + p
+            for t in lo + rng.integers(0, 128, size=90):
+                t = int(t)
+                lines.append(
+                    f"{pid},Track {t:07d},spotify:track:{t:07d},"
+                    f"Artist {t % 997:04d},spotify:artist:{t % 997:04d},"
+                    f"Album {t // 12:06d}"
+                )
+        # plus a brand-new track (vocabulary growth in a delta)
+        t = 9_000_000 + first_pid % 1000
+        lines.append(
+            f"{first_pid},Track {t:07d},spotify:track:{t:07d},"
+            f"Artist 0000,spotify:artist:0000,Album 000000"
+        )
+        with open(csv_path, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+    # ---- the production poll loop, 20 ms cadence ----
+    stop = [False]
+    def poller():
+        while not stop[0]:
+            app.engine.reload_if_required()
+            time.sleep(0.02)
+    pt = threading.Thread(target=poller, daemon=True)
+    pt.start()
+
+    # ---- idle deltas 1-3 (apples-to-apples with the idle full
+    # baseline): append → the SAME pipeline entry takes the delta route
+    # → publish → applied into the live engine by the poll loop.
+    # Median of 3 cycles, mirroring the baseline's discipline.
+    delta_runs, publish_runs, apply_gaps = [], [], []
+    for cycle in range(3):
+        append_rows(10_000_000 + cycle * 1_000, 96 + cycle * 160)
+        t1 = time.perf_counter()
+        summary = run_mining_job(mcfg)
+        published_s = time.perf_counter() - t1
+        assert summary.delta_seq == cycle + 1, (
+            f"delta never published: {summary}"
+        )
+        t2 = time.perf_counter()
+        while (
+            app.engine.delta_seq < cycle + 1
+            and time.perf_counter() - t2 < 30.0
+        ):
+            time.sleep(0.002)
+        assert app.engine.delta_seq == cycle + 1, (
+            f"delta {cycle + 1} never applied in serving"
+        )
+        delta_runs.append(time.perf_counter() - t1)
+        publish_runs.append(published_s)
+        apply_gaps.append((time.perf_counter() - t2) * 1e3)
+    delta_path_s = sorted(delta_runs)[1]
+    published_s = sorted(publish_runs)[1]
+    publish_to_applied_ms = sorted(apply_gaps)[1]
+    n_idle_deltas = 3
+
+    http_5xx = [0]
+    lock = threading.Lock()
+    def make_send():
+        def send(seeds):
+            status, headers, _ = app.handle(
+                "POST", "/api/recommend/",
+                json.dumps({"songs": seeds}).encode(),
+            )
+            if status >= 500:
+                with lock:
+                    http_5xx[0] += 1
+                raise RuntimeError(f"HTTP {status}")
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            cached = headers.get("X-KMLS-Cache") == "hit"
+            return ("degraded" if "X-KMLS-Degraded" in headers else "ok",
+                    cached)
+        return send
+
+    vocab = app.engine.bundle.vocab
+    payloads = sample_seed_sets(vocab, n_req, rng_seed=11, zipf_s=1.1)
+    # warm the Zipf head so the mid-replay apply hits a POPULATED cache —
+    # survival of those entries is the selective-invalidation claim
+    replay_pooled(make_send, payloads[: min(3000, n_req)],
+                  qps=qps, n_workers=16)
+    hits_before = app.cache.hits if app.cache else 0
+
+    # ---- final delta, mid-replay: zero 5xx through the in-place apply
+    mid_seq = n_idle_deltas + 1
+    delta_mid = {}
+    def run_delta_mid():
+        append_rows(20_000_000, 640)
+        t3 = time.perf_counter()
+        s_mid = run_mining_job(mcfg)
+        delta_mid["seq"] = s_mid.delta_seq
+        while (
+            app.engine.delta_seq < mid_seq
+            and time.perf_counter() - t3 < 30.0
+        ):
+            time.sleep(0.002)
+        delta_mid["applied_s"] = time.perf_counter() - t3
+    mid_thread = threading.Thread(target=run_delta_mid, daemon=True)
+    events = [(int(n_req * 0.25), mid_thread.start)]
+    report = replay_pooled(
+        make_send, payloads, qps=qps, n_workers=16, max_queue=8192,
+        events=events,
+    )
+    # the replay can drain before a slow host finishes the mid-replay
+    # mine: join the delta (and leave the poller running to apply it)
+    # BEFORE asserting, or the assertions race the publication. ident
+    # guard: joining a never-started thread (event never fired) raises
+    if mid_thread.ident is not None:
+        mid_thread.join(timeout=60.0)
+    stop[0] = True
+    pt.join(timeout=5.0)
+    assert delta_mid.get("seq") == mid_seq, (
+        f"mid-replay delta never published: {delta_mid}"
+    )
+    assert app.engine.delta_seq == mid_seq, (
+        "mid-replay delta never applied in serving"
+    )
+
+    # ---- fleet multiplier: 3-replica simulated topology ----
+    keys = [seeds_key(p) for p in payloads]
+    fleet = fleet_multiplier(keys, n_replicas=3, capacity=512)
+
+    cache = app.cache
+    print(json.dumps({
+        "qps": qps,
+        "achieved_qps": report.achieved_qps,
+        "p50_ms": report.p50_ms,
+        "p99_ms": report.p99_ms,
+        "errors": report.n_errors,
+        "http_5xx": http_5xx[0],
+        "full_path_s": full_path_s,
+        "delta_path_s": delta_path_s,
+        "delta_publish_s": published_s,
+        "publish_to_applied_ms": publish_to_applied_ms,
+        "delta_underload_s": delta_mid.get("applied_s"),
+        "speedup": full_path_s / delta_path_s,
+        "delta_applied_total": app.engine.delta_applied_total,
+        "delta_rejected_total": app.engine.delta_rejected_total,
+        "freshness_lag_s": app.engine.freshness_lag_s(),
+        "cache_hit_ratio": cache.hit_ratio() if cache else None,
+        "cache_hits_after_warm": (cache.hits - hits_before) if cache else None,
+        "cache_invalidated_keys": cache.invalidated_keys if cache else None,
+        "cache_selective_invalidations": (
+            cache.selective_invalidations if cache else None
+        ),
+        "fleet_affinity_hit_ratio": fleet["affinity_hit_ratio"],
+        "fleet_baseline_hit_ratio": fleet["baseline_hit_ratio"],
+        "fleet_multiplier": fleet["multiplier"],
+        "platform": dev.platform,
+    }))
+"""
+
 # the traffic-shape phase (ISSUE 8): the PR 1-3 shed/degrade/eject
 # machinery exercised under the load shapes production actually has,
 # not constant-rate Poisson. Three brackets through the full in-process
@@ -1828,10 +2070,15 @@ with tempfile.TemporaryDirectory(prefix="kmls_resume_") as root:
 """
 
 _REPLAY_CLIENT = r"""
-import os, pickle, sys
-from kmlserver_tpu.serving.replay import replay_async_http, sample_seed_sets
+import json, os, pickle, sys
+from kmlserver_tpu.serving.replay import (
+    ClientTraceLog, replay_async_http, sample_seed_sets,
+)
 
 url, qps, n, pickles = sys.argv[1], float(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+# optional 5th arg: JSONL path for echoed X-KMLS-Trace ids + client
+# send/recv wall clocks — the client half of scripts/kmls_tracejoin.py
+trace_path = sys.argv[5] if len(sys.argv) > 5 else None
 # seed vocabulary straight from the artifact pickle — no jax in the client
 # (the server owns the TPU; libtpu is one process per chip)
 with open(pickles, "rb") as f:
@@ -1842,12 +2089,17 @@ with open(pickles, "rb") as f:
 # capacity = n_conns x pipeline; through the remote-TPU tunnel (~0.3-0.5 s
 # per response) Little's law at 1k QPS needs ~500 in flight, so the conn
 # count scales with the env override rather than a fixed 64.
+trace_log = ClientTraceLog() if trace_path else None
 report = replay_async_http(
     url, sample_seed_sets(vocab, n), qps=qps,
     n_conns=min(int(os.environ.get("KMLS_BENCH_REPLAY_WORKERS", "48")), 128),
     max_queue=int(os.environ.get("KMLS_BENCH_REPLAY_QUEUE", "4096")),
+    trace_log=trace_log,
 )
-print(report.to_json())
+out = json.loads(report.to_json())
+if trace_log is not None:
+    out["trace_records"] = trace_log.write_jsonl(trace_path)
+print(json.dumps(out))
 """
 
 
@@ -2440,7 +2692,14 @@ def replay_phase(platform: str) -> dict | None:
 
         srv_env = _phase_env(platform)
         srv_env.update({"BASE_DIR": base, "KMLS_PORT": "0",
-                        "POLLING_WAIT_IN_MINUTES": "1"})
+                        "POLLING_WAIT_IN_MINUTES": "1",
+                        # arm span tracing at the overhead-bracket-proven
+                        # sample so the final run's echoed ids can be
+                        # JOINed against /debug/traces (ISSUE 9
+                        # remainder); traceoverhead pins p99 ≤ 1.05x at
+                        # this setting every round, and the per-run
+                        # summaries keep the raw numbers honest
+                        "KMLS_TRACE_SAMPLE": "0.01"})
         if platform == "tpu":
             # ride the tunnel: through this environment's remote-TPU link
             # every device call pays ~65 ms of round trip, so batch-32
@@ -2529,7 +2788,8 @@ def replay_phase(platform: str) -> dict | None:
                     break
                 r = _run_phase(
                     "replay-client", _REPLAY_CLIENT,
-                    [url, str(qps), str(n_req), pickles],
+                    [url, str(qps), str(n_req), pickles,
+                     os.path.join(base, "trace_client.jsonl")],
                     platform="cpu", timeout=600, extra_env=client_env,
                 )
                 if r is not None:
@@ -2558,6 +2818,38 @@ def replay_phase(platform: str) -> dict | None:
                 run_summaries.append(s)
             report = sorted(runs, key=lambda r: r["p50_ms"])[len(runs) // 2]
             report["runs"] = run_summaries
+            # trace JOIN (ISSUE 9 remainder): the last run's client
+            # records vs the server's retained spans, merged by
+            # scripts/kmls_tracejoin.py — proves the end-to-end id
+            # propagation + join tooling against a REAL HTTP stack
+            client_jsonl = os.path.join(base, "trace_client.jsonl")
+            if os.path.exists(client_jsonl):
+                try:
+                    traces_path = os.path.join(base, "debug_traces.json")
+                    with urllib.request.urlopen(
+                        url + "/debug/traces", timeout=10
+                    ) as resp:
+                        with open(traces_path, "wb") as fh:
+                            fh.write(resp.read())
+                    join = subprocess.run(
+                        [sys.executable,
+                         os.path.join("scripts", "kmls_tracejoin.py"),
+                         "--client", client_jsonl, "--traces", traces_path],
+                        capture_output=True, text=True, timeout=60,
+                        cwd=os.path.dirname(os.path.abspath(__file__)),
+                    )
+                    joined = len(
+                        [ln for ln in join.stdout.splitlines() if ln.strip()]
+                    )
+                    report["trace_joined"] = joined
+                    report["trace_sample"] = 0.01
+                    log(
+                        f"[replay] tracejoin: {joined} per-request "
+                        "timelines merged (client send/recv x server "
+                        "spans)"
+                    )
+                except Exception as exc:
+                    log(f"[replay] tracejoin skipped: {exc!r}")
             report["host_load1"] = round(load1, 2)
             report["warmup_requests"] = n_warm
             report["job_end_to_end_s"] = job_end_to_end_s
@@ -2965,6 +3257,13 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
     if "traceoverhead_p99_ratio" not in result:
         _record_traceoverhead(result, bank="traceoverhead_cpu", budget_s=150)
         em.checkpoint()
+
+    # continuous-freshness bracket (ISSUE 10): CPU-measured by
+    # construction — the ≥5x delta speedup / zero-5xx / fleet-multiplier
+    # acceptance evidence must ride the TPU artifact too
+    if "freshness_speedup" not in result:
+        _record_freshness(result, bank="freshness_cpu", budget_s=200)
+        em.checkpoint()
     return mining
 
 
@@ -3011,6 +3310,13 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # tracing-overhead micro-bracket (ISSUE 9): sampled tracing p99
         # within 5% of disabled; disabled recorder allocates nothing
         _record_traceoverhead(result)
+        em.checkpoint()
+
+    if _remaining() > 200:
+        # continuous-freshness bracket (ISSUE 10): delta publish→applied
+        # vs full re-mine + republish, zero 5xx through the in-place
+        # apply, hot cache surviving selectively, fleet multiplier
+        _record_freshness(result)
         em.checkpoint()
 
     if _remaining() > 120:
@@ -3179,7 +3485,13 @@ def _record_replay(
                      # CPU-measured job bracket can never masquerade as TPU
                      ("job_end_to_end_s", "replay_job_end_to_end_s"),
                      ("server_percentiles_basis", "replay_server_basis"),
-                     ("server_percentiles_note", "replay_server_note")):
+                     ("server_percentiles_note", "replay_server_note"),
+                     # trace JOIN evidence (ISSUE 9 remainder): client
+                     # records carrying echoed X-KMLS-Trace ids, and the
+                     # per-request timelines kmls_tracejoin.py merged
+                     ("trace_records", "replay_trace_records"),
+                     ("trace_joined", "replay_trace_joined"),
+                     ("trace_sample", "replay_trace_sample")):
         if src in replay:
             result[dst] = replay[src]
     server_pcts = replay.get("server_percentiles")
@@ -3312,6 +3624,58 @@ def _record_loadshape(
     for key, val in flat.items():
         if val is not None:
             result[key] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_freshness(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The continuous-freshness bracket (ISSUE 10): full re-mine +
+    republish vs incremental delta publish→applied-in-serving on the ds2
+    shape, with a Zipf replay running through the in-place apply. Judged
+    claims: freshness_speedup ≥ 5, freshness_http_5xx == 0 mid-apply,
+    and the hot cache surviving the delta (selective invalidation —
+    freshness_cache_invalidated_keys stays a sliver of the entry count).
+    freshness_fleet_multiplier is the 3-replica affinity decision number.
+    CPU-platform by construction, self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "freshness", _FRESHNESS_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"freshness: full path {res['full_path_s']:.2f}s vs delta "
+        f"{res['delta_path_s']:.2f}s ({res['speedup']:.1f}x), "
+        f"publish→applied {res['publish_to_applied_ms']:.0f}ms, "
+        f"{res['http_5xx']} 5xx mid-apply, "
+        f"{res['cache_invalidated_keys']} keys selectively invalidated, "
+        f"fleet multiplier {res['fleet_multiplier']:.2f}x"
+    )
+    for src, dst in (
+        ("full_path_s", "freshness_full_path_s"),
+        ("delta_path_s", "freshness_delta_path_s"),
+        ("delta_publish_s", "freshness_delta_publish_s"),
+        ("publish_to_applied_ms", "freshness_publish_to_applied_ms"),
+        ("speedup", "freshness_speedup"),
+        ("errors", "freshness_errors"),
+        ("http_5xx", "freshness_http_5xx"),
+        ("p99_ms", "freshness_p99_ms"),
+        ("delta_applied_total", "freshness_delta_applied"),
+        ("delta_rejected_total", "freshness_delta_rejected"),
+        ("cache_hit_ratio", "freshness_cache_hit_ratio"),
+        ("cache_invalidated_keys", "freshness_cache_invalidated_keys"),
+        ("fleet_affinity_hit_ratio", "freshness_fleet_affinity_hit"),
+        ("fleet_baseline_hit_ratio", "freshness_fleet_baseline_hit"),
+        ("fleet_multiplier", "freshness_fleet_multiplier"),
+        ("platform", "freshness_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
 
 
 def _record_traceoverhead(

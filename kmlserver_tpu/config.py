@@ -138,6 +138,13 @@ KNOB_REGISTRY: dict[str, str] = {
     # --- serving: hybrid rule∪embedding merge (second model family) ---
     "KMLS_HYBRID_MODE": "serving",
     "KMLS_HYBRID_BLEND_WEIGHT": "serving",
+    # --- serving: fleet cache affinity (ISSUE 10) ---
+    # rendezvous-hash request affinity (freshness/ring.py): count how much
+    # real traffic an affinity router would keep ring-local before
+    # committing to one (or to a shared external cache tier)
+    "KMLS_CACHE_AFFINITY": "serving",
+    "KMLS_CACHE_AFFINITY_PEERS": "serving",
+    "KMLS_CACHE_AFFINITY_SELF": "serving",
     # --- serving: observability (ISSUE 9) ---
     # span tracing: baseline sample rate for OK traces (0 = tracing off —
     # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
@@ -193,8 +200,17 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_COORDINATOR_ADDRESS": "mining",
     "KMLS_NUM_PROCESSES": "mining",
     "KMLS_PROCESS_ID": "mining",
+    # --- mining: continuous freshness (ISSUE 10) ---
+    # cap on the delta chain length before the pipeline forces a full
+    # re-mine (accumulated patch cost + chain-replay cost at cold start)
+    "KMLS_DELTA_MAX_CHAIN": "mining",
     # --- both workloads ---
     "KMLS_NATIVE": "both",
+    # continuous freshness (ISSUE 10): mining publishes incremental
+    # delta-<seq>.bundle artifacts between full re-mines; serving applies
+    # them in place (engine.apply_pending_deltas) with selective cache
+    # invalidation instead of a full reload
+    "KMLS_DELTA_ENABLED": "both",
     "KMLS_JAX_CACHE_DIR": "both",
     # model layout: replicated per-device tensors vs vocab-sharded across
     # the mesh — read by the serving engine (rule/embedding tensors) and
@@ -234,6 +250,10 @@ KNOB_REGISTRY: dict[str, str] = {
     # sampled-vs-disabled p99 comparison bracket
     "KMLS_BENCH_TRACE_QPS": "tool",
     "KMLS_BENCH_TRACE_REQUESTS": "tool",
+    # continuous-freshness phase (ISSUE 10): request rate/volume for the
+    # mid-delta zero-5xx replay bracket
+    "KMLS_BENCH_FRESHNESS_QPS": "tool",
+    "KMLS_BENCH_FRESHNESS_REQUESTS": "tool",
     "KMLS_SWEEP_START": "tool",
     "KMLS_SWEEP_STOP": "tool",
     "KMLS_SWEEP_STEP": "tool",
@@ -245,6 +265,7 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_FAULT_CKPT_CORRUPT": "fault",
     "KMLS_FAULT_RANK_DEAD": "fault",
     "KMLS_FAULT_EMBED_CORRUPT": "fault",
+    "KMLS_FAULT_DELTA_CORRUPT": "fault",
 }
 
 # Columns dropped from the raw CSV before any processing
@@ -364,6 +385,21 @@ class MiningConfig:
     # L2 regularization λ on both factor matrices.
     als_reg: float = 0.1
 
+    # --- continuous freshness (ISSUE 10) ---
+    # Incremental delta mining: after a full publication the pipeline
+    # saves a freshness base state (encode membership + published rule
+    # tensors + dataset byte-prefix fingerprint); a later run finds the
+    # dataset grew append-only and publishes a delta-<seq>.bundle (changed
+    # rule rows + tombstones, base-sha256-bound) through the lease path
+    # instead of re-mining everything. Off by default — the reference has
+    # no incremental posture, and serving ignores chains unless its own
+    # KMLS_DELTA_ENABLED is set.
+    delta_enabled: bool = False
+    # Chain cap: at this many unapplied-on-top-of-base deltas the next
+    # run full-re-mines instead (bounds cold-start chain replay and
+    # accumulated patch drift surface). 0 = unlimited.
+    delta_max_chain: int = 16
+
     # --- mining telemetry (ISSUE 9) ---
     # Write per-phase progress/duration/bytes counters to
     # pickles/job_metrics.prom (node-exporter textfile-collector format)
@@ -463,6 +499,8 @@ class MiningConfig:
             als_rank=_getenv_int("KMLS_ALS_RANK", 32),
             als_iters=_getenv_int("KMLS_ALS_ITERS", 8),
             als_reg=_getenv_float("KMLS_ALS_REG", 0.1),
+            delta_enabled=_getenv_bool("KMLS_DELTA_ENABLED", False),
+            delta_max_chain=_getenv_int("KMLS_DELTA_MAX_CHAIN", 16),
             job_metrics=_getenv_bool("KMLS_JOB_METRICS", True),
             checkpoint_enabled=_getenv_bool("KMLS_CKPT_ENABLED", True),
             checkpoint_dir=os.getenv("KMLS_CKPT_DIR", ""),
@@ -634,6 +672,22 @@ class ServingConfig:
     # of the popularity ranking (cheapest possible answer).
     fallback_budget_ms: float = 50.0
 
+    # --- continuous freshness (ISSUE 10) ---
+    # Apply delta bundles published between full re-mines: the poll loop
+    # checks the delta chain alongside the invalidation token and patches
+    # the live per-device tensors in place (epoch advances to a
+    # (base, delta_seq) pair; the answer cache invalidates selectively).
+    # Off by default; a full token rewrite always behaves as before.
+    delta_enabled: bool = False
+    # Rendezvous-hash request affinity (freshness/ring.py): when on, the
+    # app counts ring-local vs ring-remote requests over the peer set so
+    # operators can measure the affinity win before routing on it.
+    cache_affinity: bool = False
+    # Comma-separated replica identities (headless-Service pod DNS names);
+    # this replica's own identity (default: hostname) is added if absent.
+    cache_affinity_peers: str = ""
+    cache_affinity_self: str = ""
+
     # --- observability (ISSUE 9): span tracing + runtime health ---
     # Baseline retention probability for OK traces once tracing is on.
     # 0 (default) disables tracing entirely: no trace context, no id
@@ -722,6 +776,10 @@ class ServingConfig:
             fallback_budget_ms=_getenv_float("KMLS_FALLBACK_BUDGET_MS", 50.0),
             hybrid_mode=_getenv_hybrid_mode(),
             hybrid_blend_weight=_getenv_float("KMLS_HYBRID_BLEND_WEIGHT", 0.5),
+            delta_enabled=_getenv_bool("KMLS_DELTA_ENABLED", False),
+            cache_affinity=_getenv_bool("KMLS_CACHE_AFFINITY", False),
+            cache_affinity_peers=os.getenv("KMLS_CACHE_AFFINITY_PEERS", ""),
+            cache_affinity_self=os.getenv("KMLS_CACHE_AFFINITY_SELF", ""),
             trace_sample=_getenv_float("KMLS_TRACE_SAMPLE", 0.0),
             trace_buffer=_getenv_int("KMLS_TRACE_BUFFER", 512),
             trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),

@@ -40,6 +40,10 @@ Sites currently wired:
   ``embeddings.npz`` unloadable exactly like a torn/corrupt file — the
   reload must still publish a rules-only bundle (graceful degradation,
   never a failed reload, never a 5xx).
+- ``"delta.apply"`` — fired inside the engine's delta-bundle apply path
+  (continuous freshness, freshness/delta.py): a fail fault rejects the
+  bundle exactly like a torn/wrong-base delta — the base generation
+  keeps serving (kmls_delta_rejected_total counts it), never a 5xx.
 
 Arming, two ways:
 
@@ -61,7 +65,9 @@ Arming, two ways:
   - ``KMLS_FAULT_RANK_DEAD=rank`` — silence rank ``rank``'s watchdog
     heartbeats permanently (a dead multi-host process);
   - ``KMLS_FAULT_EMBED_CORRUPT=N`` — fail the next N embedding-artifact
-    loads (rules-only degradation, not a failed reload).
+    loads (rules-only degradation, not a failed reload);
+  - ``KMLS_FAULT_DELTA_CORRUPT=N`` — reject the next N delta-bundle
+    applies (base keeps serving, delta_rejected counted).
 
 File corruption is a separate concern (faults happen to BYTES, not call
 sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
@@ -206,6 +212,9 @@ def load_env(force: bool = False) -> None:
     raw = os.getenv("KMLS_FAULT_EMBED_CORRUPT")
     if raw:
         inject("embed.artifact", times=int(raw))
+    raw = os.getenv("KMLS_FAULT_DELTA_CORRUPT")
+    if raw:
+        inject("delta.apply", times=int(raw))
 
 
 def _ensure_env() -> None:

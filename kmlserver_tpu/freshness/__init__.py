@@ -1,0 +1,18 @@
+"""Continuous freshness — incremental delta mining, delta bundle
+publication, and the fleet-aware cache tier (ISSUE 10).
+
+The third writer/reader pair on the PR 2-4 artifact spine:
+
+- :mod:`.delta` — the mining-side ``delta`` pipeline mode (fingerprint
+  the previous run's encode state, re-encode only appended CSV rows,
+  recount support restricted to affected baskets' vocab columns, publish
+  a versioned delta bundle through the lease + fencing-token path) and
+  the ONE canonical base∘delta application both sides share;
+- :mod:`.ring` — rendezvous-hash request affinity over the replica
+  fleet, plus the simulated-topology harness that measures the
+  fleet-wide effective-hit-ratio multiplier before committing to a
+  shared external cache tier.
+"""
+
+from .delta import DeltaIneligible, apply_delta_to_tensors  # noqa: F401
+from .ring import RendezvousRing  # noqa: F401

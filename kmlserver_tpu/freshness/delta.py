@@ -1,0 +1,779 @@
+"""Incremental delta mining — freshness without the full-mine wall clock.
+
+Every GitOps sync used to re-mine and re-publish the full bundle, so
+freshness lag equaled full-mine time (the continuous-training posture the
+Google Ads infra paper argues against — PAPERS.md, arXiv:2501.10546:
+models are never retrained from scratch on a sync cadence; deltas flow).
+This module is the mining half of the third writer/reader pair on the
+artifact spine:
+
+- after a FULL publication, :func:`save_base_state` persists the encode
+  state (membership pairs, pid ranks, full vocabulary) plus the published
+  rule tensors and the dataset's byte-prefix fingerprint;
+- a later run with ``KMLS_DELTA_ENABLED=1`` calls :func:`run_delta_job`,
+  which fingerprints the CSV against the base: an UNCHANGED prefix plus
+  appended rows is the delta case — only the appended rows are re-encoded
+  (``pandas`` over the suffix bytes, never the full file), and support is
+  recounted restricted to the affected baskets' vocab columns
+  (``parallel.support.restricted_pair_counts`` — rows R of C = XᵀX, the
+  same int8 MXU contraction the full mine uses, mesh-sharded under the
+  sharded layout);
+- the changed rule rows + tombstones publish as a versioned
+  ``delta-<seq>.bundle`` (io/artifacts.py) bound to the base generation
+  by token AND the published npz's sha256, under the same
+  :class:`~..io.artifacts.PublicationLease` fencing-token protocol as a
+  full publication — a zombie writer cannot tear the chain. The
+  invalidation token is deliberately NOT rewritten: serving applies the
+  bundle in place (``engine.apply_pending_deltas``) instead of a full
+  swap.
+
+**Bit-identity** is the contract: base ∘ delta chain == full re-mine,
+tensors and answers, at replicated AND vocab-sharded layouts (pinned by
+tests/test_freshness.py). It holds because the recompute set is provably
+sufficient under append-only input:
+
+- a pair count C[i, j] changes only when some playlist whose basket
+  contains i (or j) gained a membership → every changed row index is in
+  the affected baskets' vocab (the **touched** set);
+- appended rows can only GROW ``n_playlists``, so ``min_count`` is
+  non-decreasing: rules can only drop OUT of untouched rows, and a
+  dropped rule is visible in the base tensors — rows carrying any count
+  in the ``[old_min_count, new_min_count)`` crossing band are added to
+  the recompute set (no unstored rule can re-enter: emission kept the
+  top-k by count, so everything it truncated sits below what it kept);
+- vocabulary membership travels by NAME: the bundle carries the complete
+  new (pruned) vocabulary, unchanged base rows re-map into it by name,
+  and a consequent pointing at a name that left the vocabulary can only
+  occur in a crossing-band row, which is recomputed.
+
+Anything outside those guarantees — a rewritten/truncated prefix,
+``sample_ratio`` head-slicing, the triple-antecedent confidence merge
+(``max_itemset_len >= 3``), a multi-host gang, a chain at its cap —
+raises :class:`DeltaIneligible` and the pipeline falls back to a full
+re-mine: the delta path must never publish an approximation.
+
+Deltas patch the RULE model only: the popularity ranking, the auxiliary
+vocab artifacts, and the ALS embeddings refresh on the next full re-mine
+(documented in README "Continuous freshness").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io as io_mod
+import json
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from ..config import MiningConfig
+from ..io import artifacts
+from ..mining.vocab import Baskets, Vocab
+from ..ops.rules import derive_confs
+from ..ops.support import min_count_for
+
+BASE_STATE_FILENAME = "freshness.base.pickle"
+BASE_STATE_VERSION = 1
+
+# MiningConfig fields that change delta-relevant output; a base state
+# written under different values never seeds a delta (full re-mine).
+_DELTA_CONFIG_FIELDS = (
+    "min_support",
+    "sample_ratio",
+    "max_itemset_len",
+    "k_max_consequents",
+    "confidence_mode",
+    "min_confidence",
+    "prune_vocab_threshold",
+    "model_layout",
+)
+
+
+class DeltaIneligible(RuntimeError):
+    """This run cannot be served by a delta — full re-mine instead."""
+
+
+def base_state_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, BASE_STATE_FILENAME)
+
+
+def delta_config_fingerprint(cfg: MiningConfig) -> str:
+    ident = {f: getattr(cfg, f) for f in _DELTA_CONFIG_FIELDS}
+    blob = json.dumps(ident, sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# base state
+# ---------------------------------------------------------------------------
+
+
+def save_base_state(
+    cfg: MiningConfig,
+    *,
+    token: str,
+    run_index: int,
+    dataset_path: str,
+    baskets: Baskets,
+    pid_values: np.ndarray,
+    published: dict[str, Any],
+    npz_sha256: str | None,
+    dataset_digest: tuple[int, str] | None = None,
+) -> str:
+    """Persist the delta seed after a publication (full or delta): the
+    encode state the next incremental run extends, plus the CURRENT
+    logical rule tensors (base ∘ applied chain) the next crossing-band
+    scan reads. Atomic, writer rank only (callers gate).
+
+    ``dataset_digest``: ``(bytes, sha256)`` when the caller already
+    streamed the dataset (the delta route's append-only fingerprint
+    covers the whole file) — re-reading a multi-GB CSV just to re-hash
+    it would put a linear-in-dataset term back into the delta path. The
+    pair is the fingerprint-time snapshot, so bytes and digest always
+    describe the SAME prefix even if the feed appends mid-run."""
+    if dataset_digest is not None:
+        ds_bytes, ds_sha = dataset_digest
+    else:
+        digest = artifacts.file_digest(dataset_path)
+        ds_bytes, ds_sha = digest["bytes"], digest["sha256"]
+    state = {
+        "version": BASE_STATE_VERSION,
+        "token": token,
+        "run_index": run_index,
+        "dataset": os.path.basename(dataset_path),
+        "dataset_bytes": ds_bytes,
+        "dataset_sha256": ds_sha,
+        "config_fingerprint": delta_config_fingerprint(cfg),
+        "playlist_rows": np.asarray(baskets.playlist_rows, dtype=np.int32),
+        "track_ids": np.asarray(baskets.track_ids, dtype=np.int32),
+        "n_playlists": int(baskets.n_playlists),
+        "vocab_names": list(baskets.vocab.names),
+        "pid_values": np.asarray(pid_values, dtype=np.int64),
+        "published": published,
+        "npz_sha256": npz_sha256,
+    }
+    path = base_state_path(cfg.pickles_dir)
+    artifacts.save_pickle(state, path)
+    return path
+
+
+def load_base_state(pickles_dir: str) -> dict[str, Any] | None:
+    path = base_state_path(pickles_dir)
+    try:
+        state = artifacts.load_pickle(path)
+    except Exception:
+        return None
+    if not isinstance(state, dict) or state.get("version") != BASE_STATE_VERSION:
+        return None
+    return state
+
+
+def published_from_tensors(tensors, vocab_names: list[str]) -> dict[str, Any]:
+    """The ``published`` base-state slice from a mined RuleTensors."""
+    return {
+        "vocab": list(vocab_names),
+        "rule_ids": np.asarray(tensors.rule_ids, dtype=np.int32),
+        "rule_counts": np.asarray(tensors.rule_counts, dtype=np.int32),
+        "item_counts": np.asarray(tensors.item_counts, dtype=np.int32),
+        "n_playlists": int(tensors.n_playlists),
+        "min_support": float(tensors.min_support),
+        "mode": str(tensors.mode),
+        "min_confidence": float(tensors.min_confidence),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the ONE canonical base ∘ delta application (mining AND serving use it)
+# ---------------------------------------------------------------------------
+
+
+def apply_delta_to_tensors(
+    prev: dict[str, Any], bundle: dict[str, Any]
+) -> dict[str, Any]:
+    """Apply one delta bundle to the previous logical tensors → the new
+    logical tensors, in :func:`published_from_tensors` shape.
+
+    Row identity travels by name: every new-vocab row is either overwritten
+    from the bundle's changed set or copied from the base row of the SAME
+    name with its consequent ids re-mapped old→new. A structural
+    impossibility (a new name with no base row and no changed row, or an
+    unchanged row whose consequent left the vocabulary) raises
+    ``ValueError`` — the caller rejects the bundle and keeps serving."""
+    prev_vocab: list[str] = prev["vocab"]
+    new_vocab: list[str] = bundle["vocab"]
+    prev_index = {n: i for i, n in enumerate(prev_vocab)}
+    k_prev = prev["rule_ids"].shape[1]
+    k_new = bundle["changed_rule_ids"].shape[1] if len(
+        bundle["changed_rows"]
+    ) else k_prev
+    if len(bundle["changed_rows"]) and k_new != k_prev:
+        raise ValueError(
+            f"delta row capacity {k_new} != base row capacity {k_prev}"
+        )
+    v_new = len(new_vocab)
+    # old-id → new-id map (−1 = name left the vocabulary)
+    remap = np.full(len(prev_vocab) + 1, -1, dtype=np.int32)
+    new_index = {n: i for i, n in enumerate(new_vocab)}
+    for old_i, name in enumerate(prev_vocab):
+        remap[old_i] = new_index.get(name, -1)
+    changed = np.zeros(v_new, dtype=bool)
+    changed[bundle["changed_rows"]] = True
+    # gather source rows for unchanged entries
+    src = np.full(v_new, -1, dtype=np.int64)
+    for new_i, name in enumerate(new_vocab):
+        if not changed[new_i]:
+            j = prev_index.get(name)
+            if j is None:
+                raise ValueError(
+                    f"new vocab row {name!r} has no base row and no "
+                    "changed entry — corrupt delta"
+                )
+            src[new_i] = j
+    rule_ids = np.full((v_new, k_prev), -1, dtype=np.int32)
+    rule_counts = np.zeros((v_new, k_prev), dtype=np.int32)
+    item_counts = np.zeros(v_new, dtype=np.int32)
+    unchanged = ~changed
+    if unchanged.any():
+        rows = src[unchanged]
+        old_ids = prev["rule_ids"][rows]
+        mapped = np.where(old_ids >= 0, remap[old_ids], -1)
+        if bool(((old_ids >= 0) & (mapped < 0)).any()):
+            raise ValueError(
+                "an unchanged row's consequent left the vocabulary — "
+                "the crossing-band recompute should have covered it; "
+                "corrupt delta"
+            )
+        rule_ids[unchanged] = mapped
+        rule_counts[unchanged] = prev["rule_counts"][rows]
+        item_counts[unchanged] = prev["item_counts"][rows]
+    if len(bundle["changed_rows"]):
+        rule_ids[bundle["changed_rows"]] = bundle["changed_rule_ids"]
+        rule_counts[bundle["changed_rows"]] = bundle["changed_rule_counts"]
+        item_counts[bundle["changed_rows"]] = bundle["changed_item_counts"]
+    return {
+        "vocab": list(new_vocab),
+        "rule_ids": rule_ids,
+        "rule_counts": rule_counts,
+        "item_counts": item_counts,
+        "n_playlists": int(bundle["n_playlists"]),
+        "min_support": float(prev["min_support"]),
+        "mode": str(prev["mode"]),
+        "min_confidence": float(prev["min_confidence"]),
+    }
+
+
+def touched_names(bundle: dict[str, Any]) -> set[str]:
+    """The seed names whose answers may have changed under this bundle —
+    the selective cache-invalidation set: changed rows + tombstones.
+    Rows that merely re-mapped ids kept their name-level answers."""
+    vocab = bundle["vocab"]
+    out = {vocab[int(i)] for i in bundle["changed_rows"]}
+    out.update(bundle["tombstones"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# restricted emission (numpy twin of the dense emission, per selected row)
+# ---------------------------------------------------------------------------
+
+
+def emit_rule_rows_np(
+    counts_rows: np.ndarray,
+    row_ids: np.ndarray,
+    min_count: int,
+    k_max: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Emission for SELECTED rows of the count matrix: identical per-row
+    semantics (diagonal masking at the global row id, threshold, top-k
+    with ``lax.top_k``'s ascending-index tie order via the same composite
+    integer key as ``ops.rules.emit_rule_tensors_np``) → ``(rule_ids,
+    rule_counts, item_counts)`` for the selected rows."""
+    r, v = counts_rows.shape
+    if r == 0:
+        return (
+            np.full((0, k_max), -1, np.int32),
+            np.zeros((0, k_max), np.int32),
+            np.zeros(0, np.int32),
+        )
+    counts = counts_rows.astype(np.int64, copy=False)
+    rows = np.arange(r)
+    item_counts = counts[rows, row_ids].astype(np.int32)
+    valid = counts >= min_count
+    valid[rows, row_ids] = False
+    score = np.where(valid, counts, np.int64(-1))
+    key = score * np.int64(v) + (v - 1 - np.arange(v, dtype=np.int64))[None, :]
+    k = min(k_max, v)
+    if k < v:
+        part = np.argpartition(-key, k - 1, axis=1)[:, :k]
+    else:
+        part = np.broadcast_to(np.arange(v)[None, :], (r, v)).copy()
+    part_key = np.take_along_axis(key, part, axis=1)
+    order = np.argsort(-part_key, axis=1)
+    top_ids = np.take_along_axis(part, order, axis=1)
+    top_counts = np.take_along_axis(score, top_ids, axis=1)
+    keep = top_counts > 0
+    rule_ids = np.where(keep, top_ids, -1).astype(np.int32)
+    rule_counts = np.where(keep, top_counts, 0).astype(np.int32)
+    if k < k_max:
+        pad = ((0, 0), (0, k_max - k))
+        rule_ids = np.pad(rule_ids, pad, constant_values=-1)
+        rule_counts = np.pad(rule_counts, pad)
+    return rule_ids, rule_counts, item_counts
+
+
+def _confidence_filter_rows(
+    rule_ids: np.ndarray,
+    rule_counts: np.ndarray,
+    item_counts: np.ndarray,
+    min_confidence: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Mirror of ``assemble_rule_tensors``'s confidence-mode host filter
+    (float64, so device float32 rounding can never flip a decision)."""
+    conf64 = rule_counts / np.maximum(item_counts, 1)[:, None].astype(
+        np.float64
+    )
+    keep = (rule_ids >= 0) & (conf64 >= min_confidence)
+    return (
+        np.where(keep, rule_ids, -1).astype(np.int32),
+        np.where(keep, rule_counts, 0).astype(np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the delta mining job
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeltaResult:
+    """What one delta run produced (``bundle_path`` None = no new rows)."""
+
+    seq: int
+    bundle_path: str | None
+    n_new_rows: int
+    n_changed: int
+    n_tombstones: int
+    n_touched: int
+    duration_s: float
+    fencing_token: int | None
+    base_token: str
+    dataset: str = ""
+    run_index: int = 0
+
+
+def _read_suffix_table(path: str, offset: int, limit: int | None = None):
+    """Parse ONLY the appended CSV rows (header + suffix bytes through the
+    same pandas parser the full path falls back to) → (pids, names).
+    ``limit`` bounds the suffix to the bytes the caller fingerprinted, so
+    a feed appending mid-run can never desynchronize the saved digest
+    from the rows actually encoded (the extras land in the NEXT delta)."""
+    import pandas as pd
+
+    with open(path, "rb") as fh:
+        header = fh.readline()
+        if offset < len(header):
+            raise DeltaIneligible("appended region overlaps the CSV header")
+        fh.seek(offset - 1)
+        if fh.read(1) != b"\n":
+            raise DeltaIneligible(
+                "base prefix does not end at a line boundary — the "
+                "appender continued a partial row"
+            )
+        suffix = fh.read() if limit is None else fh.read(limit)
+    df = pd.read_csv(
+        io_mod.BytesIO(header + suffix), keep_default_na=False
+    )
+    if "pid" not in df.columns or "track_name" not in df.columns:
+        raise DeltaIneligible("appended rows missing pid/track_name columns")
+    try:
+        pids = df["pid"].astype(np.int64).to_numpy()
+    except (ValueError, TypeError) as exc:
+        raise DeltaIneligible(f"appended rows have invalid pids: {exc}")
+    return pids, df["track_name"].astype(str).to_numpy()
+
+
+def _check_eligibility(cfg: MiningConfig, base: dict[str, Any] | None) -> None:
+    import jax
+
+    if jax.process_count() > 1:
+        raise DeltaIneligible("multi-host gang (delta mining is single-host)")
+    if base is None:
+        raise DeltaIneligible("no freshness base state on the PVC")
+    if base.get("config_fingerprint") != delta_config_fingerprint(cfg):
+        raise DeltaIneligible("mining config changed since the base run")
+    if cfg.sample_ratio != 1.0:
+        raise DeltaIneligible("sample_ratio head-slicing breaks append semantics")
+    if cfg.max_itemset_len >= 3:
+        raise DeltaIneligible(
+            "triple/quad extensions need the full one-hot matrix"
+        )
+
+
+def _combined_baskets(
+    base: dict[str, Any], new_pids: np.ndarray, new_names: np.ndarray
+) -> tuple[Baskets, np.ndarray, np.ndarray]:
+    """Extend the base membership with the appended rows →
+    ``(combined baskets over the merged sorted vocab, merged pid values,
+    affected playlist-row mask)``. Exactly what a full re-mine's
+    ``build_baskets`` over the whole file produces: sorted-unique vocab,
+    pid-rank playlist rows, deduplicated membership pairs."""
+    base_names = base["vocab_names"]
+    merged_names = sorted(set(base_names) | set(new_names.tolist()))
+    vocab = Vocab(
+        names=merged_names, index={n: i for i, n in enumerate(merged_names)}
+    )
+    names_arr = np.asarray(merged_names, dtype=object)
+    # base ids re-rank into the merged sorted vocabulary
+    base_remap = np.searchsorted(
+        names_arr, np.asarray(base_names, dtype=object)
+    ).astype(np.int64)
+    merged_pids = np.union1d(base["pid_values"], np.unique(new_pids))
+    base_row_remap = np.searchsorted(merged_pids, base["pid_values"])
+    # scalar-key merge instead of a 2-D unique: encode (row, track) as
+    # row·V + track (monotone in lex order, V ≪ 2^31 so no overflow) —
+    # union1d over int64 keys is an order of magnitude faster than the
+    # structured lexsort np.unique(axis=0) runs on the full pair set,
+    # and the delta path exists to NOT pay full-mine-shaped costs
+    v_merged = np.int64(len(merged_names))
+    old_keys = (
+        base_row_remap[base["playlist_rows"].astype(np.int64)].astype(np.int64)
+        * v_merged
+        + base_remap[base["track_ids"].astype(np.int64)]
+    )
+    new_rows = np.searchsorted(merged_pids, new_pids)
+    new_tids = vocab.encode(new_names).astype(np.int64)
+    new_keys = new_rows.astype(np.int64) * v_merged + new_tids
+    keys = np.union1d(old_keys, new_keys)
+    combined = Baskets(
+        playlist_rows=(keys // v_merged).astype(np.int32),
+        track_ids=(keys % v_merged).astype(np.int32),
+        n_playlists=len(merged_pids),
+        vocab=vocab,
+    )
+    affected = np.zeros(len(merged_pids), dtype=bool)
+    affected[np.unique(new_rows)] = True
+    return combined, merged_pids, affected
+
+
+def run_delta_job(cfg: MiningConfig, mesh=None) -> DeltaResult:
+    """The ``delta`` pipeline mode. Raises :class:`DeltaIneligible`
+    whenever a full re-mine is the only correct answer."""
+    import jax  # noqa: F401  (process_count in _check_eligibility)
+
+    from ..mining import miner
+    from ..parallel import layout as layout_mod
+    from ..parallel.support import restricted_pair_counts
+
+    t0 = time.perf_counter()
+    base = load_base_state(cfg.pickles_dir)
+    _check_eligibility(cfg, base)
+    assert base is not None
+
+    # the base generation must still be the published one: another writer
+    # rewriting the token (or the npz) retires this base state
+    token_path = os.path.join(cfg.base_dir, cfg.data_invalidation_file)
+    try:
+        current_token = artifacts.read_text(token_path)
+    except FileNotFoundError:
+        raise DeltaIneligible("no invalidation token on the PVC")
+    if current_token != base["token"]:
+        raise DeltaIneligible("another generation published since the base run")
+    npz_path = artifacts.tensor_artifact_path(
+        os.path.join(cfg.pickles_dir, cfg.recommendations_file)
+    )
+    if base.get("npz_sha256") is None or not os.path.exists(npz_path):
+        raise DeltaIneligible("base run published no tensor artifact")
+    if artifacts.file_digest(npz_path)["sha256"] != base["npz_sha256"]:
+        raise DeltaIneligible("published tensor artifact changed on disk")
+
+    # chain cap: past it, accumulated patch cost exceeds a clean re-mine
+    state = artifacts.read_delta_state(cfg.pickles_dir)
+    entries: list[dict[str, Any]] = []
+    if state is not None:
+        if state.get("base_token") != base["token"]:
+            raise DeltaIneligible("delta chain bound to another generation")
+        entries = list(state["entries"])
+    if cfg.delta_max_chain > 0 and len(entries) >= cfg.delta_max_chain:
+        raise DeltaIneligible(
+            f"delta chain at its cap ({len(entries)}) — full re-mine"
+        )
+
+    # dataset fingerprint: unchanged prefix + appended suffix is the delta
+    # case; anything else is a rewrite and must fully re-mine
+    dataset_path = os.path.join(cfg.datasets_dir, base["dataset"])
+    if not os.path.exists(dataset_path):
+        raise DeltaIneligible(f"base dataset {base['dataset']} is gone")
+    size = os.path.getsize(dataset_path)
+    if size < base["dataset_bytes"]:
+        raise DeltaIneligible("dataset shrank — not append-only")
+    prefix_sha = hashlib.sha256()
+    with open(dataset_path, "rb") as fh:
+        remaining = base["dataset_bytes"]
+        while remaining > 0:
+            chunk = fh.read(min(1 << 20, remaining))
+            if not chunk:
+                break
+            prefix_sha.update(chunk)
+            remaining -= len(chunk)
+        prefix_hex = prefix_sha.hexdigest()
+        # continue the SAME stream through the suffix: the full-file
+        # digest the rolled-forward base state needs comes out of this
+        # one pass instead of a second linear re-read at save time
+        suffix_len = 0
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            prefix_sha.update(chunk)
+            suffix_len += len(chunk)
+    full_sha = prefix_sha.hexdigest()
+    hashed_bytes = base["dataset_bytes"] + suffix_len
+    if prefix_hex != base["dataset_sha256"]:
+        raise DeltaIneligible("dataset prefix rewritten — not append-only")
+    if size == base["dataset_bytes"]:
+        print("Delta mining: no new rows — nothing to publish")
+        return DeltaResult(
+            seq=entries[-1]["seq"] if entries else 0,
+            bundle_path=None, n_new_rows=0, n_changed=0, n_tombstones=0,
+            n_touched=0, duration_s=time.perf_counter() - t0,
+            fencing_token=None, base_token=base["token"],
+            dataset=base["dataset"], run_index=int(base["run_index"]),
+        )
+
+    # ---------- lease BEFORE the compute (fence zombies early) ----------
+    lease = None
+    if cfg.lease_enabled:
+        lease = artifacts.PublicationLease.acquire(
+            cfg.pickles_dir,
+            ttl_s=cfg.lease_ttl_s,
+            heartbeat_interval_s=cfg.lease_heartbeat_interval_s or None,
+        )
+        lease.start_heartbeat()
+        print(
+            f"Delta publication lease acquired (fencing token "
+            f"{lease.fencing_token})"
+        )
+    try:
+        new_pids, new_names = _read_suffix_table(
+            dataset_path, base["dataset_bytes"], limit=suffix_len
+        )
+        print(
+            f"Delta mining: {len(new_pids)} appended rows over "
+            f"{len(np.unique(new_pids))} playlists"
+        )
+        combined, merged_pids, affected = _combined_baskets(
+            base, new_pids, new_names
+        )
+
+        # mirror the full path's Apriori pruning decision EXACTLY
+        new_min = min_count_for(cfg.min_support, combined.n_playlists)
+        mined = combined
+        if combined.n_tracks > cfg.prune_vocab_threshold:
+            mined, _ = miner.prune_infrequent(combined, new_min)
+            if mined.n_tracks == 0:
+                if combined.n_tracks <= 4096:
+                    mined = combined
+                else:
+                    raise DeltaIneligible(
+                        "pruned vocabulary emptied — full re-mine decides"
+                    )
+
+        prev = base["published"]
+        old_min = min_count_for(cfg.min_support, prev["n_playlists"])
+        pruned_index = mined.vocab.index
+        # touched: every item of every affected basket (the columns whose
+        # count-matrix rows can have changed)
+        touched_mask = affected[combined.playlist_rows]
+        touched_full = np.unique(combined.track_ids[touched_mask])
+        recompute = {
+            combined.vocab.names[int(i)] for i in touched_full
+        }
+        # crossing band: untouched rows whose emitted rules (or key-set
+        # membership) can drop under the risen threshold
+        if new_min > old_min:
+            counts_band = (
+                (prev["rule_counts"] >= old_min)
+                & (prev["rule_counts"] < new_min)
+            ).any(axis=1)
+            items_band = (prev["item_counts"] >= old_min) & (
+                prev["item_counts"] < new_min
+            )
+            for i in np.flatnonzero(counts_band | items_band):
+                recompute.add(prev["vocab"][int(i)])
+        # names entering the published row space are touched by
+        # construction; keep the explicit union as a belt-and-braces
+        prev_set = set(prev["vocab"])
+        recompute.update(n for n in mined.vocab.names if n not in prev_set)
+        r_ids = np.asarray(
+            sorted(
+                pruned_index[n] for n in recompute if n in pruned_index
+            ),
+            dtype=np.int32,
+        )
+        tombstones = [n for n in prev["vocab"] if n not in pruned_index]
+        # sanity: every surviving unchanged row must exist in the base
+        changed_mark = np.zeros(mined.n_tracks, dtype=bool)
+        changed_mark[r_ids] = True
+        for i, name in enumerate(mined.vocab.names):
+            if not changed_mark[i] and name not in prev_set:
+                raise DeltaIneligible(
+                    f"row {name!r} is new but outside the recompute set"
+                )
+
+        # ---------- column-restricted recount (the device compute) ------
+        mesh = layout_mod.mining_mesh(cfg, mesh)
+        use_mesh = mesh is not None and layout_mod.wants_sharded_mining(
+            cfg, mesh
+        )
+        counts_r = restricted_pair_counts(
+            mined, r_ids, mesh=mesh if use_mesh else None
+        )
+        rule_ids, rule_counts, item_counts = emit_rule_rows_np(
+            counts_r, r_ids.astype(np.int64), new_min, cfg.k_max_consequents
+        )
+        if cfg.confidence_mode == "confidence":
+            rule_ids, rule_counts = _confidence_filter_rows(
+                rule_ids, rule_counts, item_counts, cfg.min_confidence
+            )
+        if rule_ids.shape[1] != prev["rule_ids"].shape[1]:
+            raise DeltaIneligible(
+                "row capacity changed vs the base artifact"
+            )
+
+        # shrink: drop recomputed rows that equal their (re-mapped) base
+        # row — their answers did not change, so the bundle (and the
+        # cache invalidation set) should not name them
+        new_index = {n: i for i, n in enumerate(mined.vocab.names)}
+        remap = np.full(len(prev["vocab"]) + 1, -1, dtype=np.int32)
+        for old_i, name in enumerate(prev["vocab"]):
+            remap[old_i] = new_index.get(name, -1)
+        prev_index = {n: i for i, n in enumerate(prev["vocab"])}
+        keep_rows = np.ones(len(r_ids), dtype=bool)
+        for e, row in enumerate(r_ids):
+            name = mined.vocab.names[int(row)]
+            j = prev_index.get(name)
+            if j is None:
+                continue
+            old_ids = prev["rule_ids"][j]
+            mapped = np.where(old_ids >= 0, remap[old_ids], -1)
+            if (
+                bool((mapped == rule_ids[e]).all())
+                and bool((prev["rule_counts"][j] == rule_counts[e]).all())
+                and int(prev["item_counts"][j]) == int(item_counts[e])
+            ):
+                keep_rows[e] = False
+        r_ids_k = r_ids[keep_rows]
+        rule_ids_k = rule_ids[keep_rows]
+        rule_counts_k = rule_counts[keep_rows]
+        item_counts_k = item_counts[keep_rows]
+
+        seq = (entries[-1]["seq"] + 1) if entries else 1
+        bundle_name = artifacts.delta_bundle_filename(seq)
+        bundle_path = os.path.join(cfg.pickles_dir, bundle_name)
+        if lease is not None:
+            lease.check()  # fence point: no zombie writes the chain
+        artifacts.save_delta_bundle(
+            bundle_path,
+            seq=seq,
+            base_token=base["token"],
+            base_npz_sha256=base["npz_sha256"],
+            n_playlists=combined.n_playlists,
+            min_count=new_min,
+            vocab=list(mined.vocab.names),
+            changed_rows=r_ids_k,
+            changed_rule_ids=rule_ids_k,
+            changed_rule_counts=rule_counts_k,
+            changed_item_counts=item_counts_k,
+            tombstones=tombstones,
+        )
+        digest = artifacts.file_digest(bundle_path)
+        entries.append(
+            {
+                "seq": seq,
+                "file": bundle_name,
+                "sha256": digest["sha256"],
+                "bytes": digest["bytes"],
+                "written_at": time.time(),
+                "fencing_token": lease.fencing_token if lease else None,
+                "n_changed": int(len(r_ids_k)),
+                "n_tombstones": len(tombstones),
+                "n_playlists": int(combined.n_playlists),
+            }
+        )
+        if lease is not None:
+            # last fence before the chain rewrite makes the bundle live
+            lease.check()
+        artifacts.write_delta_state(
+            cfg.pickles_dir, base["token"], base["npz_sha256"], entries
+        )
+
+        # roll the base state forward so the NEXT delta extends THIS one:
+        # membership/pids/dataset fingerprint advance, and `published`
+        # becomes base ∘ chain (the crossing-band scan must read current
+        # counts, not the original base's)
+        bundle = artifacts.load_delta_bundle(
+            bundle_path, expect_sha256=digest["sha256"]
+        )
+        applied = apply_delta_to_tensors(prev, bundle)
+        save_base_state(
+            cfg,
+            token=base["token"],
+            run_index=base["run_index"],
+            dataset_path=dataset_path,
+            baskets=combined,
+            pid_values=merged_pids,
+            published=applied,
+            npz_sha256=base["npz_sha256"],
+            dataset_digest=(hashed_bytes, full_sha),
+        )
+        if lease is not None:
+            lease.release()
+        duration = time.perf_counter() - t0
+        print(
+            f"Delta {seq} published: {len(r_ids_k)} changed rows, "
+            f"{len(tombstones)} tombstones, {len(recompute)} recomputed, "
+            f"{duration:.2f}s"
+        )
+        return DeltaResult(
+            seq=seq,
+            bundle_path=bundle_path,
+            n_new_rows=len(new_pids),
+            n_changed=int(len(r_ids_k)),
+            n_tombstones=len(tombstones),
+            n_touched=len(recompute),
+            duration_s=duration,
+            fencing_token=lease.fencing_token if lease else None,
+            base_token=base["token"],
+            dataset=base["dataset"], run_index=int(base["run_index"]),
+        )
+    except BaseException:
+        if lease is not None:
+            lease.stop_heartbeat()
+            try:
+                lease.release()
+            except (artifacts.LeaseLostError, OSError):
+                pass
+        raise
+    finally:
+        if lease is not None:
+            lease.stop_heartbeat()
+
+
+def derive_serving_arrays(
+    state: dict[str, Any]
+) -> tuple[list[str], np.ndarray, np.ndarray, np.ndarray]:
+    """Logical tensors → the serving-engine array quadruple
+    ``(vocab, rule_ids, rule_confs float32, known_mask)`` using exactly
+    the load-path derivations (shared so a patched generation can never
+    derive differently from a freshly loaded one)."""
+    confs = derive_confs(
+        state["rule_counts"], state["item_counts"],
+        state["n_playlists"], state["mode"],
+    )
+    known = state["item_counts"] >= min_count_for(
+        state["min_support"], state["n_playlists"]
+    )
+    return state["vocab"], state["rule_ids"], confs, np.asarray(known)

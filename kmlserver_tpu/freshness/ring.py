@@ -1,0 +1,129 @@
+"""Fleet-aware cache affinity — rendezvous hashing over replica identities.
+
+The answer cache (serving/cache.py) is per-process: the reference
+topology's 3 replicas each re-compute the same hot heads, so the fleet
+does ~N× the unique-query work one pod would. Two fixes exist — route
+requests so one replica OWNS each key (consistent-hash affinity at the
+ingress/client), or bolt on a shared external cache tier. The ROADMAP's
+decision path says MEASURE the affinity win first: this module is that
+measurement layer plus the production half of the affinity option.
+
+**Rendezvous (highest-random-weight) hashing**: the owner of a key is
+``argmax over peers of H(peer, key)``. Unlike a modulo ring, removing a
+peer re-maps ONLY the keys it owned (each surviving peer keeps its
+argmax), which is exactly the property a rolling k8s deployment needs —
+a pod replacement must not stampede every replica's cache at once.
+
+Wiring (all default-off): ``KMLS_CACHE_AFFINITY=1`` arms the layer,
+``KMLS_CACHE_AFFINITY_PEERS`` lists the replica identities (the headless
+Service's pod DNS names — e.g. ``fast-api-0.fast-api,...`` — or any
+stable ids), ``KMLS_CACHE_AFFINITY_SELF`` names THIS replica (default:
+hostname, which under a StatefulSet IS the pod DNS label). The app then
+counts ring-local vs ring-remote requests (``kmls_cache_affinity_*`` in
+/metrics) — the observable that says what fraction of real traffic an
+affinity router would keep local, before anyone deploys one.
+
+:func:`simulate_fleet` is the offline half: replay a key stream against
+an N-replica topology of bounded caches under affinity vs round-robin
+routing and report the effective-hit-ratio multiplier (the bench
+``freshness`` phase runs it at the reference's 3-replica shape).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+
+def _weight(peer: str, key: str) -> int:
+    digest = hashlib.blake2b(
+        f"{peer}\x1f{key}".encode("utf-8"), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+class RendezvousRing:
+    """Highest-random-weight owner selection over a stable peer set."""
+
+    def __init__(self, peers: list[str]):
+        cleaned = [p.strip() for p in peers if p and p.strip()]
+        if not cleaned:
+            raise ValueError("rendezvous ring needs at least one peer")
+        # stable order for deterministic max-tie resolution (a tie on the
+        # 64-bit weight is astronomically unlikely; order makes it defined)
+        self.peers = sorted(set(cleaned))
+
+    def owner(self, key: str) -> str:
+        return max(self.peers, key=lambda p: (_weight(p, key), p))
+
+    def owner_index(self, key: str) -> int:
+        return self.peers.index(self.owner(key))
+
+
+def seeds_key(seeds: list[str]) -> str:
+    """The ring key for a seed set — same canonicalization as the answer
+    cache (sorted, duplicates kept), so the owner of a request is the
+    owner of its cache entry."""
+    return "\x1f".join(sorted(seeds))
+
+
+class _BoundedSet:
+    """Tiny LRU set standing in for one replica's answer cache."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, capacity)
+        self._od: "OrderedDict[str, None]" = OrderedDict()
+
+    def hit_or_insert(self, key: str) -> bool:
+        if key in self._od:
+            self._od.move_to_end(key)
+            return True
+        self._od[key] = None
+        if len(self._od) > self.capacity:
+            self._od.popitem(last=False)
+        return False
+
+
+def simulate_fleet(
+    keys: list[str],
+    n_replicas: int,
+    capacity: int,
+    policy: str = "affinity",
+) -> float:
+    """Effective FLEET hit ratio for a key stream under a routing policy:
+    ``affinity`` (rendezvous owner), ``roundrobin``, or ``random``
+    (hash-of-position — deterministic, so runs are reproducible). Each
+    replica is a bounded LRU; the fleet hit ratio is hits/requests across
+    all replicas — the "work done per unique query" number the ROADMAP's
+    fleet item asks for."""
+    if policy not in ("affinity", "roundrobin", "random"):
+        raise ValueError(f"unknown routing policy {policy!r}")
+    peers = [f"replica-{i}" for i in range(max(1, n_replicas))]
+    ring = RendezvousRing(peers) if policy == "affinity" else None
+    caches = [_BoundedSet(capacity) for _ in peers]
+    hits = 0
+    for i, key in enumerate(keys):
+        if ring is not None:
+            idx = ring.peers.index(ring.owner(key))
+        elif policy == "roundrobin":
+            idx = i % len(peers)
+        else:
+            idx = _weight("route", f"{i}") % len(peers)
+        if caches[idx].hit_or_insert(key):
+            hits += 1
+    return hits / len(keys) if keys else 0.0
+
+
+def fleet_multiplier(
+    keys: list[str], n_replicas: int = 3, capacity: int = 512
+) -> dict[str, float]:
+    """The decision number: affinity vs round-robin effective hit ratio
+    over the same stream/topology, and their ratio (the fleet-wide
+    effective-hit-ratio multiplier the bench compact line reports)."""
+    affinity = simulate_fleet(keys, n_replicas, capacity, "affinity")
+    baseline = simulate_fleet(keys, n_replicas, capacity, "roundrobin")
+    return {
+        "affinity_hit_ratio": affinity,
+        "baseline_hit_ratio": baseline,
+        "multiplier": (affinity / baseline) if baseline > 0 else float("inf"),
+    }

@@ -49,6 +49,24 @@ QUARANTINE_DIRNAME = "quarantine"
 # engine loads it fail-soft — absent or corrupt means rules-only serving)
 EMBEDDINGS_FILENAME = "embeddings.npz"
 EMBEDDINGS_VERSION = 1
+# continuous freshness (kmlserver_tpu/freshness/): incremental delta
+# bundles published BETWEEN full re-mines. Each bundle carries the changed
+# rule rows + tombstones of one incremental re-mine, bound to the base
+# generation by token AND the published npz's sha256; the chain file lists
+# the bundles in application order. Written through the same atomic +
+# lease-fenced discipline as every other artifact; the invalidation token
+# is deliberately NOT rewritten (a token rewrite means "full reload" —
+# deltas are applied in place by engine.apply_pending_deltas()).
+DELTA_STATE_FILENAME = "delta.state.json"
+DELTA_BUNDLE_VERSION = 1
+
+
+def delta_bundle_filename(seq: int) -> str:
+    return f"delta-{int(seq):06d}.bundle"
+
+
+def delta_state_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, DELTA_STATE_FILENAME)
 
 
 class ArtifactIntegrityError(RuntimeError):
@@ -659,6 +677,188 @@ def rules_dict_from_tensors(loaded: dict[str, Any]) -> dict[str, dict[str, float
         mode=loaded["mode"],
         rule_confs64=loaded.get("rule_confs64"),
     )
+
+
+# ---------- continuous-freshness delta bundles ----------
+
+
+def save_delta_bundle(
+    path: str,
+    *,
+    seq: int,
+    base_token: str,
+    base_npz_sha256: str,
+    n_playlists: int,
+    min_count: int,
+    vocab: list[str],
+    changed_rows: np.ndarray,
+    changed_rule_ids: np.ndarray,
+    changed_rule_counts: np.ndarray,
+    changed_item_counts: np.ndarray,
+    tombstones: list[str],
+) -> None:
+    """Write one versioned delta bundle atomically.
+
+    ``vocab`` is the COMPLETE new published row space (the possibly
+    Apriori-pruned vocabulary after the incremental rows landed) — row
+    identity travels by NAME, so applying a delta re-maps unchanged base
+    rows into this ordering and overwrites ``changed_rows`` (indices into
+    ``vocab``) with the re-mined tensors. ``tombstones`` are base-vocab
+    names absent from the new vocabulary (their rows cease to exist).
+    ``base_npz_sha256`` binds the bundle to the exact base artifact bytes
+    it patches: a reader holding any other generation must reject it."""
+    if changed_rule_ids.shape != changed_rule_counts.shape:
+        raise ValueError(
+            f"changed_rule_ids {changed_rule_ids.shape} != "
+            f"changed_rule_counts {changed_rule_counts.shape}"
+        )
+    if len(changed_rows) != changed_rule_ids.shape[0] or len(
+        changed_rows
+    ) != len(changed_item_counts):
+        raise ValueError(
+            f"changed row count mismatch: {len(changed_rows)} rows vs "
+            f"{changed_rule_ids.shape[0]} id rows / "
+            f"{len(changed_item_counts)} item counts"
+        )
+    arrays = dict(
+        version=np.int64(DELTA_BUNDLE_VERSION),
+        seq=np.int64(seq),
+        base_token=np.asarray(base_token),
+        base_npz_sha256=np.asarray(base_npz_sha256),
+        n_playlists=np.int64(n_playlists),
+        min_count=np.int64(min_count),
+        vocab=np.asarray(vocab, dtype=object),
+        changed_rows=np.asarray(changed_rows, dtype=np.int32),
+        changed_rule_ids=changed_rule_ids.astype(np.int32),
+        changed_rule_counts=changed_rule_counts.astype(np.int32),
+        changed_item_counts=np.asarray(
+            changed_item_counts, dtype=np.int32
+        ),
+        tombstones=np.asarray(list(tombstones), dtype=object),
+    )
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    _atomic_write_bytes(path, buf.getvalue())
+
+
+def load_delta_bundle(path: str, expect_sha256: str | None = None) -> dict[str, Any]:
+    """Load + strictly validate a delta bundle. Raises ``ValueError`` on
+    ANY structural problem (torn bytes, wrong version, out-of-range row
+    indices, chain-entry digest mismatch) — the engine treats every raise
+    as "rejected": the base generation keeps serving, never a 5xx."""
+    if expect_sha256 is not None:
+        digest = file_digest(path)["sha256"]
+        if digest != expect_sha256:
+            raise ValueError(
+                f"{path}: bundle sha256 {digest} != chain entry "
+                f"{expect_sha256} (torn or tampered delta)"
+            )
+    with np.load(path, allow_pickle=True) as npz:
+        required = (
+            "version", "seq", "base_token", "base_npz_sha256",
+            "n_playlists", "min_count", "vocab", "changed_rows",
+            "changed_rule_ids", "changed_rule_counts",
+            "changed_item_counts", "tombstones",
+        )
+        missing = [k for k in required if k not in npz.files]
+        if missing:
+            raise ValueError(f"{path}: not a delta bundle (missing {missing})")
+        version = int(npz["version"])
+        if version != DELTA_BUNDLE_VERSION:
+            raise ValueError(
+                f"{path}: delta bundle version {version} != "
+                f"{DELTA_BUNDLE_VERSION}"
+            )
+        vocab = [str(s) for s in npz["vocab"]]
+        rows = np.asarray(npz["changed_rows"], dtype=np.int32)
+        ids = np.asarray(npz["changed_rule_ids"], dtype=np.int32)
+        counts = np.asarray(npz["changed_rule_counts"], dtype=np.int32)
+        items = np.asarray(npz["changed_item_counts"], dtype=np.int32)
+        if ids.shape != counts.shape or ids.ndim != 2:
+            raise ValueError(f"{path}: malformed changed-row tensors")
+        if len(rows) != ids.shape[0] or len(rows) != len(items):
+            raise ValueError(f"{path}: changed-row count mismatch")
+        if len(rows) and (rows.min() < 0 or rows.max() >= len(vocab)):
+            raise ValueError(f"{path}: changed_rows outside the new vocab")
+        if len(rows) != len(set(rows.tolist())):
+            raise ValueError(f"{path}: duplicate changed_rows")
+        if ids.size and ids.max() >= len(vocab):
+            raise ValueError(f"{path}: rule ids outside the new vocab")
+        return {
+            "version": version,
+            "seq": int(npz["seq"]),
+            "base_token": str(npz["base_token"]),
+            "base_npz_sha256": str(npz["base_npz_sha256"]),
+            "n_playlists": int(npz["n_playlists"]),
+            "min_count": int(npz["min_count"]),
+            "vocab": vocab,
+            "changed_rows": rows,
+            "changed_rule_ids": ids,
+            "changed_rule_counts": counts,
+            "changed_item_counts": items,
+            "tombstones": [str(s) for s in npz["tombstones"]],
+        }
+
+
+def read_delta_state(pickles_dir: str) -> dict[str, Any] | None:
+    """The parsed delta chain file, or None when absent/unreadable (no
+    chain is the normal state between full publications)."""
+    try:
+        with open(delta_state_path(pickles_dir), "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("entries"), list):
+        return None
+    return data
+
+
+def write_delta_state(
+    pickles_dir: str,
+    base_token: str,
+    base_npz_sha256: str,
+    entries: list[dict[str, Any]],
+) -> str:
+    """Atomically (re)write the delta chain file. Written AFTER the bundle
+    bytes it references (same ordering discipline as manifest-then-token):
+    a reader that sees a chain entry can always find verified bundle
+    bytes, and a reader racing mid-publish simply retries next poll."""
+    out = delta_state_path(pickles_dir)
+    _atomic_write_bytes(
+        out,
+        json.dumps(
+            {
+                "version": 1,
+                "base_token": base_token,
+                "base_npz_sha256": base_npz_sha256,
+                "entries": entries,
+            },
+            indent=1, sort_keys=True,
+        ).encode("utf-8"),
+    )
+    return out
+
+
+def retire_delta_chain(pickles_dir: str) -> int:
+    """Remove the delta chain + its bundles (a FULL publication supersedes
+    every delta of the previous generation — a stale chain would fail its
+    base-token binding anyway, but dead bytes on the PVC invite operator
+    confusion). Never raises. → files removed."""
+    removed = 0
+    try:
+        names = os.listdir(pickles_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if name == DELTA_STATE_FILENAME or (
+            name.startswith("delta-") and name.endswith(".bundle")
+        ):
+            try:
+                os.unlink(os.path.join(pickles_dir, name))
+                removed += 1
+            except OSError:
+                pass
+    return removed
 
 
 def tensors_from_rules_dict(

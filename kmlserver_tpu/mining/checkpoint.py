@@ -67,7 +67,12 @@ STATE_FILENAME = "state.json"
 #     order makes the embedding FACTORS float-different across layouts,
 #     so a checkpoint written under one layout must never publish under
 #     the other; within a layout, resume stays bit-identical
-CKPT_VERSION = 3
+# v4: continuous freshness (ISSUE 10) — the encode payload gained the
+#     pid-rank values the delta base state extends, and `delta_enabled`
+#     joined the fingerprint: a resume across a delta-enabled flip would
+#     publish with (or without) the freshness base state its lineage
+#     expects, desynchronizing base ∘ delta from the published artifacts
+CKPT_VERSION = 4
 
 # MiningConfig fields that can change the bytes of the final artifacts (or
 # of any phase payload). Anything NOT listed — dispatch/backend knobs like
@@ -92,6 +97,10 @@ _FINGERPRINT_FIELDS = (
     "als_rank",
     "als_iters",
     "als_reg",
+    # continuous freshness (ISSUE 10): a delta-enabled run's publication
+    # step additionally writes the freshness base state derived from the
+    # phase payloads — see the v4 note above
+    "delta_enabled",
 )
 
 

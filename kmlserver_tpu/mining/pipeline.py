@@ -72,6 +72,10 @@ class JobSummary:
     fencing_token: int | None = None
     # ALS embedding training wall clock (None: embed phase disabled)
     als_train_s: float | None = None
+    # continuous freshness (ISSUE 10): set when this run published a delta
+    # bundle instead of a full artifact set (the chain sequence number;
+    # None = full publication)
+    delta_seq: int | None = None
 
 
 def _pickle_path(cfg: MiningConfig, filename: str) -> str:
@@ -87,6 +91,8 @@ def _crash_site(phase: str) -> None:
 
 def _run_encode_phase(cfg: MiningConfig, selected: str) -> dict:
     """CSV read + vocab validation/aux maps + basket encoding."""
+    import numpy as np
+
     table = read_tracks(selected, cfg.sample_ratio)
     print(
         f"Loaded {len(table)} rows, {table.n_playlists} playlists, "
@@ -104,6 +110,11 @@ def _run_encode_phase(cfg: MiningConfig, selected: str) -> dict:
         "info": info,
         "best": best,
         "baskets": baskets,
+        # pid ranks backing playlist_rows (CKPT_VERSION 4): the delta
+        # base state (freshness/delta.py) extends these with appended
+        # rows' pids, so an incremental run re-ranks without re-reading
+        # the full CSV
+        "pid_values": np.unique(table.pid),
     }
 
 
@@ -142,6 +153,78 @@ def run_mining_job(
     watchdog=None,
 ) -> JobSummary:
     print(f"Job starting at {get_current_time_str()}")
+
+    # continuous freshness (ISSUE 10): with KMLS_DELTA_ENABLED and a
+    # matching base state on the PVC, this run publishes an incremental
+    # delta bundle instead of re-mining everything — freshness lag drops
+    # from full-mine wall clock to the restricted recount. ANY
+    # ineligibility (no base, rewritten prefix, config drift, chain cap,
+    # multi-host gang) falls through to the full pipeline below; the
+    # delta path never publishes an approximation.
+    if cfg.delta_enabled:
+        from ..freshness import delta as delta_mod
+
+        # delta-route telemetry (the Job manifests arm KMLS_JOB_METRICS
+        # alongside KMLS_DELTA_ENABLED): a delta publication must refresh
+        # job_metrics.prom — freshness-timestamp dashboards alert on its
+        # age, and most syncs in steady state ARE deltas. Constructed
+        # before the run so an abort still records success=0; the
+        # ineligible fallthrough constructs nothing on disk (JobMetrics
+        # only writes on phase_done/finish) and the full path below
+        # writes its own. Writer-rank gate kept for symmetry even though
+        # eligibility rejects multi-host gangs.
+        jm_delta = (
+            JobMetrics(cfg.pickles_dir)
+            if cfg.job_metrics and jax.process_index() == 0
+            else None
+        )
+        try:
+            res = delta_mod.run_delta_job(cfg, mesh=mesh)
+        except delta_mod.DeltaIneligible as exc:
+            print(f"Delta mining ineligible ({exc}); running the full pipeline")
+        except BaseException:
+            if jm_delta is not None:
+                try:
+                    # same abort discipline as the full path: success=0
+                    # telemetry, never masking the real cause
+                    jm_delta.finish(False)
+                except Exception:
+                    pass
+            raise
+        else:
+            if jm_delta is not None:
+                try:
+                    jm_delta.phase_done("delta", res.duration_s)
+                    if res.bundle_path:
+                        jm_delta.note_artifact("delta", res.bundle_path)
+                    jm_delta.finish(
+                        True,
+                        rule_generation_s=res.duration_s,
+                        fencing_token=res.fencing_token,
+                    )
+                except Exception as exc:
+                    # publication already succeeded — telemetry is
+                    # best-effort, exactly like the full path's guard
+                    print(
+                        f"WARNING: success telemetry skipped "
+                        f"({jm_delta.path}): {exc!r}"
+                    )
+            print(f"Job finished at {get_current_time_str()}")
+            return JobSummary(
+                dataset=res.dataset,
+                run_index=res.run_index,
+                n_rows=res.n_new_rows,
+                n_playlists=0,
+                n_tracks=0,
+                n_songs_missing=0,
+                rule_generation_s=res.duration_s,
+                token=res.base_token,
+                artifact_paths=(
+                    {"delta": res.bundle_path} if res.bundle_path else {}
+                ),
+                fencing_token=res.fencing_token,
+                delta_seq=res.seq if res.bundle_path else None,
+            )
 
     # model layout (KMLS_MODEL_LAYOUT): resolved ONCE here so the mine
     # and embed phases ride the SAME vocab-sharded mesh — a sharded
@@ -373,6 +456,43 @@ def run_mining_job(
             token = registry.append_history_and_invalidate(
                 cfg, run_index, selected, timestamp=token_value
             )
+            # continuous freshness: a FULL publication supersedes any
+            # delta chain of the previous generation and seeds the next
+            # incremental run with this run's encode state + tensors.
+            # Best-effort — the artifacts above already published, so a
+            # freshness bookkeeping failure must not fail the job (the
+            # next run simply full-mines).
+            if cfg.delta_enabled:
+                from ..freshness import delta as delta_mod
+
+                try:
+                    artifacts.retire_delta_chain(cfg.pickles_dir)
+                    npz_sha = None
+                    if "rule_tensors" in paths:
+                        npz_sha = artifacts.file_digest(
+                            paths["rule_tensors"]
+                        )["sha256"]
+                    delta_mod.save_base_state(
+                        cfg,
+                        token=token_value,
+                        run_index=run_index,
+                        dataset_path=selected,
+                        baskets=encoded["baskets"],
+                        pid_values=encoded.get("pid_values"),
+                        published=delta_mod.published_from_tensors(
+                            tensors, result.vocab_names
+                        ),
+                        npz_sha256=npz_sha,
+                    )
+                    print("Freshness base state saved (delta mining armed)")
+                except Exception as exc:
+                    print(
+                        f"WARNING: freshness base state skipped: {exc!r}"
+                    )
+            else:
+                # delta mining off: a chain left by a previous
+                # configuration must not outlive the generation it patched
+                artifacts.retire_delta_chain(cfg.pickles_dir)
             if store is not None:
                 # published: the next rotation run must start fresh
                 store.clear()

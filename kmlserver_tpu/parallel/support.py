@@ -321,6 +321,66 @@ def _sharded_emit_fn(mesh: Mesh, k_max: int):
     )
 
 
+@functools.lru_cache(maxsize=16)
+def _restricted_counts_fn(mesh: Mesh):
+    """Cached jitted restricted recount per mesh: gather the requested
+    columns of the ``P('dp','tp')`` one-hot (replicated over tp) and
+    contract the playlist axis against the full sharded matrix —
+    ``C[R, :] = X[:, R]ᵀ X``, the row slice of the same int32 MXU
+    contraction the full count path runs."""
+    return jax.jit(
+        lambda x, ids: _dot_pt(jnp.take(x, ids, axis=1), x),
+        in_shardings=(
+            NamedSharding(mesh, P(AXIS_DP, AXIS_TP)),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P(None, AXIS_TP)),
+    )
+
+
+def restricted_pair_counts(
+    baskets: Baskets, row_ids, mesh: "Mesh | None" = None
+):
+    """Rows ``row_ids`` of the pair-count matrix ``C = XᵀX`` → host
+    ``(R, V) int32`` — the delta-mining recount (freshness/delta.py):
+    only the affected baskets' vocab columns are recounted, against ALL
+    baskets, so each returned row is bit-identical to the corresponding
+    row of the full count matrix. With ``mesh`` the one-hot rides the
+    same ``P('dp','tp')`` layout as the full sharded count path; without
+    one it is a single jit over the dense encode."""
+    import numpy as _np
+
+    row_ids = _np.asarray(row_ids, dtype=_np.int32)
+    v = baskets.n_tracks
+    if row_ids.size == 0:
+        return _np.zeros((0, v), dtype=_np.int32)
+    if _np.any(row_ids < 0) or _np.any(row_ids >= v):
+        raise ValueError(f"row_ids outside the vocabulary (V={v})")
+    if mesh is None:
+        # small-work host path: a delta job is a COLD process, and a jit
+        # compile (~0.3 s) would dwarf a thin row-slice recount — scatter
+        # the one-hot in numpy and BLAS the slice instead. float64 keeps
+        # every count exact (≤ n_playlists ≪ 2^53), so the int32 result
+        # is bit-identical to the device contraction.
+        if baskets.n_playlists * v <= 16_000_000:
+            x = _np.zeros((baskets.n_playlists, v), dtype=_np.float64)
+            x[baskets.playlist_rows, baskets.track_ids] = 1.0
+            return (x[:, row_ids].T @ x).astype(_np.int32)
+        x = encode.onehot_matrix(
+            jnp.asarray(baskets.playlist_rows),
+            jnp.asarray(baskets.track_ids),
+            n_playlists=baskets.n_playlists,
+            n_tracks=v,
+        )
+        counts = _dot_pt(jnp.take(x, jnp.asarray(row_ids), axis=1), x)
+        return _np.asarray(jax.device_get(counts))
+    p_pad = round_up(max(baskets.n_playlists, 1), mesh.shape[AXIS_DP])
+    v_pad = round_up(max(v, 1), mesh.shape[AXIS_TP])
+    x = _onehot_padded(baskets, p_pad, v_pad, mesh)
+    counts = _restricted_counts_fn(mesh)(x, jnp.asarray(row_ids))
+    return _np.asarray(jax.device_get(counts))[:, :v]
+
+
 def sharded_rule_tensors(
     baskets: Baskets,
     mesh: Mesh,

@@ -82,6 +82,14 @@ def _html_response(status: int, html: str) -> Response:
 class RecommendApp:
     """Transport-independent app core."""
 
+    # class-level defaults so hand-assembled test apps (``__new__`` +
+    # attribute injection, no ``__init__``) keep working as the surface
+    # grows — the affinity layer is default-off anyway
+    ring = None
+    _ring_self = ""
+    affinity_local_total = 0
+    affinity_remote_total = 0
+
     def __init__(
         self, cfg: ServingConfig, engine: RecommendEngine | None = None,
         *, defer_batcher: bool = False,
@@ -115,6 +123,40 @@ class RecommendApp:
             if cfg.cache_enabled and cfg.cache_max_entries > 0
             else None
         )
+        # continuous freshness (ISSUE 10): when the engine applies a delta
+        # bundle in place (no epoch bump), only the keys whose seeds
+        # intersect the delta's touched vocab may go stale — invalidate
+        # exactly those instead of the wholesale epoch flush. The engine
+        # notifies AFTER the patched bundle reference is live (the same
+        # ordering contract the epoch bump rides), and `wholesale` applies
+        # already invalidated via the epoch bump. getattr: engine test
+        # doubles predating the delta path stay constructible.
+        listeners = getattr(self.engine, "delta_listeners", None)
+        if listeners is not None:
+            listeners.append(self._on_delta_applied)
+        # fleet cache affinity (freshness/ring.py): default-off counters
+        # measuring what fraction of traffic a rendezvous-hash router
+        # would keep on THIS replica — the decision data for affinity
+        # routing vs a shared external cache tier
+        self.ring = None
+        self._ring_self = ""
+        self.affinity_local_total = 0
+        self.affinity_remote_total = 0
+        if cfg.cache_affinity:
+            import socket as socket_mod
+
+            from ..freshness.ring import RendezvousRing
+
+            me = cfg.cache_affinity_self or socket_mod.gethostname()
+            peers = [
+                p.strip()
+                for p in (cfg.cache_affinity_peers or "").split(",")
+                if p.strip()
+            ]
+            if me not in peers:
+                peers.append(me)
+            self.ring = RendezvousRing(peers)
+            self._ring_self = me
         # defer_batcher: the asyncio transport installs its loop-native
         # AsyncMicroBatcher instead — don't spawn the threaded pipeline
         if cfg.batch_window_ms > 0 and not defer_batcher:
@@ -216,7 +258,15 @@ class RecommendApp:
                 # retained traces, JSON: the per-request WHY behind a
                 # /metrics percentile (tail-based retention — see
                 # observability/trace.py). Bounded payload: the ring caps
-                # at KMLS_TRACE_BUFFER entries.
+                # at KMLS_TRACE_BUFFER entries. Loopback-only, exactly
+                # like /metrics/reset above: retained traces carry request
+                # payloads (seed songs in span attrs and shed/degraded
+                # bodies) and must not be fleet-scrapeable by default —
+                # the tracejoin tooling runs next to the pod it debugs.
+                if client_host is not None:
+                    host = client_host.removeprefix("::ffff:")
+                    if host not in ("127.0.0.1", "::1"):
+                        return _json_response(403, {"detail": "localhost only"})
                 return _json_response(200, self.recorder.debug_payload())
             if path == "/metrics":
                 text = self.metrics.render(
@@ -260,6 +310,25 @@ class RecommendApp:
             # spans (1 = replicated — a dashboard can alert on a fleet
             # unexpectedly flipping layout after a publication)
             "model_shards": getattr(self.engine, "n_shards", 1),
+            # continuous freshness (ISSUE 10): delta bundles applied in
+            # place vs rejected (torn/wrong-base/injected), the chain
+            # position currently serving, and the age of the newest
+            # APPLIED generation — the freshness-lag number the delta
+            # path exists to shrink
+            "delta_applied_total": getattr(
+                self.engine, "delta_applied_total", 0
+            ),
+            "delta_rejected_total": getattr(
+                self.engine, "delta_rejected_total", 0
+            ),
+            "delta_seq": getattr(self.engine, "delta_seq", 0),
+            "freshness_lag_seconds": round(
+                getattr(self.engine, "freshness_lag_s", lambda: 0.0)(), 3
+            ),
+            # fleet cache affinity: what fraction of traffic a rendezvous
+            # router would keep on this replica (0/0 with the layer off)
+            "cache_affinity_local_total": self.affinity_local_total,
+            "cache_affinity_remote_total": self.affinity_remote_total,
         }
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         state["replicas_ejected"] = (
@@ -509,7 +578,23 @@ class RecommendApp:
             self._trace_finish(trace, "ok", headers)
         return status, headers, payload
 
+    def _on_delta_applied(self, touched: set, wholesale: bool) -> None:
+        """Engine callback after a delta bundle swapped in: selectively
+        invalidate the touched seed keys (wholesale applies bumped the
+        epoch, which already invalidates every key for free)."""
+        if self.cache is None or wholesale:
+            return
+        dropped = self.cache.invalidate_seeds(set(touched))
+        logger.info(
+            "delta applied: %d touched names, %d cache entries invalidated "
+            "selectively", len(touched), dropped,
+        )
+
     def _cache_key(self, songs: list[str]) -> tuple:
+        if self.cache is not None:
+            return self.cache.make_key(
+                self.engine.bundle_epoch, songs, self.cfg.max_seed_tracks
+            )
         return RecommendCache.key(
             self.engine.bundle_epoch, songs, self.cfg.max_seed_tracks
         )
@@ -527,6 +612,17 @@ class RecommendApp:
         only when set — test doubles keep their bare ``submit(seeds)``
         signature. "off" covers: cache disabled, no batcher, or a batcher
         without ``submit`` (test doubles) — callers compute inline there."""
+        if self.ring is not None:
+            # affinity accounting on the ONE path both transports share:
+            # is THIS replica the rendezvous owner of the request's cache
+            # key? (counters only — no routing yet; GIL-coalesced adds,
+            # same benign-race budget as the batcher's in-flight counts)
+            from ..freshness.ring import seeds_key
+
+            if self.ring.owner(seeds_key(songs)) == self._ring_self:
+                self.affinity_local_total += 1
+            else:
+                self.affinity_remote_total += 1
         if (
             self.cache is None
             or self.batcher is None

@@ -11,12 +11,29 @@ distribution into dictionary lookups — the same shape of win prefix/KV
 caching delivers in inference serving stacks.
 
 Correctness comes from the key, not from invalidation machinery: entries
-are keyed by ``(bundle_epoch, canonicalized seed set)``, and the engine
-bumps ``bundle_epoch`` on every successful hot swap AFTER publishing the
-new bundle (see the ordering contract in engine.load). A post-swap lookup
-therefore constructs a key no stale entry can match — the whole cache is
-invalidated wholesale, for free, without touching it. Stale old-epoch
-entries age out of the LRU naturally.
+are keyed by ``(bundle_epoch, seed-set generation, canonicalized seed
+set)``, and the engine bumps ``bundle_epoch`` on every successful hot
+swap AFTER publishing the new bundle (see the ordering contract in
+engine.load). A post-swap lookup therefore constructs a key no stale
+entry can match — the whole cache is invalidated wholesale, for free,
+without touching it. Stale old-epoch entries age out of the LRU
+naturally.
+
+**Selective invalidation** (continuous freshness, ISSUE 10) extends the
+same key-freshness argument to delta applies, which deliberately do NOT
+bump the epoch (a delta touches a handful of vocab rows; wholesale
+invalidation would re-compute every hot head for nothing): the cache
+keeps a per-seed-name GENERATION counter, and a key's generation
+component is the sum over its seeds. ``invalidate_seeds(touched)`` bumps
+the touched names' generations AFTER the engine swapped the patched
+bundle in — exactly the epoch ordering contract in miniature — so a
+post-invalidation lookup whose seeds intersect the touched set
+constructs a key that no stale entry (and no in-flight pre-delta
+leader's eventual store) can ever match, while untouched keys keep their
+generation, their entries, and their hit ratio. Unreachable entries are
+also deleted eagerly (one walk under the lock) so the LRU capacity isn't
+squatted by dead keys, and the walk's count feeds
+``kmls_cache_invalidated_keys_total``.
 
 Canonicalization: answers are order-independent for seed sets within the
 kernel's seed cap (the score merge is a max over seeds; the fallback
@@ -55,16 +72,66 @@ class RecommendCache:
         self.misses = 0
         self.evictions = 0
         self.singleflight_joins = 0
+        # selective invalidation (ISSUE 10): per-seed-name generation
+        # counters — a key's generation component is the sum over its
+        # seeds, so bumping one name makes every key containing it
+        # unconstructable. Bounded by the vocabulary; only names a delta
+        # ever touched have entries.
+        self._name_gen: dict[str, int] = {}
+        self.selective_invalidations = 0
+        self.invalidated_keys = 0
 
     # ---------- keys ----------
 
     @staticmethod
     def key(epoch: int, seeds: list[str], seed_cap: int) -> tuple:
-        """→ ``(epoch, canonical seed tuple)``. Sorted (order-free answers)
-        with duplicates kept; seed lists past the kernel cap keep request
-        order because truncation there is positional."""
+        """Generation-less key (legacy/static form): ``(epoch, 0,
+        canonical seed tuple)``. Sorted (order-free answers) with
+        duplicates kept; seed lists past the kernel cap keep request
+        order because truncation there is positional. Cache-owning
+        callers use :meth:`make_key`, which adds the live seed-set
+        generation component."""
         core = tuple(sorted(seeds)) if len(seeds) <= seed_cap else tuple(seeds)
-        return (epoch, core)
+        return (epoch, 0, core)
+
+    def make_key(self, epoch: int, seeds: list[str], seed_cap: int) -> tuple:
+        """→ ``(epoch, seed-set generation, canonical seed tuple)``. The
+        generation sum is monotone non-decreasing per seed set and
+        strictly increases when any member name is invalidated, so a
+        stale entry's key can never be reconstructed. Lock-free reads: a
+        lookup racing a bump reads the old generation, which is exactly
+        equivalent to having looked up before the bump."""
+        core = tuple(sorted(seeds)) if len(seeds) <= seed_cap else tuple(seeds)
+        gens = self._name_gen
+        if not gens:
+            return (epoch, 0, core)
+        get = gens.get
+        gen = 0
+        for s in core:
+            gen += get(s, 0)
+        return (epoch, gen, core)
+
+    def invalidate_seeds(self, touched: set[str]) -> int:
+        """Selectively invalidate every key whose seed set intersects
+        ``touched``: bump the touched names' generations (making stale
+        keys unconstructable — the correctness half) and eagerly delete
+        the now-unreachable LRU entries (the capacity half). Call AFTER
+        the new bundle reference is live, mirroring the epoch ordering
+        contract. → entries deleted."""
+        if not touched:
+            return 0
+        with self._lock:
+            for name in touched:
+                self._name_gen[name] = self._name_gen.get(name, 0) + 1
+            doomed = [
+                k for k in self._lru
+                if any(s in touched for s in k[-1])
+            ]
+            for k in doomed:
+                del self._lru[k]
+            self.selective_invalidations += 1
+            self.invalidated_keys += len(doomed)
+        return len(doomed)
 
     # ---------- LRU ----------
 

@@ -275,6 +275,33 @@ class RecommendEngine:
         # a FAILED load (direct load() calls always go through — tests and
         # operator nudges must not be backoff-gated)
         self._backoff_until = 0.0
+        # ---- continuous freshness (ISSUE 10) ----
+        # chain position currently applied on top of the base generation:
+        # the serving epoch is logically the PAIR (bundle_epoch,
+        # delta_seq) — a delta apply advances delta_seq in place without
+        # bumping bundle_epoch (the cache invalidates selectively instead
+        # of wholesale), and a full reload resets it to 0
+        self.delta_seq = 0
+        self.delta_applied_total = 0
+        self.delta_rejected_total = 0
+        self.last_delta_error: str | None = None
+        # callbacks fired AFTER a delta swap commits: (touched_names,
+        # wholesale) — the app points this at the cache's selective
+        # invalidation
+        self.delta_listeners: list = []
+        # the logical tensors deltas patch (counts included — the npz
+        # load's dict shape); None when the bundle came from the pickle
+        # or carries merged float64 confidences (delta-ineligible)
+        self._host_state: dict | None = None
+        # sha256 of the npz the host state was loaded from — the binding
+        # a bundle's base_npz_sha256 must match
+        self._base_npz_sha: str | None = None
+        # wall-clock written_at of the newest APPLIED generation (base
+        # manifest or delta chain entry) — kmls_freshness_lag_seconds
+        self._applied_written_at = 0.0
+        # rejection backoff for the POLLING path only (direct
+        # apply_pending_deltas calls always go through, like load())
+        self._delta_backoff_until = 0.0
         self._kernel = None  # resolved lazily: donation needs the backend
         # dispatches whose (batch, length) shape was never pre-warmed —
         # each one paid a jit compile on the serving path; must stay 0
@@ -397,6 +424,21 @@ class RecommendEngine:
                 while len(self.dispatch_counts) < len(replicas):
                     self.dispatch_counts.append(0)
             self.cache_value = replicas[0].model_token or self.cache_value
+            # continuous freshness: a full reload starts a fresh
+            # (base, delta_seq) pair at seq 0 — a pending chain for THIS
+            # generation applies via apply_pending_deltas right after
+            # (reload_if_required chains the two)
+            self.delta_seq = 0
+            self._host_state = getattr(self, "_candidate_host_state", None)
+            self._base_npz_sha = getattr(self, "_candidate_npz_sha", None)
+            self._delta_backoff_until = 0.0
+            manifest = artifacts.load_manifest(self.cfg.pickles_dir)
+            if manifest is not None and manifest.get("token") == self.cache_value:
+                self._applied_written_at = float(
+                    manifest.get("written_at") or time.time()
+                )
+            else:
+                self._applied_written_at = time.time()
             self.finished_loading = True
             # embedding status commits WITH the bundle it describes
             self.embedding_degraded = emb_degraded
@@ -610,6 +652,13 @@ class RecommendEngine:
                 logger.exception(
                     "tensor artifact %s unreadable; trying the pickle", npz_path
                 )
+        # continuous freshness: the candidate host state a delta bundle
+        # can patch in place — committed alongside the swap in load().
+        # Only the npz path carries the counts a patch needs, and merged
+        # float64 confidences (rule_confs64) cannot be re-derived after a
+        # patch, so those bundles serve deltas-disabled.
+        self._candidate_host_state = None
+        self._candidate_npz_sha = None
         if loaded is not None:
             vocab = loaded["vocab"]
             rule_ids = loaded["rule_ids"]
@@ -619,6 +668,24 @@ class RecommendEngine:
             known = loaded["item_counts"] >= min_count_for(
                 loaded["min_support"], loaded["n_playlists"]
             )
+            if self.cfg.delta_enabled and loaded.get("rule_confs64") is None:
+                self._candidate_host_state = {
+                    "vocab": list(vocab),
+                    "rule_ids": np.asarray(rule_ids, dtype=np.int32),
+                    "rule_counts": np.asarray(
+                        loaded["rule_counts"], dtype=np.int32
+                    ),
+                    "item_counts": np.asarray(
+                        loaded["item_counts"], dtype=np.int32
+                    ),
+                    "n_playlists": int(loaded["n_playlists"]),
+                    "min_support": float(loaded["min_support"]),
+                    "mode": str(loaded["mode"]),
+                    "min_confidence": float(loaded["min_confidence"]),
+                }
+                self._candidate_npz_sha = artifacts.file_digest(npz_path)[
+                    "sha256"
+                ]
         else:
             rules_dict = artifacts.load_pickle(rec_path)
             vocab = sorted(
@@ -631,7 +698,17 @@ class RecommendEngine:
                 ),
             )
         index = {n: i for i, n in enumerate(vocab)}
-        known_mask = np.asarray(known)
+        return self._replicas_from_arrays(
+            vocab, index, np.asarray(known), rule_ids, rule_confs, token
+        )
+
+    def _replicas_from_arrays(
+        self, vocab, index, known_mask, rule_ids, rule_confs, token
+    ) -> list[RuleBundle]:
+        """Build the replica set from host arrays — shared by the
+        disk-artifact load above and the in-place delta apply
+        (:meth:`apply_pending_deltas`), so a patched generation commits
+        to devices through exactly the code a fresh load uses."""
         devs = self._serve_devices()
         # layout decision (parallel/layout.py, the one shared copy):
         # MEASURED rule-tensor bytes vs the per-device budget. A sharded
@@ -873,11 +950,191 @@ class RecommendEngine:
         on the exponential backoff ladder instead of every poll/nudge —
         the staleness signal survives untouched (is_data_stale is pure),
         so the retry always happens; it just stops being a busy loop
-        against a poison artifact."""
+        against a poison artifact.
+
+        Continuous freshness rides the same poll: a NOT-stale generation
+        still checks the delta chain and applies new bundles in place
+        (rejections back off on ``_delta_backoff_until`` so a poison
+        bundle can't turn the poller into a digest-hashing busy loop;
+        direct :meth:`apply_pending_deltas` calls always go through,
+        mirroring load())."""
         if time.monotonic() < self._backoff_until:
             return
         if self.is_data_stale() or not self.finished_loading:
-            self.load()
+            if self.load():
+                self.apply_pending_deltas()
+        elif (
+            self.cfg.delta_enabled
+            and time.monotonic() >= self._delta_backoff_until
+        ):
+            self.apply_pending_deltas()
+
+    # ---------- continuous freshness: in-place delta application ----------
+
+    def freshness_lag_s(self) -> float:
+        """Age of the newest APPLIED generation (base publication or
+        delta chain entry) — what dashboards alert on as freshness lag.
+        0.0 before the first load."""
+        if not self._applied_written_at:
+            return 0.0
+        return max(time.time() - self._applied_written_at, 0.0)
+
+    def _note_delta_rejection(self, seq: int, message: str) -> None:
+        self.delta_rejected_total += 1
+        self.last_delta_error = message
+        self._delta_backoff_until = (
+            time.monotonic() + self.cfg.reload_backoff_base_s
+        )
+        logger.warning(
+            "delta bundle %d REJECTED (%s); base generation keeps "
+            "serving, retry after %.1fs",
+            seq, message, self.cfg.reload_backoff_base_s,
+        )
+
+    def apply_pending_deltas(self) -> int:
+        """Apply every not-yet-applied bundle of the current generation's
+        delta chain IN PLACE → bundles applied.
+
+        Each apply rebuilds the replica set from the patched host tensors
+        through the same array path a fresh load uses (per-device
+        ``device_put``; vocab-sharded layout included), re-warms the
+        kernel buckets (a no-op cost when shapes are unchanged — the jit
+        cache hits), and swaps the replica references WITHOUT bumping
+        ``bundle_epoch``: the answer cache invalidates selectively via
+        ``delta_listeners`` (only keys whose seeds intersect the touched
+        vocab). The one exception is a blend-mode hybrid bundle whose
+        ``n_playlists`` moved — the global 1/P confidence rescale shifts
+        every blended ranking, so that apply bumps the epoch (wholesale
+        invalidation, the safe direction). Any validation failure — torn
+        bytes, wrong base binding, chain gap, the ``delta.apply`` chaos
+        site — rejects the bundle and keeps the current state serving:
+        bad delta ⇒ keep base, never a 5xx."""
+        if not self.cfg.delta_enabled or not self.finished_loading:
+            return 0
+        state = artifacts.read_delta_state(self.cfg.pickles_dir)
+        if state is None:
+            return 0
+        from ..freshness import delta as delta_mod
+
+        applied = 0
+        with self._reload_lock:
+            if state.get("base_token") != self.cache_value:
+                return 0  # chain for another generation: inert here
+            pending = [
+                e for e in sorted(
+                    state.get("entries", []), key=lambda e: e.get("seq", 0)
+                )
+                if e.get("seq", 0) > self.delta_seq
+            ]
+            if not pending:
+                return 0
+            if self._host_state is None:
+                logger.warning(
+                    "delta chain present but this bundle has no patchable "
+                    "host tensors (pickle-only load or merged-confidence "
+                    "artifact); serving the base generation"
+                )
+                return 0
+            for entry in pending:
+                seq = int(entry.get("seq", 0))
+                if seq != self.delta_seq + 1:
+                    self._note_delta_rejection(
+                        seq, f"chain gap: expected seq {self.delta_seq + 1}"
+                    )
+                    break
+                path = os.path.join(
+                    self.cfg.pickles_dir, str(entry.get("file", ""))
+                )
+                try:
+                    # chaos hook: KMLS_FAULT_DELTA_CORRUPT rejects here
+                    faults.fire("delta.apply")
+                    bundle = artifacts.load_delta_bundle(
+                        path, expect_sha256=entry.get("sha256")
+                    )
+                    if bundle["base_token"] != self.cache_value:
+                        raise ValueError(
+                            "bundle base token != serving generation"
+                        )
+                    if (
+                        self._base_npz_sha is not None
+                        and bundle["base_npz_sha256"] != self._base_npz_sha
+                    ):
+                        raise ValueError(
+                            "bundle bound to different base artifact bytes"
+                        )
+                    patched = delta_mod.apply_delta_to_tensors(
+                        self._host_state, bundle
+                    )
+                    vocab, rule_ids, rule_confs, known = (
+                        delta_mod.derive_serving_arrays(patched)
+                    )
+                    index = {n: i for i, n in enumerate(vocab)}
+                    old_replicas = self.replicas
+                    replicas = self._replicas_from_arrays(
+                        vocab, index, known, rule_ids, rule_confs,
+                        self.cache_value or "",
+                    )
+                    # the second model family rides along untouched:
+                    # factors are already committed to each replica's
+                    # device, and their warmed shapes stay warmed
+                    for i, nb in enumerate(replicas):
+                        if i < len(old_replicas):
+                            src = old_replicas[i]
+                            nb.emb_factors = src.emb_factors
+                            nb.emb_vocab = src.emb_vocab
+                            nb.emb_index = src.emb_index
+                            nb.emb_warmed_shapes = src.emb_warmed_shapes
+                    for nb in replicas:
+                        self._warmup(nb)
+                except Exception as exc:
+                    self._note_delta_rejection(
+                        seq, f"{type(exc).__name__}: {exc}"
+                    )
+                    break
+                # blend-mode hybrid + moved P: the uniform confidence
+                # rescale shifts every blended ranking, so untouched keys
+                # are NOT safe — bump the epoch (wholesale invalidation)
+                wholesale = (
+                    self.cfg.hybrid_mode == "blend"
+                    and any(r.emb_factors is not None for r in replicas)
+                    and patched["n_playlists"]
+                    != self._host_state["n_playlists"]
+                )
+                epoch = self.bundle_epoch + (1 if wholesale else 0)
+                for nb in replicas:
+                    nb.epoch = epoch
+                # ordering contract (same as load's): replica references
+                # land BEFORE the invalidation signal (epoch bump or the
+                # listeners' generation bump), so an answer stored under
+                # a post-invalidation key can only have been computed
+                # from the patched tensors
+                self.replicas = replicas
+                self.bundle = replicas[0]
+                if wholesale:
+                    self.bundle_epoch = epoch
+                self._host_state = patched
+                self.delta_seq = seq
+                self.delta_applied_total += 1
+                self.last_delta_error = None
+                self._applied_written_at = float(
+                    entry.get("written_at") or time.time()
+                )
+                applied += 1
+                touched = delta_mod.touched_names(bundle)
+                logger.info(
+                    "delta %d applied in place (epoch %d/%d): %d changed "
+                    "rows, %d tombstones, %d touched names%s",
+                    seq, self.bundle_epoch, self.delta_seq,
+                    len(bundle["changed_rows"]), len(bundle["tombstones"]),
+                    len(touched),
+                    " [wholesale invalidation]" if wholesale else "",
+                )
+                for fn in list(self.delta_listeners):
+                    try:
+                        fn(touched, wholesale)
+                    except Exception:
+                        logger.exception("delta listener failed")
+        return applied
 
     # ---------- lookups ----------
 

@@ -60,6 +60,16 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_cache_singleflight_joins_total": "counter:serving",
     "kmls_cache_entries": "gauge:serving",
     "kmls_cache_hit_ratio": "gauge:serving",
+    # selective invalidation (continuous freshness, ISSUE 10): delta
+    # applies bump per-seed-name generations instead of the epoch —
+    # invalidation events and the entries each walk deleted
+    "kmls_cache_selective_invalidations_total": "counter:serving",
+    "kmls_cache_invalidated_keys_total": "counter:serving",
+    # fleet cache affinity (freshness/ring.py): would a rendezvous-hash
+    # router have kept this request on THIS replica? The decision data
+    # for affinity routing vs a shared external cache tier.
+    "kmls_cache_affinity_local_total": "counter:serving",
+    "kmls_cache_affinity_remote_total": "counter:serving",
     # --- serving: dispatch / layout ---
     "kmls_device_dispatch_total": "counter:serving",
     "kmls_shard_dispatch_total": "counter:serving",
@@ -78,6 +88,15 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_replicas_ejected": "gauge:serving",
     "kmls_utilization": "gauge:serving",
     "kmls_admission_degrade_total": "counter:serving",
+    # --- serving: continuous freshness (ISSUE 10) ---
+    # delta bundles applied in place vs rejected (torn/wrong-base/
+    # injected), the chain position serving ((base, delta_seq) epoch
+    # pair), and the age of the newest APPLIED generation — the
+    # freshness-lag number the delta path exists to shrink
+    "kmls_delta_applied_total": "counter:serving",
+    "kmls_delta_rejected_total": "counter:serving",
+    "kmls_delta_seq": "gauge:serving",
+    "kmls_freshness_lag_seconds": "gauge:serving",
     # --- serving: observability (ISSUE 9) ---
     # peak-hold event-loop/scheduler stall estimate, decayed — the
     # runtime-health signal the admission ladder also folds in
@@ -392,6 +411,15 @@ class ServingMetrics:
                 f"kmls_cache_entries {len(cache)}",
                 "# TYPE kmls_cache_hit_ratio gauge",
                 f"kmls_cache_hit_ratio {cache.hit_ratio():.4f}",
+                # selective invalidation (continuous freshness): delta
+                # applies invalidate only touched seed keys — events and
+                # entries deleted, vs the for-free wholesale epoch bump
+                "# TYPE kmls_cache_selective_invalidations_total counter",
+                "kmls_cache_selective_invalidations_total "
+                f"{getattr(cache, 'selective_invalidations', 0)}",
+                "# TYPE kmls_cache_invalidated_keys_total counter",
+                "kmls_cache_invalidated_keys_total "
+                f"{getattr(cache, 'invalidated_keys', 0)}",
             ]
         if dispatch_counts:
             # per-replica device dispatch counters: the evidence that the

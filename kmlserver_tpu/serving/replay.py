@@ -98,6 +98,58 @@ class ReplayReport:
         return json.dumps(dataclasses.asdict(self))
 
 
+class ClientTraceLog:
+    """Client-side half of the trace JOIN (ISSUE 9 remainder, landed with
+    ISSUE 10): one record per request whose response echoed an
+    ``X-KMLS-Trace`` id — the send/recv wall-clock timestamps the server's
+    retained spans (``GET /debug/traces``) cannot know. Bounded, thread-
+    safe, JSONL on disk; ``scripts/kmls_tracejoin.py`` merges the two
+    halves into one per-request timeline keyed by trace id."""
+
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = max(1, capacity)
+        self._entries: list[dict] = []
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def record(
+        self,
+        trace_id: str,
+        send_unix: float,
+        recv_unix: float,
+        status: int = 200,
+    ) -> None:
+        if not trace_id:
+            return
+        entry = {
+            "trace_id": trace_id,
+            "client_send_unix": round(send_unix, 6),
+            "client_recv_unix": round(recv_unix, 6),
+            "client_rtt_ms": round((recv_unix - send_unix) * 1e3, 4),
+            "status": int(status),
+        }
+        with self._lock:
+            if len(self._entries) >= self.capacity:
+                self.dropped += 1
+                return
+            self._entries.append(entry)
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._entries)
+
+    def write_jsonl(self, path: str) -> int:
+        """Dump the log → records written. Plain open(): a loadgen-side
+        scratch file, not a PVC artifact (no atomicity contract)."""
+        entries = self.entries()
+        # kmls-verify: allow[atomic-write] — loadgen-side scratch JSONL on
+        # the client host, not a PVC artifact; no reader races it
+        with open(path, "w", encoding="utf-8") as fh:
+            for e in entries:
+                fh.write(json.dumps(e) + "\n")
+        return len(entries)
+
+
 def _unpack_send_result(result) -> tuple[str, bool | None]:
     """send() contract: a bare source tag (legacy), or (source, cached)."""
     if isinstance(result, tuple):
@@ -550,6 +602,7 @@ def replay_async_http(
     n_conns: int = 32,
     pipeline: int = 16,
     max_queue: int = 4096,
+    trace_log: ClientTraceLog | None = None,
 ) -> ReplayReport:
     """Open-loop HTTP replay on ONE event loop with request pipelining —
     the load generator the 1k-QPS acceptance needs on a syscall-taxed
@@ -586,6 +639,10 @@ def replay_async_http(
     lat_uncached: list[float] = []
     by_source: dict[str, int] = {}
     errors = 0
+    # perf_counter → unix offset, captured once: trace-log records carry
+    # wall-clock endpoints so kmls_tracejoin can line them up with the
+    # server spans' start_unix
+    wall_off = time.time() - time.perf_counter()
 
     async def _run() -> None:
         nonlocal errors
@@ -637,10 +694,25 @@ def replay_async_http(
                         body = await reader.readexactly(clen)
                         status = int(head.split(b" ", 2)[1])
                         done += 1
+                        t_done = time.perf_counter()
+                        if trace_log is not None:
+                            # echoed trace id (present when the server's
+                            # recorder is armed) → the client half of the
+                            # tracejoin timeline
+                            for line in head_lower.split(b"\r\n"):
+                                if line.startswith(b"x-kmls-trace:"):
+                                    trace_log.record(
+                                        line.split(b":", 1)[1]
+                                        .strip().decode("ascii", "replace"),
+                                        wall_off + t_arr,
+                                        wall_off + t_done,
+                                        status,
+                                    )
+                                    break
                         if status != 200:
                             errors += 1
                             continue
-                        dt_ms = (time.perf_counter() - t_arr) * 1e3
+                        dt_ms = (t_done - t_arr) * 1e3
                         lat_ms.append(dt_ms)
                         # the server marks answer-cache hits with an
                         # X-KMLS-Cache header (serving/app.py) — the only
@@ -703,10 +775,12 @@ def replay_async_http(
     )
 
 
-def pooled_http_sender_factory(url: str):
+def pooled_http_sender_factory(url: str, trace_log: ClientTraceLog | None = None):
     """→ ``make_send`` for :func:`replay_pooled`: each worker gets its own
     keep-alive HTTP/1.1 connection (the server speaks HTTP/1.1 —
-    serving/app.py Handler.protocol_version), reconnecting on error."""
+    serving/app.py Handler.protocol_version), reconnecting on error.
+    ``trace_log`` records echoed ``X-KMLS-Trace`` ids with client
+    send/recv wall clocks for the tracejoin tooling."""
     import http.client
     import urllib.parse
 
@@ -718,6 +792,7 @@ def pooled_http_sender_factory(url: str):
 
         def send(seeds: list[str]) -> str:
             body = json.dumps({"songs": seeds})
+            t_send = time.time()
             try:
                 conn.request(
                     "POST", "/api/recommend/", body=body,
@@ -725,6 +800,12 @@ def pooled_http_sender_factory(url: str):
                 )
                 resp = conn.getresponse()
                 data = json.load(resp)
+                if trace_log is not None:
+                    tid = resp.getheader("X-KMLS-Trace")
+                    if tid:
+                        trace_log.record(
+                            tid, t_send, time.time(), resp.status
+                        )
                 if resp.status != 200:
                     # a shed (429) or server error must count as an
                     # error/drop, not masquerade as an "empty" result
@@ -789,6 +870,13 @@ def main() -> int:
         "--burst-factor", type=float, default=10.0,
         help="burst-shape rate multiplier over --qps",
     )
+    parser.add_argument(
+        "--trace-log", default=None, metavar="PATH",
+        help="write echoed X-KMLS-Trace ids + client send/recv wall "
+             "clocks as JSONL (HTTP targets only; requires the server's "
+             "KMLS_TRACE_SAMPLE > 0). Join with the server's "
+             "/debug/traces via scripts/kmls_tracejoin.py",
+    )
     args = parser.parse_args()
     if args.shape == "flashcrowd":
         arrivals_for = lambda n: shaped_arrivals(n, args.qps)  # noqa: E731
@@ -809,18 +897,25 @@ def main() -> int:
         payloads = reshape(
             sample_seed_sets(vocab, args.requests, zipf_s=args.zipf_s)
         )
+        trace_log = ClientTraceLog() if args.trace_log else None
         if args.client == "async" and args.shape in ("constant", "flashcrowd"):
             # the pipelined client paces its own constant schedule; shaped
             # RATES need the pooled driver's arrivals= parameter
             report = replay_async_http(
                 args.url, payloads, qps=args.qps,
                 n_conns=min(args.workers, 128),
+                trace_log=trace_log,
             )
         else:
             report = replay_pooled(
-                pooled_http_sender_factory(args.url), payloads,
+                pooled_http_sender_factory(args.url, trace_log), payloads,
                 qps=args.qps, n_workers=args.workers,
                 arrivals=arrivals_for(len(payloads)),
+            )
+        if trace_log is not None:
+            n_traced = trace_log.write_jsonl(args.trace_log)
+            print(
+                f"trace log: {n_traced} client records -> {args.trace_log}"
             )
         print(report.to_json())
         return 0

@@ -914,11 +914,13 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     refs = collect_code_knobs(index, cfg)
     assert len(refs) >= 70 and set(refs) <= set(scopes)
     env_map = collect_fault_env_map(index, cfg)
-    assert len(env_map) == 7, env_map
+    assert len(env_map) == 8, env_map
     assert env_map["KMLS_FAULT_EMBED_CORRUPT"][0] == "embed.artifact"
+    assert env_map["KMLS_FAULT_DELTA_CORRUPT"][0] == "delta.apply"
     sites = collect_fire_sites(index, cfg)
     assert {
-        "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact"
+        "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact",
+        "delta.apply",
     } <= sites
     # checker 7 anchors (ISSUE 9): the registry parses without import,
     # both exposition modules are indexed, and the dynamic robustness

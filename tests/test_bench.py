@@ -407,6 +407,20 @@ class TestTpuSuiteWiring:
             "p50_off_ms": 1.1, "began_off": 0, "began_on": 60,
             "retained_on": 48, "platform": "cpu",
         },
+        "freshness": {
+            "qps": 800.0, "achieved_qps": 799.0, "p50_ms": 0.6,
+            "p99_ms": 7.4, "errors": 0, "http_5xx": 0,
+            "full_path_s": 1.0, "delta_path_s": 0.15,
+            "delta_publish_s": 0.13, "publish_to_applied_ms": 14.0,
+            "delta_underload_s": 0.2, "speedup": 6.7,
+            "delta_applied_total": 4, "delta_rejected_total": 0,
+            "freshness_lag_s": 0.9, "cache_hit_ratio": 0.92,
+            "cache_hits_after_warm": 2100, "cache_invalidated_keys": 40,
+            "cache_selective_invalidations": 4,
+            "fleet_affinity_hit_ratio": 0.81,
+            "fleet_baseline_hit_ratio": 0.62, "fleet_multiplier": 1.31,
+            "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -473,6 +487,11 @@ class TestTpuSuiteWiring:
         assert final["replay10k_cache_hit_ratio"] == 0.98
         assert final["replay10k_devices_active"] == 2
         assert final["replay10k_platform"] == "cpu"
+        # the continuous-freshness bracket rides the TPU artifact too
+        assert final["freshness_speedup"] == 6.7
+        assert final["freshness_http_5xx"] == 0
+        assert final["freshness_fleet_multiplier"] == 1.31
+        assert final["freshness_platform"] == "cpu"
         # the supplementary CPU replay lands under cpu_-prefixed keys
         assert final["cpu_replay_achieved_qps"] == 1010.0
 
@@ -930,7 +949,7 @@ class TestBenchStateResume:
         assert bench.run_tpu_suite(em, str(npz1)) == canned["mining"]
         banked = json.loads(Path(state_path).read_text())["phases"]
         assert set(banked) == {
-            "traceoverhead_cpu",
+            "traceoverhead_cpu", "freshness_cpu",
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
@@ -1266,6 +1285,53 @@ class TestCompactLine:
         parsed = json.loads(line)
         assert parsed["traceoverhead_p99_ratio"] == 1.0161
         assert parsed["traceoverhead_began_off"] == 0
+
+    def test_record_freshness_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-10 continuous-freshness bracket's judged keys
+        (delta-vs-full speedup ≥ 5x, zero 5xx through the in-place
+        apply, the 3-replica fleet hit-ratio multiplier) must land in
+        the compact line without regressing the ≤1,800 budget."""
+        canned = {
+            "qps": 800.0, "achieved_qps": 799.2,
+            "p50_ms": 0.6, "p99_ms": 7.4, "errors": 0, "http_5xx": 0,
+            "full_path_s": 11.04, "delta_path_s": 1.01,
+            "delta_publish_s": 0.97, "publish_to_applied_ms": 12.3,
+            "delta_underload_s": 1.22, "speedup": 10.93,
+            "delta_applied_total": 2, "delta_rejected_total": 0,
+            "freshness_lag_s": 0.8, "cache_hit_ratio": 0.902,
+            "cache_hits_after_warm": 2101, "cache_invalidated_keys": 38,
+            "cache_selective_invalidations": 2,
+            "fleet_affinity_hit_ratio": 0.81,
+            "fleet_baseline_hit_ratio": 0.62,
+            "fleet_multiplier": 1.306, "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_freshness(result)
+        assert result["freshness_speedup"] == 10.93
+        assert result["freshness_http_5xx"] == 0
+        assert result["freshness_publish_to_applied_ms"] == 12.3
+        assert result["freshness_fleet_multiplier"] == 1.306
+        assert result["freshness_cache_invalidated_keys"] == 38
+        assert result["freshness_platform"] == "cpu"
+        # only the judged claims ride the compact line (it sits at its
+        # budget; path/cache detail is sidecar-only, like traceoverhead)
+        for key in ("freshness_speedup", "freshness_http_5xx",
+                    "freshness_errors",
+                    "freshness_publish_to_applied_ms",
+                    "freshness_fleet_multiplier"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["freshness_speedup"] == 10.93
+        assert parsed["freshness_http_5xx"] == 0
+        assert parsed["freshness_fleet_multiplier"] == 1.306
 
     def test_record_mine_resume_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-4 interruption bracket's keys must land in the

@@ -25,7 +25,9 @@ class TestKeyCanonicalization:
     def test_order_insensitive_within_cap(self):
         a = RecommendCache.key(3, ["x", "a", "m"], seed_cap=128)
         b = RecommendCache.key(3, ["m", "x", "a"], seed_cap=128)
-        assert a == b == (3, ("a", "m", "x"))
+        # middle component: the seed-set generation (0 = never touched
+        # by a delta — see selective invalidation, ISSUE 10)
+        assert a == b == (3, 0, ("a", "m", "x"))
 
     def test_duplicates_are_kept(self):
         # the static fallback's digest distinguishes ["a","a"] from ["a"]
