@@ -2,7 +2,7 @@
 
 PRs 1–4 made the serving+mining stack fast and fault-tolerant; this
 package makes the invariants that correctness now rests on MACHINE-
-CHECKED instead of reviewer-remembered. Seven checkers, each a pure-AST
+CHECKED instead of reviewer-remembered. Eight checkers, each a pure-AST
 pass (stdlib only — the analyzer must run in a bare CI job without jax):
 
 - ``hotpath``      — no host-sync constructs reachable from the serving
@@ -28,7 +28,14 @@ pass (stdlib only — the analyzer must run in a bare CI job without jax):
                      textfile) is declared in
                      ``serving.metrics.METRIC_REGISTRY`` with a valid
                      type+scope and a README row, orphans flagged both
-                     directions (ISSUE 9).
+                     directions (ISSUE 9);
+- ``costspec``     — every dispatched jitted kernel named at an
+                     ``observe_kernel``/``phase_cost`` call site has an
+                     analytic cost spec in
+                     ``observability.costmodel.KERNEL_COST_SPECS`` and
+                     vice versa, the required kernel set stays
+                     registered, and every cost-model series is in
+                     ``METRIC_REGISTRY`` (ISSUE 12).
 
 Findings carry ``file:line``, a severity, an explanation, and a stable
 fingerprint; pre-existing accepted findings live in

@@ -89,6 +89,10 @@ class AnalysisConfig:
         # grow file I/O, sleeps, or host syncs
         "kmlserver_tpu/observability/trace.py::SpanRecorder.begin",
         "kmlserver_tpu/observability/trace.py::SpanRecorder.finish",
+        # the cost model's observation path (ISSUE 12): runs on the
+        # batch completion side for every dispatched kernel — a few
+        # float adds under its private lock, and it must stay that way
+        "kmlserver_tpu/observability/costmodel.py::CostModel.observe_kernel",
     )
     # host-sync / blocking constructs forbidden on the dispatch path,
     # by resolved dotted name …
@@ -203,6 +207,11 @@ class AnalysisConfig:
         default_factory=lambda: {
             "kmlserver_tpu/serving/metrics.py": "serving",
             "kmlserver_tpu/observability/jobmetrics.py": "mining",
+            # ISSUE 12: the cost-attribution block and the SLO burn-rate
+            # gauges render their own lines into /metrics — their series
+            # literals live in these modules, not metrics.py
+            "kmlserver_tpu/observability/costmodel.py": "serving",
+            "kmlserver_tpu/observability/slo.py": "serving",
         }
     )
     # (function ref, rendered prefix, scope): dict keys / subscript stores
@@ -214,6 +223,22 @@ class AnalysisConfig:
             "kmls_",
             "serving",
         ),
+    )
+
+    # --- cost-spec checker (ISSUE 12) ---
+    costmodel_file: str = "kmlserver_tpu/observability/costmodel.py"
+    costspec_registry_name: str = "KERNEL_COST_SPECS"
+    # the dispatched jitted kernels that must stay registered — the
+    # anchor that keeps a rename from silently hollowing the checker
+    # (tests assert these names exist in the real tree)
+    costspec_required: tuple[str, ...] = (
+        "serve_rules",
+        "serve_sharded",
+        "serve_native",
+        "embed_topk",
+        "als_sweep",
+        "support_count",
+        "delta_recount",
     )
 
     # --- fault-site checker ---
@@ -590,7 +615,15 @@ def _pragma_suppressed(index: ProjectIndex, finding: Finding) -> bool:
 
 
 def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Finding]]]:
-    from . import atomicwrite, exitcodes, hotpath, locking, metricsreg, registries
+    from . import (
+        atomicwrite,
+        costspec,
+        exitcodes,
+        hotpath,
+        locking,
+        metricsreg,
+        registries,
+    )
 
     return {
         "hotpath": hotpath.run,
@@ -600,6 +633,7 @@ def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Fi
         "fault-sites": registries.run_fault_sites,
         "exit-codes": exitcodes.run,
         "metrics": metricsreg.run,
+        "costspec": costspec.run,
     }
 
 

@@ -155,6 +155,23 @@ KNOB_REGISTRY: dict[str, str] = {
     # event-loop-lag collector: peak-hold decay half-life (0 disables the
     # collector AND its admission-pressure fold)
     "KMLS_LOOP_LAG_HALF_LIFE_S": "serving",
+    # --- serving: device-truth cost attribution + SLOs (ISSUE 12) ---
+    # per-kernel MFU/roofline + memory/compile telemetry (0 disables the
+    # cost model entirely — proven zero-cost, observation-counter style)
+    "KMLS_COSTMODEL": "serving",
+    # peak FLOP/s and HBM bytes/s the MFU/roofline math measures against
+    # (default: auto from the device kind — observability/costmodel.py's
+    # peak table; the TPU window pins the exact chip)
+    "KMLS_PEAK_FLOPS": "serving",
+    "KMLS_PEAK_BYTES_PER_S": "serving",
+    # SLO layer (observability/slo.py): latency target, error/degrade
+    # budgets, and the fast/slow burn-rate windows — observability only,
+    # the PR 8 admission ladder stays the actuator
+    "KMLS_SLO_P99_MS": "serving",
+    "KMLS_SLO_ERROR_BUDGET": "serving",
+    "KMLS_SLO_DEGRADE_BUDGET": "serving",
+    "KMLS_SLO_FAST_WINDOW_S": "serving",
+    "KMLS_SLO_SLOW_WINDOW_S": "serving",
     # --- mining: semantics / device dispatch ---
     "KMLS_MAX_ITEMSET_LEN": "mining",
     "KMLS_K_MAX_CONSEQUENTS": "mining",
@@ -177,7 +194,11 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_POPCOUNT_TILE_I": "mining",
     "KMLS_POPCOUNT_TILE_J": "mining",
     "KMLS_POPCOUNT_WORD_CHUNK": "mining",
-    "KMLS_PROFILE_DIR": "mining",
+    # jax.profiler trace dumps: the mining PhaseTimer sessions AND the
+    # serving /debug/profile?seconds=N capture endpoint (ISSUE 12) —
+    # unset (the default) disables both, so production pods can never
+    # be profiled by accident
+    "KMLS_PROFILE_DIR": "both",
     # --- mining: ALS embedding phase (second model family) ---
     "KMLS_EMBED_ENABLED": "mining",
     "KMLS_ALS_RANK": "mining",
@@ -250,6 +271,10 @@ KNOB_REGISTRY: dict[str, str] = {
     # sampled-vs-disabled p99 comparison bracket
     "KMLS_BENCH_TRACE_QPS": "tool",
     "KMLS_BENCH_TRACE_REQUESTS": "tool",
+    # cost-attribution phase (ISSUE 12): rate / volume for the
+    # serve-kernel MFU + roofline + compiles==0 bracket
+    "KMLS_BENCH_COSTATTRIB_QPS": "tool",
+    "KMLS_BENCH_COSTATTRIB_REQUESTS": "tool",
     # continuous-freshness phase (ISSUE 10): request rate/volume for the
     # mid-delta zero-5xx replay bracket
     "KMLS_BENCH_FRESHNESS_QPS": "tool",
@@ -707,6 +732,25 @@ class ServingConfig:
     # 0 disables the collector and the pressure fold.
     loop_lag_half_life_s: float = 1.0
 
+    # --- device-truth cost attribution + SLOs (ISSUE 12) ---
+    # Per-kernel cost attribution (observability/costmodel.py): fenced
+    # device seconds × analytic FLOPs/bytes specs → achieved rates, MFU
+    # vs the backend peak, roofline class, live compile counter, and
+    # the publish-time memory accounting — all at /metrics. Off = the
+    # engine holds no cost model at all (one is-None check per batch;
+    # the module observation counter proves zero work, test-pinned).
+    costmodel_enabled: bool = True
+    # SLO burn rates (observability/slo.py, /debug/slo +
+    # kmls_slo_burn_rate): the p99-latency target (snapped up to the
+    # nearest histogram bucket boundary), the availability (errors +
+    # sheds) and quality (degraded answers) budgets as bad-event
+    # fractions, and the fast/slow alerting windows.
+    slo_p99_ms: float = 25.0
+    slo_error_budget: float = 0.001
+    slo_degrade_budget: float = 0.01
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+
     # --- second model family: hybrid rule∪embedding serving ---
     # How the two model families combine when an embedding artifact is
     # published: "rules" ignores embeddings entirely (the legacy path),
@@ -785,5 +829,15 @@ class ServingConfig:
             trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),
             loop_lag_half_life_s=_getenv_float(
                 "KMLS_LOOP_LAG_HALF_LIFE_S", 1.0
+            ),
+            costmodel_enabled=_getenv_bool("KMLS_COSTMODEL", True),
+            slo_p99_ms=_getenv_float("KMLS_SLO_P99_MS", 25.0),
+            slo_error_budget=_getenv_float("KMLS_SLO_ERROR_BUDGET", 0.001),
+            slo_degrade_budget=_getenv_float(
+                "KMLS_SLO_DEGRADE_BUDGET", 0.01
+            ),
+            slo_fast_window_s=_getenv_float("KMLS_SLO_FAST_WINDOW_S", 300.0),
+            slo_slow_window_s=_getenv_float(
+                "KMLS_SLO_SLOW_WINDOW_S", 3600.0
             ),
         )

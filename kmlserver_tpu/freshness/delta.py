@@ -362,6 +362,12 @@ class DeltaResult:
     base_token: str
     dataset: str = ""
     run_index: int = 0
+    # the recounted shape (combined baskets after the prune decision) —
+    # what the restricted recount's analytic cost attribution
+    # (costmodel "delta_recount", jobmetrics phase cost) is computed
+    # over; 0/0 when nothing was recounted
+    n_playlists: int = 0
+    n_tracks: int = 0
 
 
 def _read_suffix_table(path: str, offset: int, limit: int | None = None):
@@ -748,6 +754,8 @@ def run_delta_job(cfg: MiningConfig, mesh=None) -> DeltaResult:
             fencing_token=lease.fencing_token if lease else None,
             base_token=base["token"],
             dataset=base["dataset"], run_index=int(base["run_index"]),
+            n_playlists=int(mined.n_playlists),
+            n_tracks=int(mined.n_tracks),
         )
     except BaseException:
         if lease is not None:

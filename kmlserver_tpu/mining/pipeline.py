@@ -48,6 +48,7 @@ from .. import faults
 from ..config import BASE_INDEX, MiningConfig
 from ..data.csv import read_tracks
 from ..io import artifacts, registry
+from ..observability import costmodel
 from ..observability.jobmetrics import JobMetrics
 from ..utils.timeutil import get_current_time_str, get_current_time_str_precise
 from . import checkpoint as ckpt_mod
@@ -196,6 +197,16 @@ def run_mining_job(
                 try:
                     jm_delta.phase_done("delta", res.duration_s)
                     if res.bundle_path:
+                        # analytic cost attribution (ISSUE 12): the
+                        # delta's device compute is the column-
+                        # restricted recount C[R, :] over the combined
+                        # baskets — same formula the serving MFU uses
+                        flops, moved = costmodel.phase_cost(
+                            "delta_recount",
+                            p=res.n_playlists, v=res.n_tracks,
+                            rows=res.n_touched,
+                        )
+                        jm_delta.note_phase_cost("delta", flops, moved)
                         jm_delta.note_artifact("delta", res.bundle_path)
                     jm_delta.finish(
                         True,
@@ -322,7 +333,15 @@ def run_mining_job(
                 playlists=result.n_playlists,
                 tracks=result.n_tracks,
             )
-            jm.write()
+            # analytic cost attribution (ISSUE 12): the mine phase's
+            # dominant kernel is the pair-support contraction C = XᵀX
+            # over the (possibly pruned) mined shape — leading-order,
+            # same costmodel.phase_cost formula serving MFU uses
+            flops, moved = costmodel.phase_cost(
+                "support_count",
+                p=result.n_playlists, v=result.n_tracks,
+            )
+            jm.note_phase_cost("mine", flops, moved)
 
         rules_dict = phase(
             "rules", lambda: tensors.to_rules_dict(result.vocab_names)
@@ -355,6 +374,16 @@ def run_mining_job(
                     f"{emb_payload['final_loss']:.3f} "
                     f"({emb_payload['duration_s']:.2f}s)"
                 )
+                if jm is not None:
+                    # analytic cost attribution (ISSUE 12): the embed
+                    # phase is the ALS half-sweep loop over the full
+                    # interaction matrix
+                    flops, moved = costmodel.phase_cost(
+                        "als_sweep",
+                        p=baskets.n_playlists, v=baskets.n_tracks,
+                        r=emb_payload["rank"], iters=emb_payload["iters"],
+                    )
+                    jm.note_phase_cost("embed", flops, moved)
 
         # ---------- publication (writer only, lease-fenced) ----------
         paths: dict[str, str] = {}

@@ -64,6 +64,11 @@ class JobMetrics:
         self.phases: dict[str, dict] = {}
         self.dataset: dict[str, float] = {}
         self.artifact_bytes: dict[str, int] = {}
+        # phase -> (flops, bytes_moved): analytic per-phase attribution
+        # (ISSUE 12) from costmodel.phase_cost — what the phase's
+        # dominant kernel computed/moved, same formulas the serving MFU
+        # uses, so the two sides' numbers are comparable
+        self.phase_cost: dict[str, tuple[float, float]] = {}
         self.rule_generation_s: float | None = None
         self.fencing_token: int | None = None
         self.success = 0
@@ -91,6 +96,15 @@ class JobMetrics:
             "kmls_job_playlists": playlists,
             "kmls_job_tracks": tracks,
         }
+
+    def note_phase_cost(
+        self, phase: str, flops: float, bytes_moved: float
+    ) -> None:
+        """Attach the analytic FLOPs/bytes attribution of ``phase``'s
+        dominant kernel (costmodel.phase_cost), then persist — cost
+        telemetry must survive a preemption exactly like durations."""
+        self.phase_cost[phase] = (max(flops, 0.0), max(bytes_moved, 0.0))
+        self.write()
 
     def note_artifact(self, name: str, path: str) -> None:
         try:
@@ -137,6 +151,16 @@ class JobMetrics:
             series(
                 "kmls_job_phase_resumed",
                 int(self.phases[phase]["resumed"]), f'{{phase="{phase}"}}',
+            )
+        for phase in sorted(self.phase_cost):
+            series(
+                "kmls_job_phase_flops",
+                self.phase_cost[phase][0], f'{{phase="{phase}"}}',
+            )
+        for phase in sorted(self.phase_cost):
+            series(
+                "kmls_job_phase_bytes_moved",
+                self.phase_cost[phase][1], f'{{phase="{phase}"}}',
             )
         for name, value in self.dataset.items():
             series(name, value)

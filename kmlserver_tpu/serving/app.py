@@ -34,7 +34,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config import ServingConfig
-from ..observability import LoopLagMonitor, SpanRecorder
+from ..observability import LoopLagMonitor, SloTracker, SpanRecorder
 from .batcher import (
     DeadlineExceeded,
     NoHealthyReplicas,
@@ -70,6 +70,19 @@ CANNED_EXAMPLES = {
 Response = tuple[int, dict[str, str], bytes]
 
 
+def is_loopback_host(client_host: str | None) -> bool:
+    """THE loopback guard (ISSUE 12 satellite — one copy, four
+    endpoints: ``/metrics/reset``, ``/debug/traces``, ``/debug/slo``,
+    ``/debug/profile``). ``None`` is a direct in-process call (tests,
+    embedding harnesses) — inherently local. A dual-stack server reports
+    IPv4 loopback in IPv6-mapped form (``::ffff:127.0.0.1``): normalize
+    before the check (ADVICE r5 #3)."""
+    if client_host is None:
+        return True
+    host = client_host.removeprefix("::ffff:")
+    return host in ("127.0.0.1", "::1")
+
+
 def _json_response(status: int, obj) -> Response:
     body = json.dumps(obj).encode("utf-8")
     return status, {"Content-Type": "application/json"}, body
@@ -89,6 +102,9 @@ class RecommendApp:
     _ring_self = ""
     affinity_local_total = 0
     affinity_remote_total = 0
+    slo = None
+    _profile_thread = None
+    _profile_lock = threading.Lock()
 
     def __init__(
         self, cfg: ServingConfig, engine: RecommendEngine | None = None,
@@ -115,6 +131,22 @@ class RecommendApp:
             if cfg.loop_lag_half_life_s > 0
             else None
         )
+        # SLO burn rates (ISSUE 12): multi-window budget consumption
+        # computed lazily from the metrics counters/histograms whenever
+        # /metrics or /debug/slo reads it — nothing on the request path
+        self.slo = SloTracker(
+            self.metrics,
+            p99_target_ms=cfg.slo_p99_ms,
+            error_budget=cfg.slo_error_budget,
+            degrade_budget=cfg.slo_degrade_budget,
+            fast_window_s=cfg.slo_fast_window_s,
+            slow_window_s=cfg.slo_slow_window_s,
+        )
+        # one on-demand profiler capture at a time (/debug/profile —
+        # utils/profiling.trace_session on a background thread; the lock
+        # serializes check-and-start across handler threads)
+        self._profile_thread = None
+        self._profile_lock = threading.Lock()
         # epoch-keyed answer cache in front of the batcher (serving/cache
         # .py): a bundle hot swap invalidates it wholesale because the
         # engine's epoch is the key prefix — no flush coordination needed
@@ -206,20 +238,16 @@ class RecommendApp:
         client_host: str | None = None,
         trace_header: str | None = None,
     ) -> Response:
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
             return self._post_recommend(body, trace_header)
         if method == "POST" and path == "/metrics/reset":
-            # measurement-harness hook: windows the latency percentiles to
-            # one replay run (VERDICT r4 #7). Guarded to loopback — a None
-            # client_host is a direct in-process call (tests/embedding),
-            # inherently local. A dual-stack server reports IPv4 loopback
-            # in IPv6-mapped form ('::ffff:127.0.0.1'): normalize before
-            # the check (ADVICE r5 #3).
-            if client_host is not None:
-                host = client_host.removeprefix("::ffff:")
-                if host not in ("127.0.0.1", "::1"):
-                    return _json_response(403, {"detail": "localhost only"})
+            # measurement-harness hook: windows the latency percentiles
+            # to one replay run (VERDICT r4 #7). Loopback-only via the
+            # shared guard (is_loopback_host — one copy for all four
+            # guarded endpoints).
+            if not is_loopback_host(client_host):
+                return _json_response(403, {"detail": "localhost only"})
             discarded = self.metrics.reset_latency()
             return _json_response(
                 200, {"status": "reset", "discarded": discarded}
@@ -245,12 +273,22 @@ class RecommendApp:
                     # never readiness-fail ALL replicas at once. A 503
                     # here would restart-loop the whole fleet over data
                     # no restart can fix.
+                    ages = {
+                        name: round(age, 3)
+                        for name, age in self._artifact_ages().items()
+                    }
                     reasons = self.degraded_reasons()
                     if reasons:
                         return _json_response(
-                            200, {"status": "degraded", "reasons": reasons}
+                            200, {
+                                "status": "degraded", "reasons": reasons,
+                                "artifact_age_seconds": ages,
+                            }
                         )
-                    return _json_response(200, {"status": "ready"})
+                    return _json_response(
+                        200,
+                        {"status": "ready", "artifact_age_seconds": ages},
+                    )
                 return _json_response(
                     503, {"status": "awaiting first artifacts"}
                 )
@@ -263,11 +301,26 @@ class RecommendApp:
                 # payloads (seed songs in span attrs and shed/degraded
                 # bodies) and must not be fleet-scrapeable by default —
                 # the tracejoin tooling runs next to the pod it debugs.
-                if client_host is not None:
-                    host = client_host.removeprefix("::ffff:")
-                    if host not in ("127.0.0.1", "::1"):
-                        return _json_response(403, {"detail": "localhost only"})
+                if not is_loopback_host(client_host):
+                    return _json_response(403, {"detail": "localhost only"})
                 return _json_response(200, self.recorder.debug_payload())
+            if path == "/debug/slo":
+                # burn-rate detail (ISSUE 12): targets, windows, the
+                # cumulative inputs, fast+slow burn per SLO. Loopback-
+                # only like its /debug siblings — same policy, same
+                # shared guard (fleet scraping belongs to /metrics,
+                # which carries the kmls_slo_burn_rate gauges).
+                if not is_loopback_host(client_host):
+                    return _json_response(403, {"detail": "localhost only"})
+                if self.slo is None:
+                    return _json_response(
+                        404, {"detail": "slo tracker not configured"}
+                    )
+                return _json_response(200, self.slo.debug_payload())
+            if path == "/debug/profile":
+                if not is_loopback_host(client_host):
+                    return _json_response(403, {"detail": "localhost only"})
+                return self._debug_profile(query)
             if path == "/metrics":
                 text = self.metrics.render(
                     self.engine.reload_counter, self.engine.finished_loading,
@@ -279,6 +332,9 @@ class RecommendApp:
                     shard_counts=getattr(
                         self.engine, "shard_dispatch_counts", None
                     ),
+                    cost=getattr(self.engine, "cost_model", None),
+                    slo=self.slo,
+                    artifact_ages=self._artifact_ages(),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -363,6 +419,68 @@ class RecommendApp:
             self.recorder.retained() if self.recorder.enabled else 0
         )
         return state
+
+    def _artifact_ages(self) -> dict:
+        """Per-artifact freshness ages from the engine (empty before the
+        first load, or with an engine test double predating the API)."""
+        ages_fn = getattr(self.engine, "artifact_ages", None)
+        return ages_fn() if callable(ages_fn) else {}
+
+    def _debug_profile(self, query: str) -> Response:
+        """``GET /debug/profile?seconds=N`` (ISSUE 12): capture a
+        ``jax.profiler`` trace of the live server for N seconds through
+        ``utils/profiling.trace_session`` — the same opt-in policy as
+        offline profiling: ``KMLS_PROFILE_DIR`` must be set or the
+        capture is refused (409), so production serving can never be
+        profiled by accident. The capture runs on a background thread
+        (the async transport handles this route ON the loop — blocking
+        N seconds here would freeze every connection) and the response
+        returns immediately with the dump directory; one capture at a
+        time."""
+        from ..utils import profiling
+
+        target = profiling.profile_dir()
+        if target is None:
+            return _json_response(
+                409,
+                {"detail": "profiling disabled: set KMLS_PROFILE_DIR "
+                           "to enable /debug/profile captures"},
+            )
+        try:
+            params = dict(
+                pair.split("=", 1) for pair in query.split("&") if "=" in pair
+            )
+            seconds = float(params.get("seconds", "5"))
+        except ValueError:
+            seconds = float("nan")
+        if not math.isfinite(seconds):
+            # nan/inf slide through a min/max clamp (comparisons are
+            # false), then kill the capture thread AFTER the 202 — reject
+            # up front instead
+            return _json_response(
+                422, {"detail": "seconds must be a finite number"}
+            )
+        seconds = min(max(seconds, 0.05), 120.0)
+        label = f"serve-capture-{int(time.time())}"
+        # check-and-start under a lock: jax allows ONE active profiler
+        # session, so two racing requests must not both start a capture
+        # (the loser's thread would die after its 202 already went out)
+        with self._profile_lock:
+            thread = self._profile_thread
+            if thread is not None and thread.is_alive():
+                return _json_response(
+                    409, {"detail": "a profile capture is already running"}
+                )
+            self._profile_thread = profiling.start_capture(label, seconds)
+        return _json_response(
+            202,
+            {
+                "status": "capturing",
+                "seconds": seconds,
+                "label": label,
+                "dir": os.path.join(target, label),
+            },
+        )
 
     _STATIC_TYPES = {
         ".css": "text/css; charset=utf-8",
@@ -944,6 +1062,9 @@ document.getElementById('send').addEventListener('click', async function () {{
 <li><code>GET /test</code> — redirect here</li>
 <li><code>GET /healthz</code>, <code>GET /readyz</code> — probes</li>
 <li><code>GET /metrics</code> — Prometheus text metrics</li>
+<li><code>GET /debug/traces</code>, <code>GET /debug/slo</code>,
+<code>GET /debug/profile?seconds=N</code> — loopback-only debug views
+(retained traces, SLO burn rates, on-demand profiler capture)</li>
 </ul></body></html>"""
         return _html_response(200, html)
 

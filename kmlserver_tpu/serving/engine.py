@@ -85,6 +85,7 @@ from .. import faults
 from ..config import ServingConfig
 from ..io import artifacts, registry
 from ..io.artifacts import ArtifactIntegrityError
+from ..observability import costmodel as costmodel_mod
 from ..ops.embed import embed_topk
 from ..ops.serve import recommend_batch, recommend_batch_donated
 
@@ -306,6 +307,18 @@ class RecommendEngine:
         # dispatches whose (batch, length) shape was never pre-warmed —
         # each one paid a jit compile on the serving path; must stay 0
         self.unwarmed_dispatches = 0
+        # ---- device-truth cost attribution (ISSUE 12) ----
+        # per-kernel MFU/roofline + memory/compile telemetry; None with
+        # KMLS_COSTMODEL=0, making every call site one attribute check
+        # (the disabled mode's zero-cost proof rides the module-level
+        # OBSERVATIONS_TOTAL counter, began-counter style)
+        self.cost_model = (
+            costmodel_mod.CostModel() if cfg.costmodel_enabled else None
+        )
+        # per-artifact publication timestamps (wall clock) — the
+        # freshness-age surface /readyz and kmls_artifact_age_seconds
+        # report; empty before the first load
+        self._artifact_written_at: dict[str, float] = {}
         # reusable host staging buffers, one per padded seed shape: steady
         # state does no fresh host allocation per batch. Guarded by the
         # lock (fill + transfer must not interleave across threads) and by
@@ -352,6 +365,11 @@ class RecommendEngine:
             # decision predates the load that just completed)
             if self.finished_loading and not self.is_data_stale():
                 return True
+            if self.cost_model is not None:
+                # a (re)publication is starting: bank genuine serving-
+                # path compiles seen so far, so the warmup about to run
+                # is absorbed by mark_published instead of billed live
+                self.cost_model.note_prepublish()
             cfg = self.cfg
             best_path = os.path.join(cfg.pickles_dir, cfg.best_tracks_file)
             rec_path = os.path.join(cfg.pickles_dir, cfg.recommendations_file)
@@ -449,6 +467,27 @@ class RecommendEngine:
             self.consecutive_reload_failures = 0
             self.last_load_error = None
             self._backoff_until = 0.0
+            # per-artifact freshness bookkeeping: rules age from the
+            # manifest's written_at (just resolved above), popularity/
+            # embeddings from their file mtimes (the manifest covers the
+            # set, not per-file stamps); delta-chain rides
+            # _applied_written_at, which deltas advance in place
+            ages = {"rules": self._applied_written_at}
+            ages["popularity"] = self._file_written_at(
+                best_path, self._applied_written_at
+            )
+            if replicas[0].emb_factors is not None:
+                ages["embeddings"] = self._file_written_at(
+                    artifacts.embeddings_artifact_path(self.cfg.pickles_dir),
+                    self._applied_written_at,
+                )
+            self._artifact_written_at = ages
+            # cost attribution (ISSUE 12): publish-time tensor-residency
+            # accounting + compile-watch snapshot (post-warmup, so the
+            # kmls_compiles_total counter starts at zero for this
+            # generation — any growth IS a compile on the serving path)
+            if self.cost_model is not None:
+                self._note_publish_cost(replicas)
             logger.info(
                 "reload #%d complete (epoch %d): %d tracks, %d rule keys, "
                 "%d replica(s), layout %s (%d shard(s)), embeddings %s, "
@@ -979,6 +1018,72 @@ class RecommendEngine:
             return 0.0
         return max(time.time() - self._applied_written_at, 0.0)
 
+    @staticmethod
+    def _file_written_at(path: str, fallback: float) -> float:
+        """Best-effort artifact publication stamp: the file's mtime, or
+        the generation's manifest stamp when the file can't answer."""
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return fallback
+
+    def artifact_ages(self) -> dict[str, float]:
+        """Per-artifact freshness age (seconds since publication) for
+        every artifact the server currently answers from — the
+        staleness-bound surface /readyz and the
+        ``kmls_artifact_age_seconds`` gauge report. ``delta-chain`` is
+        the age of the newest APPLIED generation (base or delta): with
+        no deltas applied it equals ``rules``, and a delta apply shrinks
+        it without touching the base stamp — exactly the gap the delta
+        path exists to shrink. Empty before the first load."""
+        if not self._artifact_written_at:
+            return {}
+        now = time.time()
+        out = {
+            name: max(now - stamp, 0.0)
+            for name, stamp in self._artifact_written_at.items()
+        }
+        out["delta-chain"] = self.freshness_lag_s()
+        return out
+
+    def _note_publish_cost(self, replicas: list[RuleBundle]) -> None:
+        """Publish-time cost-model bookkeeping (caller holds
+        ``_reload_lock``; cost model known non-None): the analytic
+        tensor residency the layout decision measured, the live
+        bytes-in-use watermark where the backend reports one, and the
+        compile-watch snapshot for every jitted kernel this generation
+        dispatches (taken AFTER warmup, so post-publish cache growth is
+        exactly a compile on the serving path)."""
+        cm = self.cost_model
+        bundle = replicas[0]
+        tensor_bytes = {
+            "rule_ids": int(bundle.rule_ids.nbytes),
+            "rule_confs": int(bundle.rule_confs.nbytes),
+        }
+        if bundle.emb_factors is not None:
+            tensor_bytes["embeddings"] = int(bundle.emb_factors.nbytes)
+        cm.note_publish(
+            tensor_bytes,
+            self.cfg.device_budget_bytes,
+            n_shards=bundle.n_shards,
+            watermark_bytes=costmodel_mod.device_watermark_bytes(
+                bundle.device
+            ),
+        )
+        if bundle.host_rule_ids is None:
+            if bundle.shard_kernel is not None:
+                cm.watch_compiles("serve_sharded", bundle.shard_kernel)
+            else:
+                kernel = self._resolve_kernel()
+                # the engine wraps the jitted fn in a partial(k_best=);
+                # the jit cache lives on the underlying function
+                cm.watch_compiles(
+                    "serve_rules", getattr(kernel, "func", kernel)
+                )
+        if bundle.emb_factors is not None:
+            cm.watch_compiles("embed_topk", embed_topk)
+        cm.mark_published()
+
     def _note_delta_rejection(self, seq: int, message: str) -> None:
         self.delta_rejected_total += 1
         self.last_delta_error = message
@@ -1035,6 +1140,10 @@ class RecommendEngine:
                     "artifact); serving the base generation"
                 )
                 return 0
+            if self.cost_model is not None:
+                # same pre-warmup banking as load(): the applies below
+                # re-warm patched tensors legitimately
+                self.cost_model.note_prepublish()
             for entry in pending:
                 seq = int(entry.get("seq", 0))
                 if seq != self.delta_seq + 1:
@@ -1119,6 +1228,12 @@ class RecommendEngine:
                 self._applied_written_at = float(
                     entry.get("written_at") or time.time()
                 )
+                # cost attribution: an in-place apply re-publishes the
+                # patched tensors (new residency, possibly new warmed
+                # shapes) — re-snapshot so legitimate re-warm compiles
+                # are absorbed exactly like a full publication's
+                if self.cost_model is not None:
+                    self._note_publish_cost(replicas)
                 applied += 1
                 touched = delta_mod.touched_names(bundle)
                 logger.info(
@@ -1444,6 +1559,8 @@ class RecommendEngine:
             )
             self._note_dispatch(idx)
 
+            cm = self.cost_model
+
             def finish_native() -> list[tuple[list[str], str]]:
                 from . import native_serve
 
@@ -1451,14 +1568,44 @@ class RecommendEngine:
                 # failure or stall surfaces (delay faults sleep here, fail
                 # faults raise into the batcher's circuit breaker)
                 faults.fire("replica.kernel", replica=idx)
+                t_kernel = time.perf_counter() if cm is not None else 0.0
                 # the ctypes call releases the GIL for the whole batch
                 host_ids, host_confs = native_serve.serve_topk(
                     bundle.host_rule_ids, bundle.host_rule_confs, arr,
                     self.cfg.k_best_tracks,
                 )
+                if cm is not None:
+                    # same algorithm as serve_rules, on the host — the
+                    # synchronous call IS its own fence
+                    cm.observe_kernel(
+                        "serve_native",
+                        time.perf_counter() - t_kernel,
+                        b=len(seed_sets), l=length,
+                        k_max=bundle.host_rule_ids.shape[1],
+                        v=len(bundle.vocab), k_best=self.cfg.k_best_tracks,
+                    )
                 emb_host = None
                 if emb is not None:
+                    # the embed kernel ran on the DEVICE while the native
+                    # kernel ran on the host — this fence measures only
+                    # the residual wait, so the embed attribution here is
+                    # a floor on device time (rates read high; the MFU
+                    # cap keeps the headline honest, and the jitted-path
+                    # attribution above is the one benches measure)
+                    t_emb = time.perf_counter() if cm is not None else 0.0
                     emb_host = (np.asarray(emb[0]), np.asarray(emb[1]), emb[2])
+                    if cm is not None:
+                        cm.observe_kernel(
+                            "embed_topk",
+                            time.perf_counter() - t_emb,
+                            b=self._bucket_batch(max(len(seed_sets), 1)),
+                            l=self._bucket_len(
+                                max((len(s) for s in seed_sets), default=1)
+                            ),
+                            v=len(bundle.emb_vocab or ()),
+                            r=int(bundle.emb_factors.shape[1]),
+                            k_best=self.cfg.k_best_tracks,
+                        )
                 out: list[tuple[list[str], str]] = []
                 for r, seeds in enumerate(seed_sets):
                     emb_row = None if emb_host is None else (
@@ -1487,6 +1634,8 @@ class RecommendEngine:
         # sharded layout dispatches the vocab-sharded lookup (per-shard
         # gather/top-k + cross-device max-merge) resolved at publication;
         # replicated keeps the per-replica kernel
+        cm = self.cost_model
+        t_kernel = time.perf_counter() if cm is not None else 0.0
         top_ids, top_confs = (bundle.shard_kernel or self._resolve_kernel())(
             bundle.rule_ids, bundle.rule_confs, seeds_dev
         )
@@ -1501,9 +1650,41 @@ class RecommendEngine:
             faults.fire("replica.kernel", replica=idx)
             host_ids = np.asarray(top_ids)  # blocks on the device transfer
             host_confs = np.asarray(top_confs)
+            if cm is not None:
+                # fenced per-kernel attribution (ISSUE 12): the host
+                # conversion above IS the fence for the rule kernel (the
+                # device executes in order, so the embed kernel hasn't
+                # started billing yet); dispatch→fence is the same
+                # upper-bound-on-device-time semantics as the batcher's
+                # device span, so the derived MFU is a lower bound
+                t_rules = time.perf_counter()
+                dims = dict(
+                    b=n_rows, l=length, k_max=bundle.rule_ids.shape[1],
+                    v=len(bundle.vocab), k_best=self.cfg.k_best_tracks,
+                    shards=bundle.n_shards,
+                )
+                if bundle.shard_kernel is not None:
+                    cm.observe_kernel(
+                        "serve_sharded", t_rules - t_kernel, **dims
+                    )
+                else:
+                    cm.observe_kernel(
+                        "serve_rules", t_rules - t_kernel, **dims
+                    )
             emb_host = None
             if emb is not None:
                 emb_host = (np.asarray(emb[0]), np.asarray(emb[1]), emb[2])
+                if cm is not None:
+                    # incremental fence: rule kernel already fenced at
+                    # t_rules, so this span bills only the embed kernel's
+                    # compute + transfer (in-order device queue)
+                    cm.observe_kernel(
+                        "embed_topk",
+                        time.perf_counter() - t_rules,
+                        b=n_rows, l=length, v=len(bundle.emb_vocab or ()),
+                        r=int(bundle.emb_factors.shape[1]),
+                        k_best=self.cfg.k_best_tracks,
+                    )
             out: list[tuple[list[str], str]] = []
             for r, seeds in enumerate(seed_sets):
                 emb_row = None if emb_host is None else (

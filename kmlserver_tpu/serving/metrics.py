@@ -104,6 +104,43 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_traces_began_total": "counter:serving",
     "kmls_traces_retained_total": "counter:serving",
     "kmls_trace_buffer_entries": "gauge:serving",
+    # --- serving: device-truth cost attribution (ISSUE 12) ---
+    # per-kernel fenced device time + analytic FLOPs/bytes → achieved
+    # rates, MFU vs the backend peak table, and the roofline class
+    # (1 = compute-bound); rendered by observability/costmodel.py
+    "kmls_kernel_device_seconds": "counter:serving",
+    "kmls_kernel_dispatches_total": "counter:serving",
+    "kmls_kernel_flops_per_second": "gauge:serving",
+    "kmls_kernel_bytes_per_second": "gauge:serving",
+    "kmls_mfu": "gauge:serving",
+    "kmls_kernel_compute_bound": "gauge:serving",
+    # jit-cache growth after publication — the LIVE form of the
+    # zero-compiles-post-publish invariant (was test-only before)
+    "kmls_compiles_total": "counter:serving",
+    # cost-model bookkeeping: total observations (the zero-cost proof
+    # counter — 0 with KMLS_COSTMODEL=0) and dispatches naming a kernel
+    # with no registered spec (the costspec checker's runtime shadow)
+    "kmls_costmodel_observations_total": "counter:serving",
+    "kmls_costmodel_unspecced_total": "counter:serving",
+    # memory telemetry: live memory_stats() gauges where the backend
+    # provides them, plus the analytic per-artifact tensor residency
+    # the layout.py auto decision measures — budget, headroom, and the
+    # publish-time bytes-in-use watermark
+    "kmls_device_bytes_in_use": "gauge:serving",
+    "kmls_device_bytes_limit": "gauge:serving",
+    "kmls_model_tensor_bytes": "gauge:serving",
+    "kmls_device_budget_bytes": "gauge:serving",
+    "kmls_device_headroom_bytes": "gauge:serving",
+    "kmls_publish_watermark_bytes": "gauge:serving",
+    # --- serving: SLO burn rates (ISSUE 12, observability/slo.py) ---
+    # multi-window budget-consumption rates (slo ∈ latency_p99/
+    # availability/quality, window ∈ fast/slow); observability only —
+    # the admission ladder stays the actuator
+    "kmls_slo_burn_rate": "gauge:serving",
+    # per-artifact freshness age (ISSUE 12 satellite): seconds since
+    # each served artifact's publication (rules/delta-chain/embeddings/
+    # popularity) — the staleness bound /readyz also reports
+    "kmls_artifact_age_seconds": "gauge:serving",
     # --- serving: lifecycle ---
     "kmls_reloads_total": "counter:serving",
     "kmls_finished_loading": "gauge:serving",
@@ -123,6 +160,11 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_job_duration_seconds": "gauge:mining",
     "kmls_job_success": "gauge:mining",
     "kmls_job_last_success_timestamp_seconds": "gauge:mining",
+    # per-phase analytic cost attribution (ISSUE 12): the same
+    # costmodel.phase_cost formulas the serving side uses, evaluated on
+    # the mined shape — what the phase's dominant kernel moved/computed
+    "kmls_job_phase_flops": "gauge:mining",
+    "kmls_job_phase_bytes_moved": "gauge:mining",
 }
 
 # The autoscaling signal (ISSUE 8): the gauge kubernetes/hpa.yaml scales
@@ -354,16 +396,20 @@ class ServingMetrics:
     def render(
         self, reload_counter: int, finished_loading: bool,
         cache=None, dispatch_counts=None, robustness=None,
-        shard_counts=None,
+        shard_counts=None, cost=None, slo=None, artifact_ages=None,
     ) -> str:
         """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
         ``dispatch_counts`` (the engine's per-replica dispatch counters),
         ``robustness`` (a flat dict of engine/batcher recovery-state
         values — names ending in ``_total`` render as counters, the rest
-        as gauges, all under a ``kmls_`` prefix) and ``shard_counts``
+        as gauges, all under a ``kmls_`` prefix), ``shard_counts``
         (per-vocab-shard seed-hit counters, present only under the
-        sharded model layout) are optional — deployments without them
-        render exactly the old exposition."""
+        sharded model layout), ``cost`` (an observability.costmodel
+        .CostModel — per-kernel MFU/roofline + memory/compile
+        telemetry), ``slo`` (an observability.slo.SloTracker) and
+        ``artifact_ages`` (artifact name → seconds since publication)
+        are optional — deployments without them render exactly the old
+        exposition."""
         p50, p95, p99 = self.latency.percentiles(0.50, 0.95, 0.99)
         uptime = time.time() - self.started_at
         lines = [
@@ -472,6 +518,25 @@ class ServingMetrics:
             "# TYPE kmls_uptime_seconds gauge",
             f"kmls_uptime_seconds {uptime:.1f}",
         ]
+        if cost is not None:
+            # device-truth cost attribution (ISSUE 12): per-kernel
+            # device seconds / achieved rates / MFU / roofline class,
+            # the live compile counter, and the memory accounting —
+            # rendered by the cost model itself (one exposition site)
+            lines += cost.render_lines()
+        if slo is not None:
+            # multi-window SLO burn rates (observability only — the
+            # admission ladder stays the actuator)
+            lines += slo.render_lines()
+        if artifact_ages:
+            # per-artifact freshness age: seconds since each served
+            # artifact's publication (the /readyz staleness bound)
+            lines.append("# TYPE kmls_artifact_age_seconds gauge")
+            lines += [
+                f'kmls_artifact_age_seconds{{artifact="{name}"}} '
+                f"{artifact_ages[name]:.3f}"
+                for name in sorted(artifact_ages)
+            ]
         if robustness:
             # dedupe by series name (ISSUE 9 satellite): a robustness key
             # colliding with a statically rendered series (e.g. a

@@ -54,6 +54,27 @@ def trace_session(label: str) -> Iterator[None]:
         yield
 
 
+def start_capture(label: str, seconds: float) -> "object":
+    """Timed on-demand capture (ISSUE 12, the ``/debug/profile``
+    endpoint): run :func:`trace_session` for ``seconds`` on a daemon
+    thread → the thread (join it to wait; the endpoint doesn't). The
+    trace covers whatever the process executes while the window is open
+    — for a live server, the serving kernels under real traffic. A no-op
+    thread when profiling is disabled (the caller gates on
+    :func:`profile_dir`, this is belt-and-braces)."""
+    import threading
+
+    def run() -> None:
+        with trace_session(label):
+            time.sleep(max(seconds, 0.0))
+
+    thread = threading.Thread(
+        target=run, daemon=True, name="kmls-profile-capture"
+    )
+    thread.start()
+    return thread
+
+
 class PhaseTimer:
     """Named phase timings with device-sync fencing.
 

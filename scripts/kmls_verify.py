@@ -3,7 +3,7 @@
 
 Usage (from the repo root)::
 
-    python scripts/kmls_verify.py                 # all six checkers
+    python scripts/kmls_verify.py                 # all eight checkers
     python scripts/kmls_verify.py --checker knobs --checker locks
     python scripts/kmls_verify.py --json          # machine-readable
     python scripts/kmls_verify.py --write-baseline  # accept current findings

@@ -709,6 +709,144 @@ def test_metrics_registry_keys_do_not_keep_themselves_alive(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# checker 8: kernel cost-spec registry (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_COSTMODEL_GOOD = """
+    KERNEL_COST_SPECS = {
+        "serve_fast": None,
+        "mine_count": None,
+    }
+
+    METRIC_REGISTRY_STUB = True
+    """
+
+_COSTMODEL_SERIES = """
+    KERNEL_COST_SPECS = {
+        "serve_fast": None,
+    }
+
+    def render():
+        return ["kmls_mfu 1", "kmls_unknown_series 2"]
+    """
+
+_DISPATCH_GOOD = """
+    def run(cm, shape):
+        cm.observe_kernel("serve_fast", 0.5, b=shape)
+
+    def mine(jm):
+        return phase_cost("mine_count", p=10, v=4)
+    """
+
+_DISPATCH_BAD = """
+    def run(cm, shape):
+        cm.observe_kernel("serve_renamed", 0.5, b=shape)
+
+    def forward(cm, kernel):
+        cm.observe_kernel(kernel, 0.1)
+    """
+
+
+def _costspec_cfg(**overrides):
+    return fixture_cfg(
+        costmodel_file="pkg/costmodel.py",
+        costspec_required=("serve_fast",),
+        metrics_file="pkg/metrics.py",
+        metric_exposition_files={"pkg/metrics.py": "serving"},
+        metric_dynamic_sources=(),
+        **overrides,
+    )
+
+
+def test_costspec_quiet_when_specs_and_sites_agree(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/costmodel.py": _COSTMODEL_GOOD,
+            "pkg/engine.py": _DISPATCH_GOOD,
+            "pkg/metrics.py": 'METRIC_REGISTRY = {"kmls_mfu": "gauge:serving"}\n',
+        },
+    )
+    result = run_fixture(tmp_path, _costspec_cfg(), ["costspec"])
+    assert keys(result, "costspec") == set()
+
+
+def test_costspec_flags_unregistered_orphan_unresolvable_and_required(
+    tmp_path,
+):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/costmodel.py": (
+                'KERNEL_COST_SPECS = {\n    "mine_count": None,\n}\n'
+            ),
+            "pkg/engine.py": _DISPATCH_BAD,
+            "pkg/metrics.py": 'METRIC_REGISTRY = {"kmls_mfu": "gauge:serving"}\n',
+        },
+    )
+    result = run_fixture(tmp_path, _costspec_cfg(), ["costspec"])
+    got = keys(result, "costspec")
+    # observed-but-unregistered kernel; spec nothing observes; variable
+    # kernel name; the required anchor gone from the registry
+    assert "unregistered:serve_renamed" in got
+    assert "orphan:mine_count" in got
+    assert any(k.startswith("unresolvable:") for k in got), got
+    assert "required-missing:serve_fast" in got
+
+
+def test_costspec_flags_series_missing_from_metric_registry(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/costmodel.py": _COSTMODEL_SERIES,
+            "pkg/engine.py": (
+                'def run(cm):\n'
+                '    cm.observe_kernel("serve_fast", 0.5)\n'
+            ),
+            "pkg/metrics.py": 'METRIC_REGISTRY = {"kmls_mfu": "gauge:serving"}\n',
+        },
+    )
+    result = run_fixture(tmp_path, _costspec_cfg(), ["costspec"])
+    got = keys(result, "costspec")
+    assert got == {"series-unregistered:kmls_unknown_series"}
+
+
+def test_costspec_missing_registry_is_one_loud_finding(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/costmodel.py": "PEAKS = {}\n",
+            "pkg/engine.py": _DISPATCH_GOOD,
+        },
+    )
+    result = run_fixture(tmp_path, _costspec_cfg(), ["costspec"])
+    assert keys(result, "costspec") == {"registry-missing"}
+
+
+def test_costspec_pragma_suppresses_forwarding_helper(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/costmodel.py": _COSTMODEL_GOOD,
+            "pkg/engine.py": (
+                'def run(cm, shape):\n'
+                '    cm.observe_kernel("serve_fast", 0.5)\n'
+                '    phase_cost("mine_count", p=1)\n'
+                'def forward(cm, kernel):\n'
+                '    # kmls-verify: allow[costspec] forwarding helper\n'
+                '    cm.observe_kernel(kernel, 0.1)\n'
+            ),
+            "pkg/metrics.py": 'METRIC_REGISTRY = {"kmls_mfu": "gauge:serving"}\n',
+        },
+    )
+    result = run_fixture(tmp_path, _costspec_cfg(), ["costspec"])
+    assert keys(result, "costspec") == set()
+    assert any(
+        f.checker == "costspec" for f in result["suppressed"]
+    ), "the forwarding site must be pragma-suppressed, not invisible"
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip + CLI gate
 # ---------------------------------------------------------------------------
 
@@ -943,6 +1081,29 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
         for surfaces in refs.values()
         for relpath, _line2, _scope in surfaces
     ), "the mining textfile exposition module fell out of the index"
+    # checker 8 anchors (ISSUE 12): the cost-spec registry parses
+    # without import, every required (dispatched jitted) kernel is
+    # registered, and the serving/mining dispatch sites are visible —
+    # a rename would otherwise hollow the checker silently
+    from kmlserver_tpu.analysis.costspec import (
+        collect_observe_sites,
+        parse_cost_specs,
+    )
+
+    specs, _reg_line = parse_cost_specs(index, cfg)
+    assert set(cfg.costspec_required) <= set(specs), (
+        set(cfg.costspec_required) - set(specs)
+    )
+    sites, unresolved = collect_observe_sites(index)
+    assert {
+        "serve_rules", "serve_sharded", "serve_native", "embed_topk",
+        "support_count", "als_sweep", "delta_recount",
+    } <= set(sites), sorted(sites)
+    assert any(
+        relpath == "kmlserver_tpu/serving/engine.py"
+        for relpath, _line3 in sites["serve_rules"]
+    ), "the engine's dispatch observation fell out of the index"
+    assert unresolved == [], unresolved
 
 
 def test_cli_exit_codes(tmp_path):
@@ -969,7 +1130,7 @@ def test_cli_exit_codes(tmp_path):
 @pytest.mark.parametrize(
     "checker",
     ["hotpath", "locks", "atomic-write", "knobs", "fault-sites",
-     "exit-codes", "metrics"],
+     "exit-codes", "metrics", "costspec"],
 )
 def test_every_checker_registered(checker):
     from kmlserver_tpu.analysis.core import all_checkers

@@ -421,6 +421,15 @@ class TestTpuSuiteWiring:
             "fleet_baseline_hit_ratio": 0.62, "fleet_multiplier": 1.31,
             "platform": "cpu",
         },
+        "costattrib": {
+            "qps": 800.0, "requests": 4000, "p50_ms": 0.6, "p99_ms": 6.9,
+            "mfu": 7.2e-05, "roofline": "bandwidth",
+            "flops_per_s": 1.44e7, "bytes_per_s": 5.1e7,
+            "device_s": 4.82, "dispatches": 4000, "compiles": 0,
+            "obs_off_delta": 0, "peak_flops": 2e11,
+            "peak_source": "auto:cpu cpu", "headroom_bytes": 12884000000,
+            "platform": "cpu",
+        },
     }
     REPLAY = {
         "target_qps": 1000.0, "achieved_qps": 1010.0, "p50_ms": 4.0,
@@ -949,7 +958,7 @@ class TestBenchStateResume:
         assert bench.run_tpu_suite(em, str(npz1)) == canned["mining"]
         banked = json.loads(Path(state_path).read_text())["phases"]
         assert set(banked) == {
-            "traceoverhead_cpu", "freshness_cpu",
+            "traceoverhead_cpu", "freshness_cpu", "costattrib_tpu",
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
@@ -1332,6 +1341,46 @@ class TestCompactLine:
         assert parsed["freshness_speedup"] == 10.93
         assert parsed["freshness_http_5xx"] == 0
         assert parsed["freshness_fleet_multiplier"] == 1.306
+
+    def test_record_costattrib_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-12 cost-attribution bracket's judged keys
+        (serve-kernel MFU ∈ (0, 1], roofline class, live compiles==0,
+        the disabled-mode zero-observation proof) must land in the
+        compact line without regressing the ≤1,800 budget."""
+        canned = {
+            "qps": 800.0, "requests": 4000,
+            "p50_ms": 0.62, "p99_ms": 6.91,
+            "mfu": 7.2158e-05, "roofline": "bandwidth",
+            "flops_per_s": 1.443e7, "bytes_per_s": 5.1e7,
+            "device_s": 4.821, "dispatches": 4000,
+            "compiles": 0, "obs_off_delta": 0,
+            "peak_flops": 2e11, "peak_source": "auto:cpu cpu",
+            "headroom_bytes": 12884000000, "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_costattrib(result)
+        assert result["costattrib_mfu"] == pytest.approx(7.216e-05)
+        assert result["costattrib_roofline"] == "bandwidth"
+        assert result["costattrib_compiles"] == 0
+        assert result["costattrib_obs_off"] == 0
+        assert result["costattrib_platform"] == "cpu"
+        # only the judged claims ride the compact line (rate/peak detail
+        # is sidecar-only, like the traceoverhead/freshness detail)
+        for key in ("costattrib_mfu", "costattrib_roofline",
+                    "costattrib_compiles", "costattrib_obs_off"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["costattrib_mfu"] == pytest.approx(7.216e-05)
+        assert parsed["costattrib_compiles"] == 0
+        assert parsed["costattrib_obs_off"] == 0
 
     def test_record_mine_resume_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-4 interruption bracket's keys must land in the

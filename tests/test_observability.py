@@ -1047,3 +1047,589 @@ class TestJobMetricsTextfile:
         jm.dataset = {"kmls_job_not_registered": 1}
         with pytest.raises(KeyError):
             jm.write()
+
+
+# ---------------------------------------------------------------------------
+# device-truth cost attribution (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+from kmlserver_tpu.observability import costmodel as costmodel_mod  # noqa: E402
+from kmlserver_tpu.observability.costmodel import (  # noqa: E402
+    KERNEL_COST_SPECS,
+    CompileWatcher,
+    CostModel,
+    classify_roofline,
+    phase_cost,
+)
+from kmlserver_tpu.observability.slo import SLOS, WINDOWS, SloTracker  # noqa: E402
+
+_GENERIC_DIMS = dict(
+    b=8, l=4, k_max=16, v=100, k_best=10, shards=2, p=50, r=8, iters=3,
+    rows=5,
+)
+
+
+class TestCostSpecs:
+    def test_every_spec_yields_positive_cost(self):
+        for name, spec in KERNEL_COST_SPECS.items():
+            flops = spec.flops(_GENERIC_DIMS)
+            moved = spec.bytes_moved(_GENERIC_DIMS)
+            assert flops > 0, name
+            assert moved > 0, name
+
+    def test_phase_cost_matches_spec_and_rejects_unknown(self):
+        flops, moved = phase_cost("support_count", p=50, v=100)
+        assert flops == 2.0 * 50 * 100 * 100
+        assert moved == 2.0 * 50 * 100 + 100 * 100 * 4.0
+        with pytest.raises(KeyError):
+            phase_cost("no_such_kernel", p=1)
+
+    def test_flops_scale_with_the_dominant_dim(self):
+        """Leading-order sanity: doubling the contraction dim doubles
+        (or quadruples, for the quadratic terms) the analytic work."""
+        base, _ = phase_cost("als_sweep", p=100, v=50, r=8, iters=2)
+        double_p, _ = phase_cost("als_sweep", p=200, v=50, r=8, iters=2)
+        assert double_p > 1.8 * base
+        sc_base, _ = phase_cost("support_count", p=100, v=50)
+        sc_double_v, _ = phase_cost("support_count", p=100, v=100)
+        assert sc_double_v > 3.5 * sc_base  # quadratic in v
+
+    def test_roofline_classification(self):
+        # intensity 100 flops/byte vs ridge 10 → compute-bound
+        assert classify_roofline(1e6, 1e4, 1e12, 1e11) == "compute"
+        # intensity 0.1 vs ridge 10 → bandwidth-bound
+        assert classify_roofline(1e3, 1e4, 1e12, 1e11) == "bandwidth"
+
+
+class TestCostModelUnit:
+    def _cm(self):
+        return CostModel(peak_flops=1e12, peak_bytes_s=1e11)
+
+    def test_observation_accumulates_and_derives_rates(self):
+        cm = self._cm()
+        cm.observe_kernel("support_count", 0.5, p=1000, v=200)
+        cm.observe_kernel("support_count", 0.5, p=1000, v=200)
+        stats = cm.kernel_stats()["support_count"]
+        assert stats["dispatches"] == 2
+        assert stats["device_s"] == pytest.approx(1.0)
+        expect_flops = 2 * (2.0 * 1000 * 200 * 200)
+        assert stats["flops"] == pytest.approx(expect_flops)
+        assert stats["flops_per_s"] == pytest.approx(expect_flops / 1.0)
+        assert 0.0 < stats["mfu"] <= 1.0
+        assert stats["roofline"] in ("compute", "bandwidth")
+
+    def test_mfu_is_capped_at_one(self):
+        cm = CostModel(peak_flops=1.0, peak_bytes_s=1.0)  # absurdly low
+        cm.observe_kernel("support_count", 0.001, p=10_000, v=1000)
+        assert cm.kernel_stats()["support_count"]["mfu"] == 1.0
+
+    def test_unspecced_kernel_is_counted_not_fatal(self):
+        """A drifted kernel name must never 500 the serving path: the
+        dispatch is recorded with zero flops and counted loudly (the
+        costspec checker catches the drift statically in CI)."""
+        cm = self._cm()
+        cm.observe_kernel("kernel_from_the_future", 0.1, b=1)
+        assert cm.unspecced == {"kernel_from_the_future": 1}
+        stats = cm.kernel_stats()["kernel_from_the_future"]
+        assert stats["flops"] == 0.0 and stats["device_s"] > 0
+        text = "\n".join(cm.render_lines())
+        assert "kmls_costmodel_unspecced_total 1" in text
+
+    def test_compile_watcher_counts_growth_only_after_publish(self):
+        class FakeJit:
+            def __init__(self):
+                self.size = 3  # pre-existing compiles: never billed
+
+            def _cache_size(self):
+                return self.size
+
+        fn = FakeJit()
+        watcher = CompileWatcher()
+        watcher.watch("serve_rules", fn)
+        fn.size += 2  # warmup compiles during publication
+        watcher.mark_published()
+        assert watcher.compiles() == {"serve_rules": 0}
+        fn.size += 1  # a compile ON the serving path
+        assert watcher.compiles() == {"serve_rules": 1}
+        # a re-publication: note_prepublish banks the live compile (the
+        # counter stays monotonic), then the new warmup is absorbed
+        watcher.note_prepublish()
+        fn.size += 4  # the re-publication's warmup
+        watcher.mark_published()
+        assert watcher.compiles() == {"serve_rules": 1}
+        fn.size += 2  # serving-path compiles against the new generation
+        assert watcher.compiles() == {"serve_rules": 3}
+
+    def test_note_publish_headroom_accounting(self):
+        cm = self._cm()
+        cm.note_publish(
+            {"rule_ids": 600, "rule_confs": 600}, budget_bytes=1000,
+            n_shards=4, watermark_bytes=77,
+        )
+        assert cm.per_device_tensor_bytes() == 300
+        assert cm.headroom_bytes() == 700
+        text = "\n".join(cm.render_lines())
+        assert 'kmls_model_tensor_bytes{artifact="rule_ids"} 600' in text
+        assert "kmls_device_budget_bytes 1000" in text
+        assert "kmls_device_headroom_bytes 700" in text
+        assert "kmls_publish_watermark_bytes 77" in text
+
+    def test_peak_resolution_env_override(self, monkeypatch):
+        monkeypatch.setenv("KMLS_PEAK_FLOPS", "5e13")
+        monkeypatch.setenv("KMLS_PEAK_BYTES_PER_S", "2e12")
+        flops, bw, source = costmodel_mod.resolve_peaks()
+        assert flops == 5e13 and bw == 2e12 and source == "env"
+
+    def test_partial_peak_override_names_both_origins(self, monkeypatch):
+        """One knob set, one from the table: the provenance label must
+        say so — 'env' alone would claim a calibration nobody did."""
+        monkeypatch.setenv("KMLS_PEAK_FLOPS", "5e13")
+        monkeypatch.delenv("KMLS_PEAK_BYTES_PER_S", raising=False)
+        flops, bw, source = costmodel_mod.resolve_peaks()
+        assert flops == 5e13 and bw > 0
+        assert source.startswith("env+auto"), source
+        cm = CostModel(peak_flops=5e13)
+        assert cm.peak_source.startswith("explicit+"), cm.peak_source
+        assert cm.peak_bytes_s > 0
+
+
+class TestCostAttributionLive:
+    """The tentpole, end to end on the real serving stack: jitted serve
+    kernel + cost model + /metrics exposition."""
+
+    def _app(self, cfg, **over):
+        app = RecommendApp(
+            dataclasses.replace(
+                cfg, cache_enabled=False, native_serve=False, **over
+            )
+        )
+        assert app.engine.load()
+        return app
+
+    def test_mfu_roofline_and_zero_compiles_on_replayed_traffic(
+        self, mined_pvc
+    ):
+        cfg, _, _ = mined_pvc
+        app = self._app(cfg)
+        seeds = _rule_seeds(cfg)
+        for s in seeds[:12]:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        cm = app.engine.cost_model
+        summary = cm.summary()
+        serve = summary["kernels"]["serve_rules"]
+        assert serve["dispatches"] > 0
+        assert serve["device_s"] > 0
+        assert 0.0 < serve["mfu"] <= 1.0
+        assert serve["roofline"] in ("compute", "bandwidth")
+        # the live zero-compiles-post-publish invariant
+        assert summary["compiles_post_publish"].get("serve_rules") == 0
+        assert summary["unspecced"] == {}
+        # memory accounting: the layout decision's inputs are exported
+        assert summary["tensor_bytes"]["rule_ids"] > 0
+        assert summary["budget_bytes"] == cfg.device_budget_bytes
+        status, _, payload = app.handle("GET", "/metrics", None)
+        types, _ = parse_exposition(payload.decode())
+        for required in (
+            "kmls_kernel_device_seconds", "kmls_kernel_dispatches_total",
+            "kmls_mfu", "kmls_kernel_compute_bound", "kmls_compiles_total",
+            "kmls_model_tensor_bytes", "kmls_device_headroom_bytes",
+            "kmls_costmodel_observations_total",
+        ):
+            assert required in types, required
+        for name, mtype in types.items():
+            assert METRIC_REGISTRY[name].split(":", 1)[0] == mtype, name
+
+    def test_cost_device_seconds_agree_with_pr9_histogram(self, mined_pvc):
+        """Satellite pin: the cost model's per-kernel fenced device
+        seconds and the PR 9 kmls_device_seconds histogram measure the
+        same dispatches with the same fence semantics — on a sequential
+        replay (every batch is one request) their totals must agree to
+        within the batcher's extra span (staging fill before dispatch,
+        compose after fence). Wide bounds: this pins the RELATIONSHIP,
+        not this host's scheduler."""
+        cfg, _, _ = mined_pvc
+        app = self._app(cfg)
+        seeds = _rule_seeds(cfg)
+        for _ in range(3):
+            for s in seeds[:8]:
+                status, _, _ = _post(app, [s])
+                assert status == 200
+        cost_s = app.engine.cost_model.kernel_stats()["serve_rules"][
+            "device_s"
+        ]
+        _, hist_sum, hist_n = app.metrics.device_hist.snapshot()
+        assert hist_n > 0 and cost_s > 0
+        # the engine's fence closes BEFORE the batcher's (conversion vs
+        # finish-return + compose), so cost_s <= hist_sum modulo clock
+        # jitter; and it must be the same order of magnitude
+        assert cost_s <= hist_sum * 1.25 + 0.005, (cost_s, hist_sum)
+        assert cost_s >= hist_sum * 0.05 - 0.005, (cost_s, hist_sum)
+
+    def test_embed_kernel_observed_when_hybrid_active(self, tmp_path):
+        from kmlserver_tpu.data.csv import write_tracks_csv
+        from kmlserver_tpu.data.synthetic import synthetic_table
+
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        write_tracks_csv(
+            str(ds_dir / "2023_spotify_ds1.csv"),
+            synthetic_table(
+                n_playlists=80, n_tracks=60, target_rows=2400, seed=11
+            ),
+        )
+        mcfg = MiningConfig(
+            base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+            min_support=0.05, embed_enabled=True, als_rank=8, als_iters=2,
+        )
+        run_mining_job(mcfg)
+        cfg = dataclasses.replace(
+            ServingConfig.from_env(None), base_dir=str(tmp_path),
+            cache_enabled=False, native_serve=False,
+        )
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        assert app.engine.embedding_active
+        for s in app.engine.bundle.vocab[:6]:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        stats = app.engine.cost_model.kernel_stats()
+        assert stats["embed_topk"]["dispatches"] > 0
+        assert 0.0 < stats["embed_topk"]["mfu"] <= 1.0
+        compiles = app.engine.cost_model.compiles_post_publish()
+        assert compiles.get("embed_topk") == 0
+
+
+class TestCostModelZeroCostWhenDisabled:
+    def test_observation_counter_never_moves_with_costmodel_off(
+        self, mined_pvc
+    ):
+        """Began-counter discipline (the ISSUE 12 acceptance proof): with
+        KMLS_COSTMODEL=0 the engine holds no CostModel, and real traffic
+        must not move the module-level observation counter — nor render
+        any cost series."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(
+                cfg, cache_enabled=False, costmodel_enabled=False
+            )
+        )
+        assert app.engine.load()
+        assert app.engine.cost_model is None
+        before = costmodel_mod.OBSERVATIONS_TOTAL
+        for s in _rule_seeds(cfg)[:6]:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        assert costmodel_mod.OBSERVATIONS_TOTAL == before
+        status, _, payload = app.handle("GET", "/metrics", None)
+        text = payload.decode()
+        assert "kmls_mfu" not in text
+        assert "kmls_kernel_device_seconds" not in text
+        parse_exposition(text)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSloTracker:
+    def _tracker(self, metrics, clock, **over):
+        kwargs = dict(
+            p99_target_ms=25.0, error_budget=0.001, degrade_budget=0.01,
+            fast_window_s=300.0, slow_window_s=3600.0, clock=clock,
+        )
+        kwargs.update(over)
+        return SloTracker(metrics, **kwargs)
+
+    def test_idle_pod_burns_nothing(self):
+        clock = _FakeClock()
+        slo = self._tracker(ServingMetrics(), clock)
+        rates = slo.burn_rates()
+        for s in SLOS:
+            for w in WINDOWS:
+                assert rates[s][w] == 0.0
+
+    def test_error_burst_burns_fast_then_slow_remembers(self):
+        clock = _FakeClock()
+        metrics = ServingMetrics()
+        slo = self._tracker(metrics, clock)
+        slo.burn_rates()  # baseline sample at t=1000, all zeros
+        for _ in range(990):
+            metrics.record("rules", 0.001)
+        for _ in range(10):
+            metrics.record_error()
+        clock.t += 60
+        rates = slo.burn_rates()
+        # 10 bad / 1000 attempts = 1% over a 0.1% budget → burn ~10x
+        assert rates["availability"]["fast"] == pytest.approx(10.0, rel=0.05)
+        assert rates["availability"]["slow"] == pytest.approx(10.0, rel=0.05)
+        # the burst stops; past the fast window the fast burn clears
+        # while the slow window still remembers it
+        for step in range(6):
+            clock.t += 60
+            slo.burn_rates()  # periodic scrape keeps samples flowing
+        clock.t += 60  # now > 300s past the errors
+        rates = slo.burn_rates()
+        assert rates["availability"]["fast"] == 0.0
+        assert rates["availability"]["slow"] > 1.0
+
+    def test_latency_burn_reads_the_e2e_histogram(self):
+        clock = _FakeClock()
+        metrics = ServingMetrics()
+        slo = self._tracker(metrics, clock)
+        slo.burn_rates()
+        # 100 requests, 5 of them slower than the 25 ms target → 5% bad
+        # over the 1% budget → burn 5
+        for _ in range(95):
+            metrics.record_attribution(0.0, 0.001, 0.002)
+        for _ in range(5):
+            metrics.record_attribution(0.0, 0.04, 0.05)
+        clock.t += 60
+        rates = slo.burn_rates()
+        assert rates["latency_p99"]["fast"] == pytest.approx(5.0, rel=0.05)
+
+    def test_degraded_answers_burn_the_quality_budget(self):
+        clock = _FakeClock()
+        metrics = ServingMetrics()
+        slo = self._tracker(metrics, clock)
+        slo.burn_rates()
+        for _ in range(96):
+            metrics.record("rules", 0.001)
+        for _ in range(4):
+            metrics.record_degraded("overload")
+            metrics.record("fallback", 0.001)
+        clock.t += 60
+        rates = slo.burn_rates()
+        # 4 degraded / 100 attempts over a 1% budget → burn ~4
+        assert rates["quality"]["fast"] == pytest.approx(4.0, rel=0.05)
+
+    def test_latency_target_snaps_up_to_a_bucket_boundary(self):
+        slo = self._tracker(
+            ServingMetrics(), _FakeClock(), p99_target_ms=30.0
+        )
+        assert slo.latency_boundary_s == 0.05  # next boundary above 30ms
+
+    def test_render_always_emits_all_six_series(self):
+        slo = self._tracker(ServingMetrics(), _FakeClock())
+        lines = slo.render_lines()
+        assert lines[0] == "# TYPE kmls_slo_burn_rate gauge"
+        assert len(lines) == 1 + len(SLOS) * len(WINDOWS)
+        for s in SLOS:
+            for w in WINDOWS:
+                assert any(
+                    line.startswith(
+                        f'kmls_slo_burn_rate{{slo="{s}",window="{w}"}}'
+                    )
+                    for line in lines
+                ), (s, w)
+
+    def test_debug_endpoint_payload_shape(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        status, _, payload = app.handle("GET", "/debug/slo", None)
+        assert status == 200
+        body = json.loads(payload)
+        assert set(body["burn_rates"]) == set(SLOS)
+        assert body["targets"]["latency_p99"]["target_ms"] == cfg.slo_p99_ms
+        assert body["windows_s"]["fast"] == cfg.slo_fast_window_s
+
+
+# ---------------------------------------------------------------------------
+# shared loopback guard (ISSUE 12 satellite) — one helper, four endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestLoopbackGuard:
+    ENDPOINTS = (
+        ("POST", "/metrics/reset"),
+        ("GET", "/debug/traces"),
+        ("GET", "/debug/slo"),
+        ("GET", "/debug/profile?seconds=1"),
+    )
+
+    @pytest.fixture()
+    def app(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        return app
+
+    @pytest.mark.parametrize("method,path", ENDPOINTS)
+    def test_non_loopback_client_gets_403(self, app, method, path):
+        status, _, payload = app.handle(
+            method, path, None, client_host="10.1.2.3"
+        )
+        assert status == 403
+        assert b"localhost only" in payload
+
+    @pytest.mark.parametrize("method,path", ENDPOINTS)
+    @pytest.mark.parametrize(
+        "host", [None, "127.0.0.1", "::1", "::ffff:127.0.0.1"]
+    )
+    def test_loopback_forms_pass_the_guard(self, app, method, path, host):
+        status, _, _ = app.handle(method, path, None, client_host=host)
+        assert status != 403
+
+    def test_helper_is_the_single_copy(self):
+        from kmlserver_tpu.serving.app import is_loopback_host
+
+        assert is_loopback_host(None)
+        assert is_loopback_host("127.0.0.1")
+        assert is_loopback_host("::1")
+        assert is_loopback_host("::ffff:127.0.0.1")
+        assert not is_loopback_host("192.168.0.7")
+        assert not is_loopback_host("::ffff:192.168.0.7")
+
+
+# ---------------------------------------------------------------------------
+# per-artifact freshness age (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestArtifactAges:
+    def test_readyz_and_gauge_report_ages(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        status, _, payload = app.handle("GET", "/readyz", None)
+        assert status == 200
+        body = json.loads(payload)
+        ages = body["artifact_age_seconds"]
+        for artifact in ("rules", "popularity", "delta-chain"):
+            assert artifact in ages, ages
+            assert ages[artifact] >= 0.0
+        # no embeddings published → no embeddings age (absent, not 0 —
+        # a zero would claim freshness for an artifact that isn't there)
+        assert "embeddings" not in ages
+        status, _, payload = app.handle("GET", "/metrics", None)
+        text = payload.decode()
+        assert 'kmls_artifact_age_seconds{artifact="rules"}' in text
+        assert 'kmls_artifact_age_seconds{artifact="popularity"}' in text
+
+    def test_ages_empty_before_first_load(self, tmp_path):
+        cfg = dataclasses.replace(
+            ServingConfig.from_env(None), base_dir=str(tmp_path)
+        )
+        app = RecommendApp(cfg)
+        assert app.engine.artifact_ages() == {}
+        status, _, payload = app.handle("GET", "/metrics", None)
+        assert b"kmls_artifact_age_seconds" not in payload
+
+    def test_delta_chain_age_equals_rules_until_a_delta_applies(
+        self, mined_pvc
+    ):
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        ages = app.engine.artifact_ages()
+        assert ages["delta-chain"] == pytest.approx(ages["rules"], abs=0.5)
+
+
+# ---------------------------------------------------------------------------
+# on-demand profile capture (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+class TestDebugProfile:
+    def test_refused_without_profile_dir(self, mined_pvc, monkeypatch):
+        monkeypatch.delenv("KMLS_PROFILE_DIR", raising=False)
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        status, _, payload = app.handle(
+            "GET", "/debug/profile?seconds=1", None
+        )
+        assert status == 409
+        assert b"KMLS_PROFILE_DIR" in payload
+
+    def test_capture_runs_and_dumps_a_trace(
+        self, mined_pvc, monkeypatch, tmp_path
+    ):
+        cfg, _, _ = mined_pvc
+        target = tmp_path / "profiles"
+        target.mkdir()
+        monkeypatch.setenv("KMLS_PROFILE_DIR", str(target))
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        status, _, payload = app.handle(
+            "GET", "/debug/profile?seconds=0.1", None
+        )
+        assert status == 202, payload
+        body = json.loads(payload)
+        assert body["status"] == "capturing"
+        assert body["seconds"] == pytest.approx(0.1)
+        # a second capture while one runs is refused
+        status2, _, payload2 = app.handle(
+            "GET", "/debug/profile?seconds=0.1", None
+        )
+        assert status2 == 409 or not app._profile_thread.is_alive()
+        app._profile_thread.join(timeout=30)
+        assert not app._profile_thread.is_alive()
+        assert os.path.isdir(body["dir"])
+
+    def test_bad_seconds_is_422(self, mined_pvc, monkeypatch, tmp_path):
+        monkeypatch.setenv("KMLS_PROFILE_DIR", str(tmp_path))
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        status, _, _ = app.handle(
+            "GET", "/debug/profile?seconds=banana", None
+        )
+        assert status == 422
+
+
+class TestJobPhaseCostTelemetry:
+    """ISSUE 12: per-phase analytic FLOPs/bytes attribution in the
+    mining textfile — same formulas as the serving MFU."""
+
+    def test_phase_cost_series_render_valid_and_mining_scoped(
+        self, tmp_path
+    ):
+        jm = JobMetrics(str(tmp_path))
+        jm.phase_done("mine", 2.0)
+        flops, moved = phase_cost("support_count", p=2246, v=2171)
+        jm.note_phase_cost("mine", flops, moved)
+        jm.finish(True)
+        text = jm.render()
+        types, samples = parse_exposition(text)
+        assert types["kmls_job_phase_flops"] == "gauge"
+        assert types["kmls_job_phase_bytes_moved"] == "gauge"
+        assert 'kmls_job_phase_flops{phase="mine"}' in text
+        for name in ("kmls_job_phase_flops", "kmls_job_phase_bytes_moved"):
+            declared_type, _, scope = METRIC_REGISTRY[name].partition(":")
+            assert types[name] == declared_type and scope == "mining"
+
+    def test_real_mining_run_attributes_the_mine_phase(self, tmp_path):
+        from kmlserver_tpu.data.csv import write_tracks_csv
+        from kmlserver_tpu.data.synthetic import synthetic_table
+
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        write_tracks_csv(
+            str(ds_dir / "2023_spotify_ds1.csv"),
+            synthetic_table(
+                n_playlists=60, n_tracks=50, target_rows=1500, seed=5
+            ),
+        )
+        run_mining_job(
+            MiningConfig(
+                base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+                min_support=0.05,
+            )
+        )
+        prom = (tmp_path / "pickles" / JOB_METRICS_FILENAME).read_text()
+        parse_exposition(prom)
+        assert 'kmls_job_phase_flops{phase="mine"}' in prom
+        assert 'kmls_job_phase_bytes_moved{phase="mine"}' in prom
+        # the attributed work is positive and plausibly 2·p·v² shaped
+        for line in prom.splitlines():
+            if line.startswith('kmls_job_phase_flops{phase="mine"}'):
+                assert float(line.rsplit(" ", 1)[1]) > 0
