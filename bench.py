@@ -380,6 +380,15 @@ _COMPACT_PRIORITY = (
     # detail is sidecar-only, the compact line sits at its budget
     "freshness_speedup", "freshness_http_5xx", "freshness_errors",
     "freshness_publish_to_applied_ms", "freshness_fleet_multiplier",
+    # judged sparsity-adaptive claims (ISSUE 13): ≥5x over the native
+    # record path on the SAME ≥99%-sparse workload (density carries the
+    # ≥99% part), every route bit-identical, and the auto dispatch
+    # resolving from the measured table — ranked with the freshness/
+    # costattrib blocks below the TPU serving evidence (CPU-measured by
+    # construction); rows/s, shape and table detail are sidecar-only
+    "sparse_speedup_vs_native", "sparse_identical",
+    "sparse_headline_identical", "sparse_density",
+    "sparse_auto_path", "sparse_auto_source",
     # judged cost-attribution claims (ISSUE 12): serve-kernel MFU +
     # roofline class (the ROADMAP TPU-window headline shape, CPU-labeled
     # until a window lands), live compiles==0 post-publish, and the
@@ -2523,6 +2532,123 @@ print(json.dumps({
 }))
 """
 
+# the sparsity-adaptive bracket (ISSUE 13): the sparse CSR×bitpacked
+# hybrid vs the standing scale_cpu_native record-holder ON THE SAME
+# ≥99%-sparse workload (same prune, same emission contract, tensors
+# asserted bit-identical) — plus a dense/bitpack/sparse identity leg at
+# a bounded sub-shape and the density sweep that re-measures and
+# re-banks the dispatch lookup table the auto path consults.
+_SCALE_SPARSE_BENCH = r"""
+import dataclasses, json, os, socket, sys, time
+import numpy as np
+import jax
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.synthetic import synthetic_baskets
+from kmlserver_tpu.mining import dispatch as dispatch_mod
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.mining.sweep import run_density_sweep
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+P_N = int(os.environ.get("KMLS_BENCH_SPARSE_PLAYLISTS", "1500000"))
+V_N = int(os.environ.get("KMLS_BENCH_SPARSE_TRACKS", "40000"))
+ROWS = int(os.environ.get("KMLS_BENCH_SPARSE_ROWS", "6000000"))
+out = {}
+
+def same_tensors(a, b):
+    return bool(
+        np.array_equal(a.rule_ids, b.rule_ids)
+        and np.array_equal(a.rule_counts, b.rule_counts)
+        and np.array_equal(a.item_counts, b.item_counts)
+    )
+
+# ---- identity leg: all four routes on a bounded sub-shape (small
+# enough that the forced DENSE leg stays cheap on a 2-core CI runner) --
+small = synthetic_baskets(
+    n_playlists=8000, n_tracks=1200, target_rows=80000, seed=13
+)
+base_cfg = dataclasses.replace(
+    MiningConfig.from_env(dotenv_path=None),
+    min_support=0.001, k_max_consequents=64,
+)
+legs = {}
+for name, kw in (
+    ("sparse", dict(count_path="sparse")),
+    ("dense", dict(count_path="dense", native_cpu_pair_counts=False)),
+    ("bitpack", dict(count_path="bitpack")),
+    ("native", dict(count_path="dense")),
+):
+    legs[name] = mine(small, dataclasses.replace(base_cfg, **kw)).tensors
+out["identical"] = all(
+    same_tensors(legs["sparse"], t) for t in legs.values()
+)
+print(json.dumps(out), flush=True)  # checkpoint
+
+# ---- the headline: sparse vs the native record path, SAME workload ----
+baskets = synthetic_baskets(
+    n_playlists=P_N, n_tracks=V_N, target_rows=ROWS, seed=7
+)
+rows = len(baskets.playlist_rows)
+cfg = dataclasses.replace(
+    MiningConfig.from_env(dotenv_path=None),
+    min_support=8.0 / P_N, k_max_consequents=64,
+)
+plan = dispatch_mod.plan_count_path(
+    cfg, P_N, V_N, rows, backend=jax.default_backend(), baskets=baskets
+)
+# control probe: a dense-regime workload (5% density, toy size) must
+# keep resolving to the dense family — the dispatch smoke pins both
+# directions of the decision
+plan_dense = dispatch_mod.plan_count_path(
+    base_cfg, 4000, 1000, 200000, backend=jax.default_backend()
+)
+out.update({
+    "shape": f"{P_N}x{V_N}",
+    "rows": rows,
+    "density": round(rows / (P_N * float(V_N)), 8),
+    "auto_path": plan.path,
+    "auto_source": plan.source,
+    "auto_path_dense_regime": plan_dense.path,
+    "table_cell": plan.cell,
+})
+r_sparse = mine(baskets, dataclasses.replace(cfg, count_path="sparse"))
+out["sparse_mine_s"] = round(r_sparse.duration_s, 3)
+out["sparse_rows_per_s"] = round(rows / r_sparse.duration_s, 1)
+out["count_path"] = r_sparse.count_path
+out["frequent_items"] = r_sparse.tensors.n_frequent_items
+out["platform"] = dev.platform
+print(json.dumps(out), flush=True)  # checkpoint before the slow leg
+r_native = mine(baskets, dataclasses.replace(cfg, count_path="dense"))
+out["native_mine_s"] = round(r_native.duration_s, 3)
+out["native_rows_per_s"] = round(rows / r_native.duration_s, 1)
+out["native_count_path"] = r_native.count_path
+out["speedup_vs_native"] = round(
+    r_native.duration_s / r_sparse.duration_s, 2
+)
+out["headline_identical"] = same_tensors(
+    r_sparse.tensors, r_native.tensors
+)
+print(json.dumps(out), flush=True)  # checkpoint before the sweep
+
+# ---- density axis: re-measure + re-bank the dispatch lookup table ----
+records = run_density_sweep(
+    max_rows=min(4_000_000, max(ROWS // 2, 20000))
+)
+table = dispatch_mod.table_from_records(
+    records, jax.default_backend(),
+    measured_on=f"{socket.gethostname()}/{dev.device_kind}",
+    banked_at=time.time(),
+    base=dispatch_mod.load_table(),
+)
+dispatch_mod.save_table(dispatch_mod.builtin_table_path(), table)
+out["table_points"] = len(records)
+out["table_cells"] = len(
+    table["backends"][jax.default_backend()]["cells"]
+)
+out["sweep_identical"] = all(r["identical"] for r in records)
+print(json.dumps(out))
+"""
+
 
 # every phase script prints "device: ..." to stderr right after backend
 # init; on TPU, not seeing it within this grace period means the backend
@@ -3390,6 +3516,13 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         _record_freshness(result, bank="freshness_cpu", budget_s=200)
         em.checkpoint()
 
+    # sparsity-adaptive bracket (ISSUE 13): CPU-measured by construction
+    # (the native comparison IS a CPU kernel) — the ≥5x-at-≥99%-sparsity
+    # and bit-identity evidence must ride the TPU artifact too
+    if "sparse_speedup_vs_native" not in result:
+        _record_scale_sparse(result, bank="scale_sparse_cpu", budget_s=240)
+        em.checkpoint()
+
     # cost-attribution bracket (ISSUE 12): unlike the CPU-by-construction
     # siblings above, this phase runs ON the chip (platform="tpu" → the
     # phase subprocess sees the TPU), so a window measures serve-kernel
@@ -3557,6 +3690,12 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
             if "auto_mine_s" in scale_n:
                 result["scale_cpu_native_auto_mine_s"] = scale_n["auto_mine_s"]
                 result["scale_cpu_native_auto_path"] = scale_n["auto_path"]
+        em.checkpoint()
+
+    if _remaining() > 240:
+        # sparsity-adaptive bracket (ISSUE 13): sparse-vs-native on one
+        # ≥99%-sparse workload + identity leg + dispatch-table re-bank
+        _record_scale_sparse(result)
         em.checkpoint()
     return mining
 
@@ -4149,6 +4288,58 @@ def _record_scale_shard(
         ("rules_emitted", "scale_shard_rules"),
         ("frequent_items", "scale_shard_frequent_items"),
         ("platform", "scale_shard_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_scale_sparse(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The sparsity-adaptive bracket (ISSUE 13): at ≥99% sparsity the
+    sparse CSR×bitpacked hybrid must beat the standing
+    ``scale_cpu_native`` record path ≥5x ON THE SAME workload with
+    bit-identical tensors, the dense/bitpack/sparse identity leg must
+    agree, and the density sweep re-banks the measured dispatch table
+    the auto path consults."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "scale-sparse", _SCALE_SPARSE_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    if "speedup_vs_native" in res:
+        log(
+            f"scale-sparse: {res['shape']} at density {res['density']:.6f} "
+            f"mined in {res['sparse_mine_s']:.2f}s "
+            f"({res['sparse_rows_per_s']:.0f} rows/s, "
+            f"{res['count_path']}) vs {res['native_mine_s']:.2f}s "
+            f"{res['native_count_path']} — {res['speedup_vs_native']:.1f}x, "
+            f"identical={res.get('headline_identical')}; auto dispatch "
+            f"-> {res['auto_path']} ({res['auto_source']})"
+        )
+    for src, dst in (
+        ("sparse_mine_s", "sparse_mine_s"),
+        ("sparse_rows_per_s", "sparse_rows_per_s"),
+        ("native_mine_s", "sparse_native_mine_s"),
+        ("native_rows_per_s", "sparse_native_rows_per_s"),
+        ("speedup_vs_native", "sparse_speedup_vs_native"),
+        ("identical", "sparse_identical"),
+        ("headline_identical", "sparse_headline_identical"),
+        ("density", "sparse_density"),
+        ("shape", "sparse_shape"),
+        ("count_path", "sparse_count_path"),
+        ("auto_path", "sparse_auto_path"),
+        ("auto_source", "sparse_auto_source"),
+        ("table_cells", "sparse_table_cells"),
+        ("sweep_identical", "sparse_sweep_identical"),
+        ("frequent_items", "sparse_frequent_items"),
+        ("platform", "sparse_platform"),
     ):
         if src in res and res[src] is not None:
             val = res[src]

@@ -180,6 +180,13 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_MESH_SHAPE": "mining",
     "KMLS_BITPACK_THRESHOLD_ELEMS": "mining",
     "KMLS_BITPACK_IMPL": "mining",
+    # sparsity-adaptive dispatch (ISSUE 13): pin a count family
+    # (dense/bitpack/sparse; anything else fails safe to the measured
+    # auto), point at an alternative measured dispatch table, and set
+    # the hybrid's long-basket split point
+    "KMLS_COUNT_PATH": "mining",
+    "KMLS_DISPATCH_TABLE": "mining",
+    "KMLS_SPARSE_LONG_BASKET": "mining",
     "KMLS_HBM_BUDGET_BYTES": "mining",
     "KMLS_SHARDED_IMPL": "mining",
     "KMLS_PRUNE_VOCAB_THRESHOLD": "mining",
@@ -204,6 +211,9 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_ALS_RANK": "mining",
     "KMLS_ALS_ITERS": "mining",
     "KMLS_ALS_REG": "mining",
+    # sparse ALS storage (ISSUE 13): auto = compressed interaction matrix
+    # exactly when the dense one busts the HBM guard; always/never pin it
+    "KMLS_ALS_SPARSE": "mining",
     # --- mining: telemetry (ISSUE 9) ---
     # write pickles/job_metrics.prom (textfile-exporter format) as phases
     # complete, so a fleet's Prometheus sees mining progress
@@ -279,6 +289,11 @@ KNOB_REGISTRY: dict[str, str] = {
     # mid-delta zero-5xx replay bracket
     "KMLS_BENCH_FRESHNESS_QPS": "tool",
     "KMLS_BENCH_FRESHNESS_REQUESTS": "tool",
+    # sparsity-adaptive phase (ISSUE 13): the ≥99%-sparse headline
+    # workload's shape (CI smoke shrinks it)
+    "KMLS_BENCH_SPARSE_PLAYLISTS": "tool",
+    "KMLS_BENCH_SPARSE_TRACKS": "tool",
+    "KMLS_BENCH_SPARSE_ROWS": "tool",
     "KMLS_SWEEP_START": "tool",
     "KMLS_SWEEP_STOP": "tool",
     "KMLS_SWEEP_STEP": "tool",
@@ -356,6 +371,20 @@ class MiningConfig:
     # HBM the mining job may plan against for the auto dispatch. Default
     # leaves ~4 GiB of a v5e's 16 GiB for XLA workspace/fusion copies.
     hbm_budget_bytes: int = 12 * (1 << 30)
+    # Sparsity-adaptive dispatch (mining/dispatch.py): "auto" (default)
+    # resolves dense/bitpack/sparse from the MEASURED per-backend lookup
+    # table (bench-banked; legacy heuristic when no cell matches);
+    # "dense"/"bitpack"/"sparse" pin a family; any other spelling fails
+    # SAFE to auto with a loud warning.
+    count_path: str = "auto"
+    # Alternative measured dispatch table (JSON; see
+    # mining/dispatch_table.json for the banked shape). Empty = the
+    # packaged bench-banked table.
+    dispatch_table: str = ""
+    # Baskets longer than this leave the sparse path's CSR pair
+    # expansion for the gathered bitpacked/dense sub-count (the
+    # quadratic-per-basket guard). 0 = the ops/sparse.py default (256).
+    sparse_long_basket: int = 0
     # Sharded dense pair-count implementation: "gspmd" (annotate + let XLA
     # partition), "allgather" (explicit shard_map), "ring" (ppermute
     # neighbor exchange; lowest peak memory).
@@ -409,6 +438,14 @@ class MiningConfig:
     als_iters: int = 8
     # L2 regularization λ on both factor matrices.
     als_reg: float = 0.1
+    # Interaction-matrix storage for the ALS half-sweeps (mining/als.py):
+    # "auto" = dense while the dense f32 matrix fits the HBM guard,
+    # compressed (indices-only, nnz-proportional) exactly when it does
+    # not — the case that previously SKIPPED the embed phase; "always" /
+    # "never" pin it. Sparse factors are float-different from dense ones
+    # (accumulation order), so this knob joins the checkpoint
+    # fingerprint like model_layout did.
+    als_sparse: str = "auto"
 
     # --- continuous freshness (ISSUE 10) ---
     # Incremental delta mining: after a full publication the pipeline
@@ -512,6 +549,9 @@ class MiningConfig:
             min_confidence=_getenv_float("KMLS_MIN_CONFIDENCE", 0.04),
             mesh_shape=os.getenv("KMLS_MESH_SHAPE", "auto"),
             bitpack_threshold_elems=_getenv_bitpack_threshold(),
+            count_path=os.getenv("KMLS_COUNT_PATH", "auto"),
+            dispatch_table=os.getenv("KMLS_DISPATCH_TABLE", ""),
+            sparse_long_basket=_getenv_int("KMLS_SPARSE_LONG_BASKET", 0),
             hbm_budget_bytes=_getenv_int("KMLS_HBM_BUDGET_BYTES", 12 * (1 << 30)),
             sharded_impl=os.getenv("KMLS_SHARDED_IMPL", "gspmd"),
             model_layout=_getenv_model_layout(),
@@ -524,6 +564,7 @@ class MiningConfig:
             als_rank=_getenv_int("KMLS_ALS_RANK", 32),
             als_iters=_getenv_int("KMLS_ALS_ITERS", 8),
             als_reg=_getenv_float("KMLS_ALS_REG", 0.1),
+            als_sparse=os.getenv("KMLS_ALS_SPARSE", "auto"),
             delta_enabled=_getenv_bool("KMLS_DELTA_ENABLED", False),
             delta_max_chain=_getenv_int("KMLS_DELTA_MAX_CHAIN", 16),
             job_metrics=_getenv_bool("KMLS_JOB_METRICS", True),

@@ -257,7 +257,7 @@ def device_synthetic_bitset(
     if mesh is not None:
         from ..parallel.mesh import AXIS_DP, round_up
 
-        w_pad = round_up(w_pad, mesh.shape[AXIS_DP] * pc.WORD_CHUNK)
+        w_pad = round_up(w_pad, mesh.shape[AXIS_DP] * pc.word_chunk())
         bitset = sharded_bitset_from_probs(
             jnp.asarray(q_padded), seed, mesh, n_playlists=n_playlists,
             v_pad=v_pad, w_pad=w_pad, row_block=row_block,

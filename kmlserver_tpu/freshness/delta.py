@@ -634,8 +634,23 @@ def run_delta_job(cfg: MiningConfig, mesh=None) -> DeltaResult:
         use_mesh = mesh is not None and layout_mod.wants_sharded_mining(
             cfg, mesh
         )
+        # same measured dispatcher as the full mine (mining/dispatch.py):
+        # a sparse-eligible delta must not silently pay the dense
+        # recount. Sparse restricted counts are integer-exact, so the
+        # base ∘ chain == full-re-mine bit-identity pin holds with the
+        # sparse path on (tests/test_freshness.py).
+        from ..mining import dispatch as dispatch_mod
+
+        plan = dispatch_mod.plan_count_path(
+            cfg, mined.n_playlists, mined.n_tracks,
+            len(mined.playlist_rows),
+            backend=jax.default_backend(),
+            n_devices=mesh.devices.size if use_mesh else 1,
+            baskets=mined,
+        )
         counts_r = restricted_pair_counts(
-            mined, r_ids, mesh=mesh if use_mesh else None
+            mined, r_ids, mesh=mesh if use_mesh else None,
+            count_path="sparse" if plan.path == "sparse" else None,
         )
         rule_ids, rule_counts, item_counts = emit_rule_rows_np(
             counts_r, r_ids.astype(np.int64), new_min, cfg.k_max_consequents

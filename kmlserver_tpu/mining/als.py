@@ -43,6 +43,16 @@ exactly the shape ALX shards across TPU pods. Two layouts:
   joined the checkpoint fingerprint (mining/checkpoint.py): resume
   within a layout is bit-identical, across layouts it re-trains.
 
+- **sparse storage** (``KMLS_ALS_SPARSE``, ISSUE 13): the binary
+  interaction matrix is kept COMPRESSED — the two int32 index vectors
+  are the whole representation — and both big×skinny products become
+  chunked gather+segment-adds over the nnz events (Tensor Casting's
+  gather/scatter co-design). Memory drops from O(P·V) to O(nnz), so
+  ``auto`` trains catalogs whose dense f32 matrix busts the HBM guard
+  on a single device. Sparse factors are float-equal-but-not-bit-equal
+  to dense ones (accumulation order), so the knob joins the checkpoint
+  fingerprint exactly as ``model_layout`` did (v3 note there).
+
 Serving consumes only the ITEM factors: seed→candidate scores are
 cosine similarities in item space (item-item collaborative filtering),
 so the published artifact carries the L2-normalized item factors and the
@@ -98,6 +108,150 @@ def _als_loss(
         jnp.sum(resid * resid)
         + reg * (jnp.sum(user_f * user_f) + jnp.sum(item_f * item_f))
     )
+
+
+ALS_SPARSE_MODES = ("auto", "always", "never")
+
+# accumulation-chunk ceiling for the sparse half-sweeps: bounds the
+# gathered (chunk, R) intermediate so peak memory is nnz-INDEPENDENT
+# beyond the index arrays themselves
+_SPARSE_CHUNK = 1 << 16
+
+
+def _als_chunk(nnz: int) -> int:
+    """Power-of-two accumulation chunk: capped by ``_SPARSE_CHUNK``, and
+    scaled DOWN to the event count at small shapes so the fixed chunk
+    buffer never dominates the sparse memory plan (the budget math and
+    the sweep must agree — both call this)."""
+    chunk = 256
+    while chunk < min(max(nnz, 1), _SPARSE_CHUNK):
+        chunk <<= 1
+    return chunk
+
+
+def resolve_als_sparse(value: str | None) -> str:
+    """``KMLS_ALS_SPARSE`` validation. Fail-safe direction: sparse and
+    dense factors are float-DIFFERENT (accumulation order), so a typo
+    must resolve to ``auto`` — the default, whose dense-while-it-fits
+    behavior is exactly what every existing deployment trains today."""
+    word = (value or "auto").strip().lower()
+    if word in ALS_SPARSE_MODES:
+        return word
+    import logging
+
+    logging.getLogger("kmlserver_tpu.mining").warning(
+        "KMLS_ALS_SPARSE=%r is not one of %s; using 'auto'",
+        value, "/".join(ALS_SPARSE_MODES),
+    )
+    return "auto"
+
+
+def sparse_als_bytes(nnz: int, p: int, v: int, rank: int) -> int:
+    """Planned device bytes for the COMPRESSED formulation: the two
+    int32 index vectors (the interaction matrix is binary — indices ARE
+    the values), both factor matrices + their normal-equation right-hand
+    sides, and one fixed-size gathered chunk. nnz-proportional — the
+    dense ``P·V`` term is gone, which is the whole point."""
+    return 8 * nnz + 8 * rank * (p + v) + 4 * _als_chunk(nnz) * rank
+
+
+def _sparse_accumulate(seg, gidx, mat, n_out: int, chunk: int):
+    """``out[s] += mat[g]`` over the padded event stream, in fixed-size
+    chunks under ``lax.scan`` so the gathered intermediate never exceeds
+    ``(chunk, R)``. Padding rides sentinel ids: ``seg == n_out`` lands in
+    a scratch row sliced off at the end; the matching gather id is
+    clipped (its value lands only in the dropped row). Traced inline by
+    the jitted sweep/loss wrappers."""
+    import jax
+
+    rank = mat.shape[1]
+
+    def step(acc, k):
+        s = jax.lax.dynamic_slice_in_dim(seg, k * chunk, chunk)
+        g = jax.lax.dynamic_slice_in_dim(gidx, k * chunk, chunk)
+        vals = mat[jnp.minimum(g, mat.shape[0] - 1)]
+        return acc.at[s].add(vals), None
+
+    acc0 = jnp.zeros((n_out + 1, rank), mat.dtype)
+    acc, _ = jax.lax.scan(step, acc0, jnp.arange(seg.shape[0] // chunk))
+    return acc[:n_out]
+
+
+@functools.partial(jax.jit, static_argnames=("p", "v", "chunk"))
+def _sparse_als_sweep(rows, cols, user_f, item_f, reg, *, p, v, chunk):
+    """One alternating sweep over the COMPRESSED interaction matrix:
+    the two big×skinny products ``X F`` and ``Xᵀ U`` become chunked
+    gather+segment-adds over the nnz events (Tensor Casting's
+    gather/scatter co-design is the reference shape); the rank×rank
+    Gramians and solves are unchanged — they never saw X at all."""
+    rank = user_f.shape[1]
+    eye = jnp.eye(rank, dtype=user_f.dtype)
+    g_item = item_f.T @ item_f + reg * eye
+    xf = _sparse_accumulate(rows, cols, item_f, p, chunk)  # X F, (P, R)
+    user_f = jnp.linalg.solve(g_item, xf.T).T
+    g_user = user_f.T @ user_f + reg * eye
+    xtu = _sparse_accumulate(cols, rows, user_f, v, chunk)  # Xᵀ U, (V, R)
+    item_f = jnp.linalg.solve(g_user, xtu.T).T
+    return user_f, item_f
+
+
+@functools.partial(jax.jit, static_argnames=("p", "chunk"))
+def _sparse_als_loss(rows, cols, user_f, item_f, reg, nnz, *, p, chunk):
+    """Exact training loss without densifying:
+    ``‖X − U Fᵀ‖² = nnz − 2·Σ_nnz u_r·f_c + ‖U Fᵀ‖²`` where
+    ``‖U Fᵀ‖² = Σ (UᵀU)∘(FᵀF)`` — every X-dependent term reduces over
+    the nnz events only (X is binary: Σx² = nnz)."""
+    import jax
+
+    gram = jnp.sum((user_f.T @ user_f) * (item_f.T @ item_f))
+
+    def step(acc, k):
+        r = jax.lax.dynamic_slice_in_dim(rows, k * chunk, chunk)
+        c = jax.lax.dynamic_slice_in_dim(cols, k * chunk, chunk)
+        u = user_f[jnp.minimum(r, user_f.shape[0] - 1)]
+        f = item_f[jnp.minimum(c, item_f.shape[0] - 1)]
+        valid = (r < p).astype(user_f.dtype)
+        return acc + jnp.sum(jnp.sum(u * f, axis=1) * valid), None
+
+    cross, _ = jax.lax.scan(
+        step, jnp.float32(0.0), jnp.arange(rows.shape[0] // chunk)
+    )
+    penalty = reg * (jnp.sum(user_f * user_f) + jnp.sum(item_f * item_f))
+    return nnz - 2.0 * cross + gram + penalty
+
+
+def _train_sparse(
+    baskets: Baskets, user_init: np.ndarray, item_init: np.ndarray,
+    reg: jax.Array, iters: int, p: int, v: int,
+) -> tuple[np.ndarray, float]:
+    """The compressed-storage sweep loop → ``(item factors, final
+    loss)``. Deterministic: fixed host init, fixed chunking, XLA's
+    deterministic scatter-add — two runs on the same backend produce
+    bit-identical factors (test-pinned), which is what lets the embed
+    checkpoint resume and the manifest sha256 keep their guarantees."""
+    nnz = len(baskets.playlist_rows)
+    chunk = _als_chunk(nnz)
+    pad = (-nnz) % chunk if nnz else chunk
+    rows = np.concatenate(
+        [np.asarray(baskets.playlist_rows, np.int32), np.full(pad, p, np.int32)]
+    )
+    cols = np.concatenate(
+        [np.asarray(baskets.track_ids, np.int32), np.full(pad, v, np.int32)]
+    )
+    rows_d, cols_d = jnp.asarray(rows), jnp.asarray(cols)
+    user_f = jnp.asarray(user_init)
+    item_f = jnp.asarray(item_init)
+    for _ in range(iters):
+        user_f, item_f = _sparse_als_sweep(
+            rows_d, cols_d, user_f, item_f, reg, p=p, v=v, chunk=chunk
+        )
+    loss = float(
+        _sparse_als_loss(
+            rows_d, cols_d, user_f, item_f, reg, jnp.float32(nnz),
+            p=p, chunk=chunk,
+        )
+    )
+    return np.array(jax.device_get(item_f)), loss
 
 
 @functools.lru_cache(maxsize=8)
@@ -237,19 +391,65 @@ def train_embeddings(
     iters = max(1, cfg.als_iters)
     reg = jnp.float32(cfg.als_reg)
     p, v = baskets.n_playlists, baskets.n_tracks
+    nnz = len(baskets.playlist_rows)
     shards = _als_shards(cfg, mesh, p, v, rank)
-    # HBM-fit guard: this formulation materializes the interaction matrix
-    # DENSE float32 — 4x the int8 footprint the mining path's bitpack
-    # dispatch exists to avoid. At scales where that dispatch fires, the
-    # dense ALS would OOM the job AFTER the expensive mine; skip the
-    # phase deterministically instead (rules-only generation, loud
-    # message). Under the sharded layout the matrix-shaped terms divide
-    # across the vocab shards (the ALX point), so the guard budgets the
-    # PER-DEVICE slab. Budgeted terms: X (P·V f32) + its int8 encode
-    # source + both factor matrices and their normal-equation right-hand
-    # sides.
+    # HBM-fit guard: the DENSE formulation materializes the interaction
+    # matrix as f32 — 4x the int8 footprint the mining path's bitpack
+    # dispatch exists to avoid — and under the sharded layout the
+    # matrix-shaped terms divide across the vocab shards (the ALX
+    # point), so the guard budgets the PER-DEVICE slab: X (P·V f32) +
+    # its int8 encode source + both factor matrices and their
+    # normal-equation right-hand sides. The SPARSE storage
+    # (``KMLS_ALS_SPARSE``, ISSUE 13) replaces the P·V term with the
+    # nnz-proportional compressed form, so `auto` now TRAINS the
+    # catalogs the dense floor previously skipped; the deterministic
+    # skip remains only when the knob pins dense-or-nothing ("never")
+    # or even the compressed form busts the budget. Storage resolution
+    # is a function of (config, dataset shape, budget), so every rank —
+    # and every resume — decides identically.
+    storage_mode = resolve_als_sparse(getattr(cfg, "als_sparse", "auto"))
     dense_bytes = 5 * p * v // shards + 8 * rank * (p + v)
-    if dense_bytes > cfg.hbm_budget_bytes:
+    sparse_bytes = sparse_als_bytes(nnz, p, v, rank)
+    use_sparse = False
+    if storage_mode == "always":
+        if shards > 1:
+            print(
+                "NOTE: KMLS_ALS_SPARSE=always under the mesh-sharded "
+                "layout keeps the sharded dense half-sweeps (the mesh "
+                "already divides the matrix); sparse storage applies to "
+                "single-device training"
+            )
+        elif sparse_bytes > cfg.hbm_budget_bytes:
+            # a pinned storage mode gets the SAME deterministic guard as
+            # dense: training dense instead would silently change the
+            # factors the pin exists to fix, and proceeding would OOM
+            # after the expensive mine — skip loudly instead
+            return {
+                "item_factors": None,
+                "rank": rank,
+                "iters": iters,
+                "reg": float(cfg.als_reg),
+                "final_loss": None,
+                "duration_s": 0.0,
+                "storage": "none",
+                "skipped": (
+                    f"KMLS_ALS_SPARSE=always pins the compressed form "
+                    f"but ~{sparse_bytes >> 20} MiB for {nnz} nnz "
+                    f"exceeds hbm_budget_bytes "
+                    f"({cfg.hbm_budget_bytes >> 20} MiB); embed phase "
+                    "skipped — serving stays rules-only"
+                ),
+            }
+        else:
+            use_sparse = True
+    elif (
+        storage_mode == "auto"
+        and shards == 1
+        and dense_bytes > cfg.hbm_budget_bytes
+        and sparse_bytes <= cfg.hbm_budget_bytes
+    ):
+        use_sparse = True
+    if not use_sparse and dense_bytes > cfg.hbm_budget_bytes:
         return {
             "item_factors": None,
             "rank": rank,
@@ -257,11 +457,21 @@ def train_embeddings(
             "reg": float(cfg.als_reg),
             "final_loss": None,
             "duration_s": 0.0,
+            "storage": "none",
             "skipped": (
                 f"dense {p}x{v} interaction matrix (~{dense_bytes >> 20} MiB"
                 f" per device across {shards} shard(s))"
                 f" exceeds hbm_budget_bytes ({cfg.hbm_budget_bytes >> 20} "
-                "MiB); embed phase skipped — serving stays rules-only"
+                "MiB) and sparse storage is "
+                + (
+                    "disabled (KMLS_ALS_SPARSE=never)"
+                    if storage_mode == "never"
+                    else f"also over budget (~{sparse_bytes >> 20} MiB "
+                    f"for {nnz} nnz)"
+                    if shards == 1
+                    else "single-device only (sharded layout active)"
+                )
+                + "; embed phase skipped — serving stays rules-only"
             ),
         }
     t0 = time.perf_counter()
@@ -275,7 +485,12 @@ def train_embeddings(
     item_init = rng.standard_normal((v, rank)).astype(np.float32) / np.sqrt(
         rank
     )
-    if shards > 1:
+    if use_sparse:
+        item_raw, final_loss = _train_sparse(
+            baskets, user_init, item_init, reg, iters, p, v
+        )
+        item_host = normalize_factors(item_raw)
+    elif shards > 1:
         item_raw, final_loss = _train_sharded(
             baskets, mesh, user_init, item_init, reg, iters, p, v
         )
@@ -302,6 +517,8 @@ def train_embeddings(
         "final_loss": final_loss,
         "duration_s": duration_s,
         "shards": shards,
+        "storage": "sparse" if use_sparse else "dense",
+        "nnz": nnz,
     }
 
 

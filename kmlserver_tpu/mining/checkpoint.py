@@ -72,7 +72,15 @@ STATE_FILENAME = "state.json"
 #     joined the fingerprint: a resume across a delta-enabled flip would
 #     publish with (or without) the freshness base state its lineage
 #     expects, desynchronizing base ∘ delta from the published artifacts
-CKPT_VERSION = 4
+# v5: sparse ALS storage (ISSUE 13) — `als_sparse` joined the
+#     fingerprint for the same reason model_layout did in v3: the
+#     compressed half-sweeps' accumulation order makes the factors
+#     float-different from the dense sweep's, so a checkpoint trained
+#     under one storage mode must never publish under the other. The
+#     auto mode's budget-driven resolution rides the checkpointed embed
+#     payload itself (like the HBM skip decision always has), so a
+#     mid-resume budget change cannot splice storages either.
+CKPT_VERSION = 5
 
 # MiningConfig fields that can change the bytes of the final artifacts (or
 # of any phase payload). Anything NOT listed — dispatch/backend knobs like
@@ -97,6 +105,7 @@ _FINGERPRINT_FIELDS = (
     "als_rank",
     "als_iters",
     "als_reg",
+    "als_sparse",
     # continuous freshness (ISSUE 10): a delta-enabled run's publication
     # step additionally writes the freshness base state derived from the
     # phase payloads — see the v4 note above

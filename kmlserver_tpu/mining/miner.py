@@ -62,10 +62,40 @@ class MiningResult:
     # merge ran, False when it had to be skipped (confidences pairwise-only),
     # None when not applicable
     triple_merge_applied: bool | None = None
-    # which pair-count route ran: "native-cpu", "dense-fused", or (staged
-    # branch, straight from pair_count_fn) "dense", "bitpack-mxu",
-    # "bitpack-vpu", "sharded-bitpack", "sharded-dense-<impl>"
+    # which pair-count route ran: "native-cpu", "dense-fused",
+    # "sparse-hybrid", "sparse-sharded", or (staged branch, straight from
+    # pair_count_fn) "dense", "bitpack-mxu", "bitpack-vpu",
+    # "sharded-bitpack", "sharded-dense-<impl>"
     count_path: str | None = None
+    # how the dispatch decided (mining/dispatch.py CountPlan.source:
+    # override/threshold/table/heuristic) — provenance for job telemetry
+    count_path_source: str | None = None
+    # exact pair-event count the sparse plan measured (None: not measured)
+    sparse_events: int | None = None
+
+
+def bitpack_plan_bytes(
+    n_playlists: int,
+    n_tracks: int,
+    *,
+    n_devices: int = 1,
+    n_rows: int = 0,
+) -> int:
+    """Planned per-device bytes of the bit-packed formulation: bitset
+    slab (word axis sharded over dp) + int32 counts with top-k scratch +
+    one unpacked int8 slab (the mxu impl's per-scan-step intermediate) +
+    membership operands. THE one copy of this footprint — the dispatch
+    heuristic (mining/dispatch.py) and :func:`bitpack_wanted` must agree
+    on what 'bitpack fits' means or the sparse rescue mis-fires."""
+    from ..ops import popcount as pc
+
+    v_pad, w_pad = pc.padded_shape(n_tracks, n_playlists)
+    return (
+        v_pad * w_pad * 4 // max(n_devices, 1)
+        + 8 * v_pad * v_pad
+        + v_pad * pc.word_chunk() * 32
+        + 8 * n_rows // max(n_devices, 1)
+    )
 
 
 def bitpack_wanted(
@@ -109,21 +139,14 @@ def bitpack_wanted(
                 + 8 * n_rows // max(n_devices, 1)
             )
             if dense_bytes > hbm_budget_bytes:
-                # the bitpack route is the fallback, not a guarantee: check
-                # ITS footprint too — bitset slab (word axis sharded over
-                # dp) + int32 counts with top-k scratch + one unpacked
-                # int8 slab (the mxu impl's per-scan-step intermediate) +
-                # membership operands — and warn loudly when NEITHER
-                # formulation fits, so an impending allocator failure is
-                # diagnosable before the opaque OOM (ADVICE r3)
-                from ..ops import popcount as pc
-
-                v_pad, w_pad = pc.padded_shape(n_tracks, n_playlists)
-                bitpack_bytes = (
-                    v_pad * w_pad * 4 // max(n_devices, 1)
-                    + 8 * v_pad * v_pad
-                    + v_pad * pc.WORD_CHUNK * 32
-                    + 8 * n_rows // max(n_devices, 1)
+                # the bitpack route is the fallback, not a guarantee:
+                # check ITS footprint too (bitpack_plan_bytes — shared
+                # with the dispatch heuristic) and warn loudly when
+                # NEITHER formulation fits, so an impending allocator
+                # failure is diagnosable before the opaque OOM (ADVICE r3)
+                bitpack_bytes = bitpack_plan_bytes(
+                    n_playlists, n_tracks,
+                    n_devices=n_devices, n_rows=n_rows,
                 )
                 if bitpack_bytes > hbm_budget_bytes:
                     print(
@@ -517,13 +540,49 @@ def mine(
         # needs the one-hot or count matrix on device: single-device dense
         # mining without an itemset census or triple/quad extensions. The
         # sharded, bit-packed, and census paths keep the staged pipeline.
-        wants_bitpack = bitpack_wanted(
-            mined_baskets.n_playlists, mined_baskets.n_tracks,
-            cfg.bitpack_threshold_elems,
-            hbm_budget_bytes=cfg.hbm_budget_bytes,
-            n_rows=len(mined_baskets.playlist_rows),
+        #
+        # WHICH family counts is the measured three-way dispatch
+        # (mining/dispatch.py): explicit KMLS_COUNT_PATH override →
+        # explicit legacy threshold → measured (density, shape) table
+        # cell → legacy bitpack_wanted heuristic. The plan measures the
+        # exact density and pair-event volume with one O(nnz) host
+        # bincount before any device work is committed.
+        from . import dispatch as dispatch_mod
+
+        plan = dispatch_mod.plan_count_path(
+            cfg, mined_baskets.n_playlists, mined_baskets.n_tracks,
+            len(mined_baskets.playlist_rows),
             backend=jax.default_backend(),
+            n_devices=mesh.devices.size if mesh is not None else 1,
+            baskets=mined_baskets,
         )
+        wants_bitpack = plan.path == "bitpack"
+        use_sparse = plan.path == "sparse"
+        plan_source = plan.source
+        if use_sparse and cfg.max_itemset_len >= 3:
+            # the itemset census and the triple/quad extensions need
+            # materialized device intermediates the sparse route never
+            # builds — the same exactness-over-speed guard the bitpack
+            # override below applies; fall back to what the legacy
+            # dispatch would have chosen. LOUDLY — a pinned/table sparse
+            # decision must never be dropped in silence — and the
+            # telemetry source says what actually decided, not the plan
+            # that was overridden.
+            print(
+                "NOTE: max_itemset_len >= 3 needs materialized device "
+                "intermediates for the census/triple merge, which the "
+                f"sparse path never builds — the {plan.source} sparse "
+                "decision is overridden by the legacy dense/bitpack "
+                "dispatch"
+            )
+            use_sparse = False
+            plan_source = "census-override"
+            wants_bitpack = bitpack_wanted(
+                mined_baskets.n_playlists, mined_baskets.n_tracks, "auto",
+                hbm_budget_bytes=cfg.hbm_budget_bytes,
+                n_rows=len(mined_baskets.playlist_rows),
+                backend=jax.default_backend(),
+            )
         # exactness guard: the itemset census and the confidence-mode
         # triple/quad merge need the dense one-hot (x) — the bit-packed
         # route never materializes it and would silently downgrade those
@@ -532,6 +591,13 @@ def mine(
         # doesn't fit, bitpack proceeds and the loud pairwise-only
         # warning below stands (dense was never an option).
         staged_threshold = cfg.bitpack_threshold_elems
+        if plan.source == "override":
+            # a pinned family must reach the staged pair_count_fn branch
+            # too, which re-derives bitpack-vs-dense from the threshold
+            if wants_bitpack:
+                staged_threshold = 1
+            elif plan.path == "dense":
+                staged_threshold = None
         if (
             wants_bitpack
             and mesh is None
@@ -548,6 +614,7 @@ def mine(
                 "overriding the bitpack threshold with the dense path"
             )
             wants_bitpack = False
+            plan_source = "census-override"
             # the override must reach pair_count_fn too, or the staged
             # branch would re-derive bitpack from the raw cfg threshold
             staged_threshold = None
@@ -556,7 +623,15 @@ def mine(
         # the native bit-packed counter is the same exact XᵀX ~40x faster
         # (native/kmls_popcount.cpp). Same eligibility as the fused path
         # (no downstream step may need the one-hot or counts on device).
-        use_native_cpu = native_cpu_ok
+        # The native counter is the dense family's CPU implementation:
+        # a measured/override SPARSE plan outranks it (that is the very
+        # comparison the scale_sparse bench banks), and an explicit
+        # bitpack override pins the bit-packed family as named.
+        use_native_cpu = (
+            native_cpu_ok
+            and not use_sparse
+            and not (plan.source == "override" and plan.path == "bitpack")
+        )
         # vocab-sharded count+emit (the model-parallel layout's mining
         # half): counts stay column-sharded across the mesh and each
         # shard emits its own antecedent rows — the (V, V) matrix never
@@ -566,16 +641,20 @@ def mine(
         use_shard_mine = (
             layout_mod.wants_sharded_mining(cfg, mesh)
             and not wants_bitpack
+            and not use_sparse
             and cfg.max_itemset_len < 3
         )
         use_fused = (
             mesh is None
             and not wants_bitpack
+            and not use_sparse
             and cfg.max_itemset_len < 3
             and not use_native_cpu
         )
         counts = x = None
-        if use_native_cpu:
+        if use_sparse:
+            count_path = None  # the sparse branch names hybrid vs sharded
+        elif use_native_cpu:
             count_path = "native-cpu"
         elif use_shard_mine:
             count_path = f"sharded-vocab-{cfg.sharded_impl}"
@@ -583,7 +662,102 @@ def mine(
             count_path = "dense-fused"
         else:
             count_path = None  # the staged branch reports what actually ran
-        if use_native_cpu:
+        if use_sparse:
+            # the sparse family (ops/sparse.py): CSR-style pair-event
+            # expansion + bitpacked long-basket sub-count — only the nnz
+            # membership pairs are touched, no (P, V) operand exists in
+            # any layout. Counts are bit-identical integers, so every
+            # emission twin downstream yields identical rule tensors.
+            with timer.phase("sparse_mine"):
+                from ..ops import sparse as sparse_mod
+
+                min_count = support.min_count_for(
+                    cfg.min_support, mined_baskets.n_playlists
+                )
+                thr = cfg.sparse_long_basket or None
+                if layout_mod.wants_sharded_mining(cfg, mesh):
+                    from ..parallel.support import (
+                        sparse_sharded_rule_tensors,
+                    )
+
+                    emitted = sparse_sharded_rule_tensors(
+                        mined_baskets, mesh, min_count,
+                        cfg.k_max_consequents, long_basket_threshold=thr,
+                    )
+                    tensors = rules.assemble_rule_tensors(
+                        *emitted,
+                        n_playlists=mined_baskets.n_playlists,
+                        min_support=cfg.min_support,
+                        k_max=cfg.k_max_consequents,
+                        mode=cfg.confidence_mode,
+                        min_confidence=cfg.min_confidence,
+                        n_total_songs=n_total,
+                        n_tracks=mined_baskets.n_tracks,
+                    )
+                    count_path = "sparse-sharded"
+                else:
+                    count_path = "sparse-hybrid"
+                    if jax.default_backend() == "cpu":
+                        # fully sparse count→emit when no long baskets:
+                        # membership pairs straight to rule rows, the
+                        # (V, V) matrix never exists. Long baskets fall
+                        # back to the materialized-matrix route (sparse
+                        # count + dense emission) — same tensors.
+                        emitted = sparse_mod.sparse_rule_rows(
+                            mined_baskets.playlist_rows,
+                            mined_baskets.track_ids,
+                            n_playlists=mined_baskets.n_playlists,
+                            n_tracks=mined_baskets.n_tracks,
+                            min_count=min_count,
+                            k_max=cfg.k_max_consequents,
+                            long_basket_threshold=thr,
+                        )
+                        if emitted is not None:
+                            tensors = rules.assemble_rule_tensors(
+                                *emitted,
+                                n_playlists=mined_baskets.n_playlists,
+                                min_support=cfg.min_support,
+                                k_max=cfg.k_max_consequents,
+                                mode=cfg.confidence_mode,
+                                min_confidence=cfg.min_confidence,
+                                n_total_songs=n_total,
+                                n_tracks=mined_baskets.n_tracks,
+                            )
+                        else:
+                            counts_host = sparse_mod.sparse_pair_counts_np(
+                                mined_baskets.playlist_rows,
+                                mined_baskets.track_ids,
+                                n_playlists=mined_baskets.n_playlists,
+                                n_tracks=mined_baskets.n_tracks,
+                                long_basket_threshold=thr,
+                            )
+                            tensors = rules.mine_rules_from_counts_np(
+                                counts_host,
+                                n_playlists=mined_baskets.n_playlists,
+                                min_support=cfg.min_support,
+                                k_max=cfg.k_max_consequents,
+                                mode=cfg.confidence_mode,
+                                min_confidence=cfg.min_confidence,
+                                n_total_songs=n_total,
+                            )
+                    else:
+                        counts_dev = sparse_mod.sparse_pair_counts_device(
+                            mined_baskets.playlist_rows,
+                            mined_baskets.track_ids,
+                            n_playlists=mined_baskets.n_playlists,
+                            n_tracks=mined_baskets.n_tracks,
+                            long_basket_threshold=thr,
+                        )
+                        tensors = rules.mine_rules_from_counts(
+                            counts_dev,
+                            n_playlists=mined_baskets.n_playlists,
+                            min_support=cfg.min_support,
+                            k_max=cfg.k_max_consequents,
+                            mode=cfg.confidence_mode,
+                            min_confidence=cfg.min_confidence,
+                            n_total_songs=n_total,
+                        )
+        elif use_native_cpu:
             with timer.phase("native_pair_counts"):
                 counts_np = native_pair_counts(mined_baskets)
             with timer.phase("rule_emission"):
@@ -789,4 +963,6 @@ def mine(
         phase_timings=dict(timer.phases),
         triple_merge_applied=triple_merge_applied,
         count_path=count_path,
+        count_path_source=plan_source,
+        sparse_events=plan.pair_events,
     )

@@ -333,14 +333,30 @@ def run_mining_job(
                 playlists=result.n_playlists,
                 tracks=result.n_tracks,
             )
+            # the measured dispatch decision (ISSUE 13), surfaced as a
+            # labeled gauge: which family counted + what decided it
+            if result.count_path:
+                jm.note_count_path(
+                    result.count_path, result.count_path_source or "",
+                )
             # analytic cost attribution (ISSUE 12): the mine phase's
             # dominant kernel is the pair-support contraction C = XᵀX
             # over the (possibly pruned) mined shape — leading-order,
-            # same costmodel.phase_cost formula serving MFU uses
-            flops, moved = costmodel.phase_cost(
-                "support_count",
-                p=result.n_playlists, v=result.n_tracks,
-            )
+            # same costmodel.phase_cost formula serving MFU uses. A
+            # sparse-family mine (ISSUE 13) did nnz-proportional work
+            # instead, and the attribution must say so.
+            if result.count_path and result.count_path.startswith("sparse"):
+                pruned_v = result.pruned_vocab or result.n_tracks
+                flops, moved = costmodel.phase_cost(
+                    "sparse_count",
+                    events=result.sparse_events or 0,
+                    nnz=encoded["n_rows"], v=pruned_v,
+                )
+            else:
+                flops, moved = costmodel.phase_cost(
+                    "support_count",
+                    p=result.n_playlists, v=result.n_tracks,
+                )
             jm.note_phase_cost("mine", flops, moved)
 
         rules_dict = phase(
@@ -376,13 +392,26 @@ def run_mining_job(
                 )
                 if jm is not None:
                     # analytic cost attribution (ISSUE 12): the embed
-                    # phase is the ALS half-sweep loop over the full
-                    # interaction matrix
-                    flops, moved = costmodel.phase_cost(
-                        "als_sweep",
-                        p=baskets.n_playlists, v=baskets.n_tracks,
-                        r=emb_payload["rank"], iters=emb_payload["iters"],
-                    )
+                    # phase is the ALS half-sweep loop — over the full
+                    # dense interaction matrix, or (ISSUE 13) over its
+                    # compressed nnz-proportional form
+                    if emb_payload.get("storage") == "sparse":
+                        flops, moved = costmodel.phase_cost(
+                            "als_sweep_sparse",
+                            nnz=emb_payload.get(
+                                "nnz", len(baskets.playlist_rows)
+                            ),
+                            p=baskets.n_playlists, v=baskets.n_tracks,
+                            r=emb_payload["rank"],
+                            iters=emb_payload["iters"],
+                        )
+                    else:
+                        flops, moved = costmodel.phase_cost(
+                            "als_sweep",
+                            p=baskets.n_playlists, v=baskets.n_tracks,
+                            r=emb_payload["rank"],
+                            iters=emb_payload["iters"],
+                        )
                     jm.note_phase_cost("embed", flops, moved)
 
         # ---------- publication (writer only, lease-fenced) ----------

@@ -71,6 +71,8 @@ class JobMetrics:
         self.phase_cost: dict[str, tuple[float, float]] = {}
         self.rule_generation_s: float | None = None
         self.fencing_token: int | None = None
+        # (count_path, source) from the measured dispatch (ISSUE 13)
+        self.count_path: tuple[str, str] | None = None
         self.success = 0
 
     # ---------- accumulation ----------
@@ -104,6 +106,14 @@ class JobMetrics:
         dominant kernel (costmodel.phase_cost), then persist — cost
         telemetry must survive a preemption exactly like durations."""
         self.phase_cost[phase] = (max(flops, 0.0), max(bytes_moved, 0.0))
+        self.write()
+
+    def note_count_path(self, path: str, source: str) -> None:
+        """Record which pair-count family the measured dispatcher chose
+        and why (``override``/``threshold``/``table``/``heuristic``) —
+        the plan-time decision surfaced as a labeled gauge so the fleet
+        can see WHICH kernel mined each generation, then persist."""
+        self.count_path = (path, source)
         self.write()
 
     def note_artifact(self, name: str, path: str) -> None:
@@ -161,6 +171,12 @@ class JobMetrics:
             series(
                 "kmls_job_phase_bytes_moved",
                 self.phase_cost[phase][1], f'{{phase="{phase}"}}',
+            )
+        if self.count_path is not None:
+            series(
+                "kmls_job_count_path", 1,
+                f'{{path="{self.count_path[0]}",'
+                f'source="{self.count_path[1]}"}}',
             )
         for name, value in self.dataset.items():
             series(name, value)

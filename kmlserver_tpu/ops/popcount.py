@@ -43,6 +43,12 @@ entry point falls back gracefully.
 Tile sizes are env-tunable (``KMLS_POPCOUNT_TILE_I/TILE_J/WORD_CHUNK``) for
 on-hardware tuning without a code change; defaults keep every operand on
 the (8, 128) 32-bit tile grid and the per-step VMEM footprint ≈ 0.3 MB.
+Like ``KMLS_POPCOUNT_VARIANT``, the tile knobs are read LAZILY at
+kernel-build time (:func:`resolve_tiles`) — an env change after import
+takes effect on the next call, and because the resolved sizes ride the
+jit static arguments, a changed tile can never silently reuse a program
+compiled for the old one. (They were read once at module import until
+ISSUE 13; tests now pin the lazy behavior.)
 """
 
 from __future__ import annotations
@@ -57,22 +63,58 @@ import numpy as np
 
 from . import encode
 
-TILE_I = int(os.environ.get("KMLS_POPCOUNT_TILE_I", "32"))
-TILE_J = int(os.environ.get("KMLS_POPCOUNT_TILE_J", "128"))
-WORD_CHUNK = int(os.environ.get("KMLS_POPCOUNT_WORD_CHUNK", "512"))
+TILE_I_DEFAULT = 32
+TILE_J_DEFAULT = 128
+WORD_CHUNK_DEFAULT = 512
 _SUB = 128  # lane-aligned word slice for the bcast variant's 3D intermediate
-# the vocab axis must pad to a multiple of BOTH tile sizes — rounding to
-# max() silently leaves output rows unwritten when TILE_I ∤ TILE_J
-V_TILE = math.lcm(TILE_I, TILE_J)
-if WORD_CHUNK > _SUB and WORD_CHUNK % _SUB != 0:
-    raise ValueError(
-        f"KMLS_POPCOUNT_WORD_CHUNK={WORD_CHUNK} must be a multiple of "
-        f"{_SUB} (or at most {_SUB}): the bcast kernel slices word chunks "
-        f"in {_SUB}-wide pieces and a ragged tail would be dropped"
-    )
 
 VARIANTS = ("bcast", "row")
 COUNT_IMPLS = ("mxu", "vpu")
+
+
+def resolve_tiles(
+    tile_i: int | None = None,
+    tile_j: int | None = None,
+    word_chunk: int | None = None,
+) -> tuple[int, int, int]:
+    """``(TILE_I, TILE_J, WORD_CHUNK)`` — explicit args > env knobs >
+    defaults, validated. THE one read point for the tile knobs, called
+    at kernel-build time (never at import: a deployment that exports
+    the knobs after importing the package must still be heard)."""
+    if tile_i is None:
+        tile_i = int(os.environ.get("KMLS_POPCOUNT_TILE_I", TILE_I_DEFAULT))
+    if tile_j is None:
+        tile_j = int(os.environ.get("KMLS_POPCOUNT_TILE_J", TILE_J_DEFAULT))
+    if word_chunk is None:
+        word_chunk = int(
+            os.environ.get("KMLS_POPCOUNT_WORD_CHUNK", WORD_CHUNK_DEFAULT)
+        )
+    if tile_i < 1 or tile_j < 1 or word_chunk < 1:
+        raise ValueError(
+            f"popcount tiles must be positive, got "
+            f"{tile_i}x{tile_j}x{word_chunk}"
+        )
+    if word_chunk > _SUB and word_chunk % _SUB != 0:
+        raise ValueError(
+            f"KMLS_POPCOUNT_WORD_CHUNK={word_chunk} must be a multiple of "
+            f"{_SUB} (or at most {_SUB}): the bcast kernel slices word "
+            f"chunks in {_SUB}-wide pieces and a ragged tail would be "
+            "dropped"
+        )
+    return tile_i, tile_j, word_chunk
+
+
+def v_tile(tile_i: int | None = None, tile_j: int | None = None) -> int:
+    """The vocab-axis padding unit: the vocab must pad to a multiple of
+    BOTH tile sizes — rounding to max() silently leaves output rows
+    unwritten when TILE_I ∤ TILE_J."""
+    ti, tj, _ = resolve_tiles(tile_i, tile_j)
+    return math.lcm(ti, tj)
+
+
+def word_chunk() -> int:
+    """The resolved word-chunk size (lazy env read)."""
+    return resolve_tiles()[2]
 
 
 def resolve_counts_impl(impl: str | None = None) -> str:
@@ -174,52 +216,77 @@ def _kernel_bcast(a_ref, b_ref, out_ref, *, swar: bool):
 _KERNELS = {"row": _kernel_row, "bcast": _kernel_bcast}
 
 
-@partial(jax.jit, static_argnames=("interpret", "variant", "swar"))
 def popcount_pair_counts_padded(
     bt: jax.Array,
     *,
     interpret: bool = False,
     variant: str = "bcast",
     swar: bool = False,
+    tile_i: int | None = None,
+    tile_j: int | None = None,
+    word_chunk: int | None = None,
 ) -> jax.Array:
     """Pair counts from an already-padded bitset matrix
-    ``bt (V_pad, W_pad) uint32`` with V_pad % TILE_J == 0 and
-    W_pad % WORD_CHUNK == 0. → int32 (V_pad, V_pad)."""
+    ``bt (V_pad, W_pad) uint32`` with V_pad % lcm(TILE_I, TILE_J) == 0
+    and W_pad % WORD_CHUNK == 0. → int32 (V_pad, V_pad). Tile sizes
+    resolve HERE (env or explicit) and ride the jit static args, so a
+    knob change after import builds — and caches — a new program."""
+    ti, tj, wk = resolve_tiles(tile_i, tile_j, word_chunk)
+    return _popcount_padded_jit(
+        bt, interpret=interpret, variant=variant, swar=swar,
+        tile_i=ti, tile_j=tj, word_chunk=wk,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("interpret", "variant", "swar", "tile_i", "tile_j", "word_chunk"),
+)
+def _popcount_padded_jit(
+    bt: jax.Array,
+    *,
+    interpret: bool,
+    variant: str,
+    swar: bool,
+    tile_i: int,
+    tile_j: int,
+    word_chunk: int,
+) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     v_pad, w_pad = bt.shape
-    if v_pad % TILE_I or v_pad % TILE_J or w_pad % WORD_CHUNK:
+    if v_pad % tile_i or v_pad % tile_j or w_pad % word_chunk:
         raise ValueError(
             f"bt {bt.shape} must pad V to a multiple of lcm(TILE_I, TILE_J)"
-            f"={V_TILE} and W to a multiple of WORD_CHUNK={WORD_CHUNK}; a "
-            f"truncating grid would silently skip output tiles"
+            f"={math.lcm(tile_i, tile_j)} and W to a multiple of "
+            f"WORD_CHUNK={word_chunk}; a truncating grid would silently "
+            "skip output tiles"
         )
-    grid = (v_pad // TILE_I, v_pad // TILE_J, w_pad // WORD_CHUNK)
+    grid = (v_pad // tile_i, v_pad // tile_j, w_pad // word_chunk)
     return pl.pallas_call(
         partial(_KERNELS[variant], swar=swar),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (TILE_I, WORD_CHUNK),
+                (tile_i, word_chunk),
                 lambda i, j, k: (i, k),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (TILE_J, WORD_CHUNK),
+                (tile_j, word_chunk),
                 lambda i, j, k: (j, k),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (TILE_I, TILE_J), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+            (tile_i, tile_j), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((v_pad, v_pad), jnp.int32),
         interpret=interpret,
     )(bt, bt)
 
 
-@partial(jax.jit, static_argnames=("word_chunk",))
 def mxu_pair_counts_padded(
     bt: jax.Array, *, word_chunk: int | None = None
 ) -> jax.Array:
@@ -241,10 +308,17 @@ def mxu_pair_counts_padded(
 
     Pure XLA: no Pallas/Mosaic involvement, so it runs natively (not
     interpreted) on CPU test backends and carries zero lowering risk on
-    TPU generations.
+    TPU generations. The word-chunk knob resolves here (lazy env read)
+    and rides the inner jit's static arg.
     """
+    wk = min(resolve_tiles(word_chunk=word_chunk)[2], bt.shape[1])
+    return _mxu_padded_jit(bt, word_chunk=wk)
+
+
+@partial(jax.jit, static_argnames=("word_chunk",))
+def _mxu_padded_jit(bt: jax.Array, *, word_chunk: int) -> jax.Array:
     v_pad, w_pad = bt.shape
-    wk = min(word_chunk or WORD_CHUNK, w_pad)
+    wk = word_chunk
     if w_pad % wk:
         raise ValueError(
             f"W_pad {w_pad} must be a multiple of the word chunk {wk} "
@@ -278,13 +352,16 @@ def _round_up(n: int, m: int) -> int:
 
 def padded_shape(n_tracks: int, n_playlists: int) -> tuple[int, int]:
     """``(v_pad, w_pad)`` the kernel actually allocates: the vocabulary
-    padded to ``V_TILE = lcm(TILE_I, TILE_J)`` and the bitset word count
-    ``ceil(P/32)`` padded to ``WORD_CHUNK``. The ONE copy of this math —
+    padded to ``lcm(TILE_I, TILE_J)`` and the bitset word count
+    ``ceil(P/32)`` padded to ``WORD_CHUNK`` (tiles resolved lazily, so
+    this tracks the env knobs call-by-call). The ONE copy of this math —
     bench/demo HBM accounting must call it, not re-derive it (the two
     hand-derived copies drifted twice)."""
-    v_pad = _round_up(max(n_tracks, V_TILE), V_TILE)
+    ti, tj, wk = resolve_tiles()
+    vt = math.lcm(ti, tj)
+    v_pad = _round_up(max(n_tracks, vt), vt)
     w_pad = _round_up(
-        (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, WORD_CHUNK
+        (n_playlists + encode.WORD_BITS - 1) // encode.WORD_BITS, wk
     )
     return v_pad, w_pad
 

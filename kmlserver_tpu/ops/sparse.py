@@ -1,0 +1,487 @@
+"""Sparsity-adaptive pair-support counting — the third kernel family.
+
+The dense MXU contraction (ops/support.py) and the bit-packed popcount
+pair (ops/popcount.py) both pay DENSE-shaped work: ``O(P·V)`` operand
+bytes for the one-hot and ``O(V²·P/32)`` word-ANDs for the bitset, no
+matter how empty the basket matrix actually is. At realistic playlist
+scale the matrix is >99% sparse (mean basket length ≪ V), so almost all
+of that work multiplies zeros.
+
+This module counts only what exists. ``C = XᵀX`` decomposes per basket:
+
+    C = Σ_b e_b e_bᵀ,   e_b = the indicator of basket b's tracks
+
+so a basket of length k contributes its k(k-1)/2 unordered track pairs
+(C is symmetric — one count per pair, mirrored at the end) plus its k
+diagonal singles (item supports — one bincount over the track ids). The
+CSR-style half of the hybrid expands those pair events straight from the
+(sorted) membership rows — repeats, one arange, gathers; no division —
+and accumulates them with one integer bincount per chunk:
+``O(Σ_b k_b²/2)`` work total, versus ``O(P·V²)`` dense FLOPs. Integer
+accumulation in any order is exact, so the counts are BIT-IDENTICAL to
+the dense and bit-packed paths — pinned by tests/test_sparse.py at four
+densities in both layouts.
+
+**The long-basket guard (the × bitpacked half of the hybrid):** pair
+expansion is quadratic per basket, so one pathological 50k-track basket
+would generate 1.25G events on its own. Baskets longer than
+``long_basket_threshold`` are split out, their rows gathered into a
+COMPACT sub-problem (only the occupied playlists exist in it), and
+counted densely there — through the native bit-packed POPCNT kernel when
+it's available, or an exact float64 BLAS contraction otherwise — then
+summed into the sparse counts. Both halves are exact integer math, so
+the split point changes performance, never results.
+
+Everything here is host-side numpy by design, like the native-CPU
+counter: the whole point of the sparse path is that the ``(P, V)``
+operand never exists anywhere — only the nnz membership pairs and the
+``(V_f, V_f)`` count matrix (post-Apriori ``V_f`` is the few thousand
+frequent items) are ever materialized. A jitted device twin
+(:func:`sparse_pair_counts_device`) scatter-adds the same event stream
+on an accelerator backend for jobs whose emission stays on device; same
+events + integer adds = bit-identical by construction.
+
+Which of the three families runs is a MEASURED decision —
+``mining/dispatch.py`` — not a hand-set threshold; see the README
+"Sparse kernels & dispatch" section.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Baskets longer than this leave the CSR pair expansion for the gathered
+# dense/bitpacked sub-count (quadratic-per-basket guard). Env-tunable via
+# KMLS_SPARSE_LONG_BASKET (read per call, not at import — the popcount
+# tile knobs' import-time-read bug is not repeated here).
+LONG_BASKET_DEFAULT = 256
+
+# Pair events expanded per accumulation chunk: bounds the transient
+# expansion arrays (~5 words/event) and amortizes the per-chunk
+# ``O(V²)`` bincount sweep. Larger is faster until the chunk's key
+# array stops fitting cache-adjacent memory.
+EVENT_CHUNK = 16_000_000
+
+
+def resolve_long_basket(threshold: int | None = None) -> int:
+    """``KMLS_SPARSE_LONG_BASKET`` (lazy read) with the module default."""
+    import os
+
+    if threshold is not None:
+        return max(int(threshold), 2)
+    raw = os.environ.get("KMLS_SPARSE_LONG_BASKET")
+    return max(int(raw), 2) if raw not in (None, "") else LONG_BASKET_DEFAULT
+
+
+def _sorted_by_playlist(
+    playlist_rows: np.ndarray, track_ids: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Membership rows grouped by playlist (stable, so equal-playlist
+    order is preserved). ``build_baskets`` already emits sorted rows —
+    the monotonicity probe keeps that case a no-op."""
+    rows = np.asarray(playlist_rows)
+    tids = np.asarray(track_ids)
+    if rows.size and np.any(np.diff(rows) < 0):
+        order = np.argsort(rows, kind="stable")
+        rows, tids = rows[order], tids[order]
+    return rows, tids
+
+
+def basket_lengths(playlist_rows: np.ndarray, n_playlists: int) -> np.ndarray:
+    """Per-playlist membership counts (int64, O(nnz) host bincount)."""
+    return np.bincount(
+        np.asarray(playlist_rows, dtype=np.int64), minlength=n_playlists
+    )
+
+
+def pair_event_count(
+    playlist_rows: np.ndarray,
+    n_playlists: int,
+    long_basket_threshold: int | None = None,
+) -> tuple[int, int]:
+    """``(pair_events, long_rows)`` the hybrid would process: the exact
+    Σ k(k-1)/2 over short baskets, and the membership rows the
+    long-basket sub-count gathers. The dispatcher's plan-time work
+    estimate — exact, not a distributional guess, and O(nnz) to
+    compute."""
+    thr = resolve_long_basket(long_basket_threshold)
+    lengths = basket_lengths(playlist_rows, n_playlists)
+    short = lengths[lengths <= thr].astype(np.int64)
+    long_rows = int(lengths[lengths > thr].sum())
+    return int(np.sum(short * (short - 1) // 2)), long_rows
+
+
+def _segments(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """``(starts, counts)`` of the contiguous playlist segments in the
+    sorted membership rows (unique preserves first-occurrence order)."""
+    _, starts, counts = np.unique(rows, return_index=True, return_counts=True)
+    return starts.astype(np.int64), counts.astype(np.int64)
+
+
+def _split_long(
+    rows: np.ndarray, tids: np.ndarray, starts: np.ndarray,
+    counts: np.ndarray, thr: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """→ ``(short_rows, short_tids, starts, counts, long_rows, long_tids)``
+    with the segment structure recomputed for the short remainder."""
+    long_seg = counts > thr
+    if not np.any(long_seg):
+        return rows, tids, starts, counts, rows[:0], tids[:0]
+    sel = np.zeros(len(rows), dtype=bool)
+    for s, c in zip(starts[long_seg], counts[long_seg]):
+        sel[s : s + c] = True
+    keep = ~sel
+    short_rows, short_tids = rows[keep], tids[keep]
+    if short_rows.size:
+        starts, counts = _segments(short_rows)
+    else:
+        starts = counts = np.zeros(0, dtype=np.int64)
+    return short_rows, short_tids, starts, counts, rows[sel], tids[sel]
+
+
+def _iter_pair_keys(
+    tids: np.ndarray,
+    starts: np.ndarray,
+    counts: np.ndarray,
+    n_tracks: int,
+    event_chunk: int,
+    both_directions: bool = False,
+):
+    """Yield flat ``i·V + j`` keys for every unordered intra-basket pair,
+    one POSITION-triangle event per pair (positions i < j inside each
+    basket's segment — ids may come out either order; the caller mirrors,
+    or asks for ``both_directions`` and skips the mirror pass).
+    Division-free vectorized expansion in bounded chunks whose
+    boundaries respect element granularity."""
+    nnz = len(tids)
+    if nnz == 0:
+        return
+    key_dtype = (
+        np.int32
+        if n_tracks * n_tracks < np.iinfo(np.int32).max
+        else np.int64
+    )
+    seg_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    pos = np.arange(nnz, dtype=np.int64)
+    # pairs each element opens: the elements AFTER it in its own basket
+    rep_all = starts[seg_of] + counts[seg_of] - 1 - pos
+    cum = np.cumsum(rep_all)
+    lo = 0
+    while lo < nnz:
+        target = (cum[lo - 1] if lo else 0) + event_chunk
+        hi = int(np.searchsorted(cum, target, side="left")) + 1
+        hi = min(max(hi, lo + 1), nnz)
+        rep = rep_all[lo:hi]
+        n_events = int(rep.sum())
+        if n_events:
+            off = np.concatenate([[0], np.cumsum(rep[:-1])])
+            within = np.arange(n_events, dtype=np.int64) - np.repeat(off, rep)
+            left = np.repeat(tids[lo:hi], rep).astype(key_dtype)
+            right = tids[np.repeat(pos[lo:hi] + 1, rep) + within].astype(
+                key_dtype
+            )
+            v = key_dtype(n_tracks)
+            if both_directions:
+                yield np.concatenate([left * v + right, right * v + left])
+            else:
+                yield left * v + right
+        lo = hi
+
+
+def _count_long_dense(
+    rows: np.ndarray, tids: np.ndarray, n_tracks: int
+) -> np.ndarray:
+    """Bitpacked/dense half over the GATHERED long baskets: only the
+    occupied playlists exist in the sub-problem. Native POPCNT when the
+    library is there; otherwise an exact float64 contraction (counts ≤ P
+    ≪ 2^53, so the cast back to int32 is lossless)."""
+    from . import cpu_popcount
+
+    _, compact = np.unique(rows, return_inverse=True)
+    p_long = int(compact.max()) + 1 if compact.size else 0
+    if p_long == 0:
+        return np.zeros((n_tracks, n_tracks), dtype=np.int32)
+    if cpu_popcount.available():
+        try:
+            return np.asarray(
+                cpu_popcount.pair_counts(
+                    compact.astype(np.int32), tids.astype(np.int32),
+                    n_playlists=p_long, n_tracks=n_tracks,
+                ),
+                dtype=np.int32,
+            )
+        except RuntimeError:
+            pass
+    x = np.zeros((p_long, n_tracks), dtype=np.float64)
+    x[compact, tids] = 1.0
+    return (x.T @ x).astype(np.int32)
+
+
+def sparse_pair_counts_np(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    long_basket_threshold: int | None = None,
+    event_chunk: int = EVENT_CHUNK,
+) -> np.ndarray:
+    """Pair counts ``(V, V) int32`` from membership pairs, touching only
+    the nnz that exist. Pairs must be DEDUPLICATED (the ``build_baskets``
+    invariant shared with the bitpack path): a duplicate would double-
+    count here exactly as it would in the dense one-hot."""
+    thr = resolve_long_basket(long_basket_threshold)
+    rows, tids = _sorted_by_playlist(playlist_rows, track_ids)
+    out = np.zeros((n_tracks, n_tracks), dtype=np.int32)
+    if rows.size == 0:
+        return out
+    starts, counts = _segments(rows)
+    rows, tids, starts, counts, lrows, ltids = _split_long(
+        rows, tids, starts, counts, thr
+    )
+    if lrows.size:
+        out += _count_long_dense(lrows, ltids, n_tracks)
+    # short-basket diagonal = item supports; the long block above carries
+    # its own diagonal (it is a complete sub-count)
+    if tids.size:
+        out[np.diag_indices(n_tracks)] += np.bincount(
+            tids.astype(np.int64), minlength=n_tracks
+        ).astype(np.int32, copy=False)
+    e_total = int(np.sum(counts * (counts - 1) // 2))
+    v2 = n_tracks * n_tracks
+    # accumulator selection: the bincount path sweeps O(V²) PER CHUNK
+    # (plus one V²-strided mirror), which is the right trade only while
+    # event volume dominates the matrix; past that, sort-unique touches
+    # O(E log E) regardless of V — the regime the sparse path exists for
+    if v2 <= min(4 * max(e_total, 1), 1 << 28):
+        upper = np.zeros(v2, dtype=np.int32)
+        for keys in _iter_pair_keys(
+            tids, starts, counts, n_tracks, event_chunk
+        ):
+            upper += np.bincount(keys, minlength=v2).astype(
+                np.int32, copy=False
+            )
+        u = upper.reshape(n_tracks, n_tracks)
+        out += u
+        out += u.T
+    else:
+        flat = out.reshape(-1)
+        for keys in _iter_pair_keys(
+            tids, starts, counts, n_tracks, event_chunk,
+            both_directions=True,
+        ):
+            uniq, cnt = np.unique(keys, return_counts=True)
+            flat[uniq] += cnt.astype(np.int32, copy=False)
+    return out
+
+
+def sparse_rule_rows(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    min_count: int,
+    k_max: int,
+    long_basket_threshold: int | None = None,
+    event_chunk: int = EVENT_CHUNK,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None:
+    """FULLY sparse count→emit: membership pairs straight to
+    ``(rule_ids, rule_counts, row_valid, item_counts)`` without ever
+    materializing the ``(V, V)`` count matrix — the matrix's only
+    consumer is a per-row threshold + top-k, and the sorted unique
+    (key, count) stream IS the matrix in CSR form. At large frequent
+    vocabularies this skips both the O(V²) memory and the O(V²)
+    emission sweep, which is where the dense-shaped paths (including
+    the native C sparse-scatter method, whose output is still the dense
+    matrix) spend most of their time.
+
+    Bit-identical to ``ops.rules.emit_rule_tensors`` by construction:
+    absent pairs count 0 < min_count (never emitted), the per-row
+    ordering is (count desc, column asc) — exactly ``lax.top_k``'s tie
+    order — via one lexsort over only the THRESHOLD SURVIVORS, and the
+    diagonal/item supports come from the same integer bincount.
+
+    Returns None when long baskets exist under the hybrid threshold:
+    their sub-count is a dense block, so the caller falls back to the
+    materialized-matrix route (still sparse counting, dense emission).
+    """
+    rows, tids = _sorted_by_playlist(playlist_rows, track_ids)
+    rule_ids = np.full((n_tracks, k_max), -1, dtype=np.int32)
+    rule_counts = np.zeros((n_tracks, k_max), dtype=np.int32)
+    if rows.size == 0:
+        return (
+            rule_ids, rule_counts,
+            np.zeros(n_tracks, dtype=np.int32),
+            np.zeros(n_tracks, dtype=np.int32),
+        )
+    starts, counts = _segments(rows)
+    thr = resolve_long_basket(long_basket_threshold)
+    if np.any(counts > thr):
+        return None
+    item_counts = np.bincount(
+        tids.astype(np.int64), minlength=n_tracks
+    ).astype(np.int32)
+    keys = [
+        k for k in _iter_pair_keys(
+            tids, starts, counts, n_tracks, event_chunk,
+            both_directions=True,
+        )
+    ]
+    if not keys:
+        return (
+            rule_ids, rule_counts,
+            np.zeros(n_tracks, dtype=np.int32), item_counts,
+        )
+    uq, ct = np.unique(np.concatenate(keys), return_counts=True)
+    del keys
+    keep = ct >= min_count
+    uq, ct = uq[keep], ct[keep].astype(np.int64)
+    v = np.int64(n_tracks)
+    r_surv = (uq.astype(np.int64) // v).astype(np.int32)
+    c_surv = (uq.astype(np.int64) - r_surv.astype(np.int64) * v).astype(
+        np.int32
+    )
+    row_valid = np.bincount(
+        r_surv.astype(np.int64), minlength=n_tracks
+    ).astype(np.int32)
+    # (row asc, count desc, col asc) — lax.top_k's exact tie order;
+    # survivors only, so this sort is tiny relative to the event stream
+    order = np.lexsort((c_surv, -ct, r_surv))
+    r_o = r_surv[order]
+    rank = np.arange(len(r_o), dtype=np.int64) - np.repeat(
+        np.concatenate([[0], np.cumsum(row_valid.astype(np.int64))[:-1]]),
+        row_valid,
+    )
+    sel = rank < k_max
+    rule_ids[r_o[sel], rank[sel]] = c_surv[order][sel]
+    rule_counts[r_o[sel], rank[sel]] = ct[order][sel].astype(np.int32)
+    return rule_ids, rule_counts, row_valid, item_counts
+
+
+def sparse_pair_counts_device(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    long_basket_threshold: int | None = None,
+    event_chunk: int = 1 << 20,
+):
+    """Device twin: the same event stream scatter-added on the default
+    backend → ``(V, V) int32`` jax array. Events are generated host-side
+    (they ARE the compressed representation — that's the point), padded
+    to fixed-size chunks so the jit shape set stays bounded, and
+    accumulated with integer ``.at[].add`` — exact in any order, so the
+    result is bit-identical to :func:`sparse_pair_counts_np`. The long-
+    basket block and the diagonal land host-side first (just more terms
+    of the integer sum)."""
+    import jax.numpy as jnp
+
+    thr = resolve_long_basket(long_basket_threshold)
+    rows, tids = _sorted_by_playlist(playlist_rows, track_ids)
+    base = np.zeros((n_tracks, n_tracks), dtype=np.int32)
+    if rows.size == 0:
+        return jnp.asarray(base)
+    starts, counts = _segments(rows)
+    rows, tids, starts, counts, lrows, ltids = _split_long(
+        rows, tids, starts, counts, thr
+    )
+    if tids.size:
+        base[np.diag_indices(n_tracks)] += np.bincount(
+            tids.astype(np.int64), minlength=n_tracks
+        ).astype(np.int32, copy=False)
+    if lrows.size:
+        base += _count_long_dense(lrows, ltids, n_tracks)
+    upper = jnp.zeros(n_tracks * n_tracks, dtype=jnp.int32)
+    for keys in _iter_pair_keys(tids, starts, counts, n_tracks, event_chunk):
+        pad = event_chunk - (len(keys) % event_chunk or event_chunk)
+        padded = np.concatenate(
+            [keys.astype(np.int64), np.full(pad, -1, np.int64)]
+        )
+        for c0 in range(0, len(padded), event_chunk):
+            upper = _scatter_events(
+                upper, jnp.asarray(padded[c0 : c0 + event_chunk])
+            )
+    u = upper.reshape(n_tracks, n_tracks)
+    return u + u.T + jnp.asarray(base)
+
+
+_scatter_step = None
+
+
+def _scatter_events(flat, keys):
+    """One fixed-shape scatter-add chunk: ``keys`` are flat ``i·V + j``
+    event indices, -1 = padding (dropped via a sentinel row). The jitted
+    step lives at module scope (built once, lazily — this module must
+    import without jax work), so the jit cache genuinely keys on the
+    (flat size, chunk shape) pair instead of recompiling per call."""
+    global _scatter_step
+    if _scatter_step is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(flat, keys):
+            n = flat.shape[0]
+            valid = keys >= 0
+            idx = jnp.where(valid, keys, n)
+            grown = jnp.concatenate([flat, jnp.zeros((1,), flat.dtype)])
+            grown = grown.at[idx].add(valid.astype(flat.dtype))
+            return grown[:n]
+
+        _scatter_step = step
+    return _scatter_step(flat, keys)
+
+
+def sparse_restricted_pair_counts_np(
+    playlist_rows: np.ndarray,
+    track_ids: np.ndarray,
+    row_ids: np.ndarray,
+    *,
+    n_playlists: int,
+    n_tracks: int,
+    event_chunk: int = EVENT_CHUNK,
+) -> np.ndarray:
+    """Rows ``row_ids`` of ``C = XᵀX`` → ``(R, V) int32`` — the sparse
+    twin of the delta recount (``parallel.support.restricted_pair_counts``):
+    only baskets containing a requested antecedent generate events, and
+    each generates ``hits_b · k_b`` of them instead of the dense path's
+    full ``P × R`` contraction. Bit-identical (integer accumulation)."""
+    row_ids = np.asarray(row_ids, dtype=np.int64)
+    r = len(row_ids)
+    out = np.zeros((r, n_tracks), dtype=np.int32)
+    if r == 0:
+        return out
+    rank = np.full(n_tracks, -1, dtype=np.int64)
+    rank[row_ids] = np.arange(r, dtype=np.int64)
+    rows, tids = _sorted_by_playlist(playlist_rows, track_ids)
+    if rows.size == 0:
+        return out
+    starts, counts = _segments(rows)
+    # per-element basket handle: which segment each membership row lives in
+    seg_of = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    hits = np.flatnonzero(rank[tids] >= 0)  # membership rows that are antecedents
+    if hits.size == 0:
+        return out
+    v = np.int64(n_tracks)
+    rep_all = counts[seg_of[hits]]
+    cum = np.cumsum(rep_all)
+    lo = 0
+    n_hits = len(hits)
+    while lo < n_hits:
+        target = (cum[lo - 1] if lo else 0) + event_chunk
+        hi = int(np.searchsorted(cum, target, side="left")) + 1
+        hi = min(max(hi, lo + 1), n_hits)
+        h = hits[lo:hi]
+        rep = rep_all[lo:hi]
+        n_events = int(rep.sum())
+        off = np.concatenate([[0], np.cumsum(rep[:-1])])
+        within = np.arange(n_events, dtype=np.int64) - np.repeat(off, rep)
+        left = np.repeat(rank[tids[h]], rep)
+        right = tids[np.repeat(starts[seg_of[h]], rep) + within]
+        out += np.bincount(
+            left * v + right, minlength=r * n_tracks
+        ).reshape(r, n_tracks).astype(np.int32, copy=False)
+        lo = hi
+    return out

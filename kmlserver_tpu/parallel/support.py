@@ -159,9 +159,10 @@ def sharded_bitpack_pair_counts(
     # (the ONE copy of that gating)
     dp = mesh.shape[AXIS_DP]
     v = baskets.n_tracks
-    v_pad = round_up(max(v, pc.V_TILE), pc.V_TILE)
+    vt = pc.v_tile()
+    v_pad = round_up(max(v, vt), vt)
     w_total = round_up(
-        (baskets.n_playlists + 31) // 32, dp * pc.WORD_CHUNK
+        (baskets.n_playlists + 31) // 32, dp * pc.word_chunk()
     )
     build = jax.jit(
         lambda pr, ti: pc.bitpack_by_track(
@@ -339,7 +340,8 @@ def _restricted_counts_fn(mesh: Mesh):
 
 
 def restricted_pair_counts(
-    baskets: Baskets, row_ids, mesh: "Mesh | None" = None
+    baskets: Baskets, row_ids, mesh: "Mesh | None" = None,
+    count_path: str | None = None,
 ):
     """Rows ``row_ids`` of the pair-count matrix ``C = XᵀX`` → host
     ``(R, V) int32`` — the delta-mining recount (freshness/delta.py):
@@ -347,7 +349,15 @@ def restricted_pair_counts(
     baskets, so each returned row is bit-identical to the corresponding
     row of the full count matrix. With ``mesh`` the one-hot rides the
     same ``P('dp','tp')`` layout as the full sharded count path; without
-    one it is a single jit over the dense encode."""
+    one it is a single jit over the dense encode.
+
+    ``count_path="sparse"`` (the freshness route consults the SAME
+    measured dispatcher as the full mine — mining/dispatch.py — so a
+    sparse-eligible delta never silently pays the dense recount) expands
+    only the baskets that contain a requested antecedent
+    (ops/sparse.py); exact integer accumulation keeps every row
+    bit-identical to the dense contraction, mesh or not — and since no
+    one-hot is built at all, the mesh adds nothing it needs."""
     import numpy as _np
 
     row_ids = _np.asarray(row_ids, dtype=_np.int32)
@@ -356,6 +366,13 @@ def restricted_pair_counts(
         return _np.zeros((0, v), dtype=_np.int32)
     if _np.any(row_ids < 0) or _np.any(row_ids >= v):
         raise ValueError(f"row_ids outside the vocabulary (V={v})")
+    if count_path == "sparse":
+        from ..ops import sparse as sparse_mod
+
+        return sparse_mod.sparse_restricted_pair_counts_np(
+            baskets.playlist_rows, baskets.track_ids, row_ids,
+            n_playlists=baskets.n_playlists, n_tracks=v,
+        )
     if mesh is None:
         # small-work host path: a delta job is a COLD process, and a jit
         # compile (~0.3 s) would dwarf a thin row-slice recount — scatter
@@ -379,6 +396,51 @@ def restricted_pair_counts(
     x = _onehot_padded(baskets, p_pad, v_pad, mesh)
     counts = _restricted_counts_fn(mesh)(x, jnp.asarray(row_ids))
     return _np.asarray(jax.device_get(counts))[:, :v]
+
+
+def sparse_sharded_rule_tensors(
+    baskets: Baskets,
+    mesh: Mesh,
+    min_count: int,
+    k_max: int,
+    long_basket_threshold: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The SPARSE count feeding the SAME vocab-sharded emission: counts
+    come from the CSR×bitpacked hybrid (ops/sparse.py — only the nnz
+    membership pairs are ever touched; the ``(P, V)`` one-hot never
+    exists in any layout), then ride ``P(None, 'tp')`` into the exact
+    per-shard emission kernel the dense sharded path uses
+    (:func:`_sharded_emit_fn`), so the emitted tensors are bit-identical
+    to every other path by construction. What the sharded layout buys
+    here is the EMISSION memory shape (each device holds only its
+    ``C[:, lo:hi]`` block and emits its own antecedent rows); what the
+    sparse count buys is skipping the dense/bitpack count FLOPs — the
+    two compose."""
+    import numpy as np
+
+    from ..ops import sparse as sparse_mod
+
+    tp = mesh.shape[AXIS_TP]
+    v = baskets.n_tracks
+    v_pad = round_up(max(v, 1), tp)
+    counts_np = sparse_mod.sparse_pair_counts_np(
+        baskets.playlist_rows, baskets.track_ids,
+        n_playlists=baskets.n_playlists, n_tracks=v,
+        long_basket_threshold=long_basket_threshold,
+    )
+    if v_pad != v:
+        counts_np = np.pad(counts_np, ((0, v_pad - v), (0, v_pad - v)))
+    counts = jax.device_put(
+        counts_np, NamedSharding(mesh, P(None, AXIS_TP))
+    )
+    emitted = _sharded_emit_fn(mesh, k_max)(counts, jnp.int32(min_count))
+    rule_ids, rule_counts, row_valid, item_counts = jax.device_get(emitted)
+    return (
+        np.asarray(rule_ids[:v]),
+        np.asarray(rule_counts[:v]),
+        np.asarray(row_valid[:v]),
+        np.asarray(item_counts[:v]),
+    )
 
 
 def sharded_rule_tensors(

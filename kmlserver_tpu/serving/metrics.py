@@ -165,6 +165,10 @@ METRIC_REGISTRY: dict[str, str] = {
     # the mined shape — what the phase's dominant kernel moved/computed
     "kmls_job_phase_flops": "gauge:mining",
     "kmls_job_phase_bytes_moved": "gauge:mining",
+    # sparsity-adaptive dispatch (ISSUE 13): which pair-count family the
+    # measured dispatcher chose for this generation, labeled
+    # {path, source} — value is always 1 (an info-style gauge)
+    "kmls_job_count_path": "gauge:mining",
 }
 
 # The autoscaling signal (ISSUE 8): the gauge kubernetes/hpa.yaml scales

@@ -60,8 +60,9 @@ interpret = dev.platform != "tpu"
 if interpret and not allow_interpret:
     print("SKIP: not a TPU backend", file=sys.stderr)
     sys.exit(3)
+_ti, _tj, _wk = pc.resolve_tiles()
 print(f"device: {dev.platform} ({dev.device_kind}) tiles "
-      f"{pc.TILE_I}x{pc.TILE_J}x{pc.WORD_CHUNK}", file=sys.stderr, flush=True)
+      f"{_ti}x{_tj}x{_wk}", file=sys.stderr, flush=True)
 
 baskets = synthetic_baskets(
     n_playlists=n_playlists, n_tracks=n_tracks, target_rows=target_rows,

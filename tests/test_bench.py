@@ -324,6 +324,21 @@ class TestTpuSuiteWiring:
             "bitset_gib": 9.5, "workload_model": "bernoulli-zipf",
             "rows_measured": 450_000_000,
         },
+        # before "scale": the prefix match must hit the sparse bracket's
+        # own canned result, not fall through to the scale one
+        "scale-sparse": {
+            "identical": True, "headline_identical": True,
+            "shape": "1500000x40000", "rows": 6000000,
+            "density": 0.0001, "auto_path": "sparse",
+            "auto_source": "table", "auto_path_dense_regime": "dense",
+            "table_cell": "d0:e3", "sparse_mine_s": 2.53,
+            "sparse_rows_per_s": 2367872.0, "count_path": "sparse-hybrid",
+            "frequent_items": 39862, "native_mine_s": 18.38,
+            "native_rows_per_s": 326448.0,
+            "native_count_path": "native-cpu", "speedup_vs_native": 7.27,
+            "table_points": 13, "table_cells": 11,
+            "sweep_identical": True, "platform": "cpu",
+        },
         "scale": {
             "mine_s": 20.0, "rows_per_s": 2.5e6, "frequent_items": 5069,
             "auto_mine_s": 12.0, "auto_path": "dense-fused",
@@ -963,7 +978,7 @@ class TestBenchStateResume:
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
             "loadshape_cpu", "mine_resume_cpu", "als_hybrid_cpu",
-            "confserve_cpu",
+            "confserve_cpu", "scale_sparse_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -1381,6 +1396,54 @@ class TestCompactLine:
         assert parsed["costattrib_mfu"] == pytest.approx(7.216e-05)
         assert parsed["costattrib_compiles"] == 0
         assert parsed["costattrib_obs_off"] == 0
+
+    def test_record_scale_sparse_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-13 sparsity bracket's judged keys (≥5x over the
+        native record path on the SAME ≥99%-sparse workload, every route
+        bit-identical, the auto dispatch resolving from the measured
+        table) must land in the compact line without regressing the
+        ≤1,800 budget."""
+        canned = {
+            "identical": True, "headline_identical": True,
+            "shape": "1500000x40000", "rows": 6000000,
+            "density": 0.0001, "auto_path": "sparse",
+            "auto_source": "table", "auto_path_dense_regime": "dense",
+            "table_cell": "d0:e3",
+            "sparse_mine_s": 2.53, "sparse_rows_per_s": 2367872.0,
+            "count_path": "sparse-hybrid", "frequent_items": 39862,
+            "native_mine_s": 18.38, "native_rows_per_s": 326448.0,
+            "native_count_path": "native-cpu",
+            "speedup_vs_native": 7.27,
+            "table_points": 13, "table_cells": 11,
+            "sweep_identical": True, "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_scale_sparse(result)
+        assert result["sparse_speedup_vs_native"] == 7.27
+        assert result["sparse_identical"] is True
+        assert result["sparse_headline_identical"] is True
+        assert result["sparse_auto_path"] == "sparse"
+        assert result["sparse_auto_source"] == "table"
+        assert result["sparse_count_path"] == "sparse-hybrid"
+        # only the judged claims ride the compact line (the TPU-suite
+        # line is at capacity; rows/s + shape/table detail is
+        # sidecar-only, the freshness/traceoverhead precedent)
+        for key in ("sparse_speedup_vs_native", "sparse_identical",
+                    "sparse_headline_identical", "sparse_density",
+                    "sparse_auto_path", "sparse_auto_source"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["sparse_speedup_vs_native"] == 7.27
+        assert parsed["sparse_identical"] is True
+        assert parsed["sparse_auto_path"] == "sparse"
 
     def test_record_mine_resume_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-4 interruption bracket's keys must land in the

@@ -164,6 +164,40 @@ class TestDeltaBitIdentity:
                       ["s003", "s004", "s005"], ["__unknown__"]):
             assert engine.recommend(seeds) == full.recommend(seeds)
 
+    def test_delta_chain_sparse_recount_equals_full_remine(
+        self, tmp_path, rng, delta_pvc
+    ):
+        """ISSUE 13: the delta recount routed through the SPARSE family
+        (KMLS_COUNT_PATH=sparse → parallel/support.restricted_pair_counts
+        takes the event-expansion twin) must keep base ∘ chain
+        bit-identical to a full re-mine — tensors AND answers. The
+        count-path knob is dispatch, not semantics, so the delta stays
+        ELIGIBLE across the flip (same config fingerprint)."""
+        mining_cfg, serving_cfg, csv_path = delta_pvc
+        sparse_cfg = dataclasses.replace(mining_cfg, count_path="sparse")
+        engine = RecommendEngine(serving_cfg)
+        assert engine.load()
+
+        _append_rows(csv_path, [(3, "s000"), (3, "zz_new"), (81, "s001"),
+                                (81, "s002"), (81, "zz_new")])
+        s1 = run_mining_job(sparse_cfg)
+        assert s1.delta_seq == 1
+        assert engine.apply_pending_deltas() == 1
+
+        _append_rows(csv_path, [(82, "s000"), (82, "s001"), (82, "s003"),
+                                (83, "s004"), (83, "zz_new")])
+        s2 = run_mining_job(sparse_cfg)
+        assert s2.delta_seq == 2
+        assert engine.apply_pending_deltas() == 1
+
+        # the full re-mine deliberately keeps the DEFAULT dispatch — the
+        # identity must hold across families, not just within one
+        full = _fresh_full_remine(tmp_path, csv_path, mining_cfg)
+        _assert_bundles_identical(engine.bundle, full.bundle)
+        for seeds in (["s000"], ["s001", "s002"], ["zz_new"],
+                      ["s003", "s004"], ["__unknown__"]):
+            assert engine.recommend(seeds) == full.recommend(seeds)
+
     def test_delta_with_pruning_and_tombstones(self, tmp_path, rng):
         """Apriori pruning active (vocab > threshold): a marginal track
         at exactly min_count drops out when appended playlists raise the

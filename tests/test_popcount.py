@@ -96,16 +96,17 @@ def test_padded_entry_rejects_misaligned_shapes():
     import jax.numpy as jnp
 
     from kmlserver_tpu.ops.popcount import (
-        WORD_CHUNK, popcount_pair_counts_padded,
+        popcount_pair_counts_padded, word_chunk,
     )
 
+    wk = word_chunk()
     with pytest.raises(ValueError, match="truncating grid"):
         popcount_pair_counts_padded(
-            jnp.zeros((120, WORD_CHUNK), jnp.uint32), interpret=True
+            jnp.zeros((120, wk), jnp.uint32), interpret=True
         )
     with pytest.raises(ValueError, match="truncating grid"):
         popcount_pair_counts_padded(
-            jnp.zeros((128, WORD_CHUNK - 12), jnp.uint32), interpret=True
+            jnp.zeros((128, wk - 12), jnp.uint32), interpret=True
         )
 
 

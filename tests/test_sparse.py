@@ -1,0 +1,659 @@
+"""Sparsity-adaptive kernels + measured dispatch (ISSUE 13).
+
+The load-bearing contract is BIT-IDENTITY: the sparse CSR×bitpacked
+hybrid — host, device, fully-sparse emission, and vocab-sharded — must
+produce the same counts and the same emitted rule tensors as the dense
+and bit-packed families at every density, in both layouts. On top of
+that: the dispatcher's resolution order (override → threshold → table →
+heuristic) with its fail-safe directions, the sparse ALS storage's
+determinism and its now-trains-past-the-dense-guard behavior, and the
+popcount tile knobs' lazy (kernel-build-time) env reads.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.data.synthetic import synthetic_baskets
+from kmlserver_tpu.mining import als
+from kmlserver_tpu.mining import dispatch as dispatch_mod
+from kmlserver_tpu.mining.miner import mine
+from kmlserver_tpu.ops import encode, sparse, support
+
+DENSITIES = (0.05, 0.01, 0.002, 0.0005)
+
+
+def _dense_counts(baskets):
+    x = encode.onehot_matrix(
+        jnp.asarray(baskets.playlist_rows),
+        jnp.asarray(baskets.track_ids),
+        n_playlists=baskets.n_playlists,
+        n_tracks=baskets.n_tracks,
+    )
+    return np.asarray(support.pair_counts(x))
+
+
+def _tensors_equal(a, b):
+    return (
+        np.array_equal(a.rule_ids, b.rule_ids)
+        and np.array_equal(a.rule_counts, b.rule_counts)
+        and np.array_equal(a.item_counts, b.item_counts)
+        and np.array_equal(a.row_valid_counts, b.row_valid_counts)
+        and a.n_frequent_items == b.n_frequent_items
+        and a.overflow_rows == b.overflow_rows
+    )
+
+
+# ---------------------------------------------------------------------------
+# count-level bit-identity
+# ---------------------------------------------------------------------------
+
+
+class TestSparseCounts:
+    @pytest.mark.parametrize("density", DENSITIES)
+    def test_counts_bit_identical_across_densities(self, density):
+        p, v = 1500, 400
+        baskets = synthetic_baskets(
+            n_playlists=p, n_tracks=v,
+            target_rows=max(int(density * p * v), 32), seed=17,
+        )
+        dense = _dense_counts(baskets)
+        host = sparse.sparse_pair_counts_np(
+            baskets.playlist_rows, baskets.track_ids,
+            n_playlists=p, n_tracks=v,
+        )
+        dev = np.asarray(
+            sparse.sparse_pair_counts_device(
+                baskets.playlist_rows, baskets.track_ids,
+                n_playlists=p, n_tracks=v, event_chunk=4096,
+            )
+        )
+        np.testing.assert_array_equal(dense, host)
+        np.testing.assert_array_equal(dense, dev)
+
+    def test_long_basket_hybrid_split_is_exact(self):
+        """Forcing most baskets through the gathered dense/native
+        sub-count (threshold 3) must not change a single count — the
+        split point is performance, never results."""
+        baskets = synthetic_baskets(
+            n_playlists=400, n_tracks=120, target_rows=4000, seed=5
+        )
+        dense = _dense_counts(baskets)
+        for thr in (3, 7, 10_000):
+            got = sparse.sparse_pair_counts_np(
+                baskets.playlist_rows, baskets.track_ids,
+                n_playlists=400, n_tracks=120, long_basket_threshold=thr,
+            )
+            np.testing.assert_array_equal(dense, got)
+
+    def test_unsorted_and_empty_inputs(self):
+        baskets = synthetic_baskets(
+            n_playlists=200, n_tracks=60, target_rows=1200, seed=9
+        )
+        perm = np.random.default_rng(1).permutation(
+            len(baskets.playlist_rows)
+        )
+        got = sparse.sparse_pair_counts_np(
+            baskets.playlist_rows[perm], baskets.track_ids[perm],
+            n_playlists=200, n_tracks=60,
+        )
+        np.testing.assert_array_equal(_dense_counts(baskets), got)
+        empty = sparse.sparse_pair_counts_np(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            n_playlists=0, n_tracks=8,
+        )
+        np.testing.assert_array_equal(empty, np.zeros((8, 8), np.int32))
+
+    def test_restricted_rows_match_full_matrix(self, rng):
+        baskets = synthetic_baskets(
+            n_playlists=500, n_tracks=150, target_rows=3000, seed=3
+        )
+        dense = _dense_counts(baskets)
+        for row_ids in ([0], [149], [5, 17, 88, 149], list(range(150))):
+            got = sparse.sparse_restricted_pair_counts_np(
+                baskets.playlist_rows, baskets.track_ids,
+                np.asarray(row_ids, np.int64),
+                n_playlists=500, n_tracks=150,
+            )
+            np.testing.assert_array_equal(dense[np.asarray(row_ids)], got)
+
+    def test_pair_event_count_is_exact(self):
+        baskets = synthetic_baskets(
+            n_playlists=300, n_tracks=90, target_rows=2500, seed=2
+        )
+        lengths = np.bincount(baskets.playlist_rows, minlength=300)
+        expect = int(np.sum(lengths * (lengths - 1) // 2))
+        events, long_rows = sparse.pair_event_count(
+            baskets.playlist_rows, 300, 10_000
+        )
+        assert events == expect
+        assert long_rows == 0
+        thr = int(lengths.max()) - 1
+        events2, long_rows2 = sparse.pair_event_count(
+            baskets.playlist_rows, 300, thr
+        )
+        assert long_rows2 == int(lengths[lengths > thr].sum())
+        assert events2 < expect
+
+
+# ---------------------------------------------------------------------------
+# emission-level bit-identity (tensors AND rules), both layouts
+# ---------------------------------------------------------------------------
+
+
+class TestSparseEmission:
+    @pytest.mark.parametrize("density", DENSITIES)
+    def test_mined_tensors_identical_replicated(self, density):
+        p, v = 1200, 300
+        baskets = synthetic_baskets(
+            n_playlists=p, n_tracks=v,
+            target_rows=max(int(density * p * v), 32), seed=11,
+        )
+        cfg = MiningConfig(min_support=2.0 / p, k_max_consequents=16)
+        reference = mine(baskets, cfg)  # native-cpu / dense default
+        for kw in (
+            dict(count_path="sparse"),
+            dict(count_path="bitpack"),
+            dict(count_path="dense", native_cpu_pair_counts=False),
+        ):
+            got = mine(baskets, dataclasses.replace(cfg, **kw))
+            assert _tensors_equal(reference.tensors, got.tensors), kw
+
+    @pytest.mark.parametrize("density", DENSITIES)
+    def test_mined_tensors_identical_sharded(self, density):
+        p, v = 800, 240
+        baskets = synthetic_baskets(
+            n_playlists=p, n_tracks=v,
+            target_rows=max(int(density * p * v), 32), seed=19,
+        )
+        from kmlserver_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+        cfg = MiningConfig(min_support=2.0 / p, k_max_consequents=16)
+        reference = mine(baskets, cfg)
+        sharded_sparse = mine(
+            baskets,
+            dataclasses.replace(
+                cfg, count_path="sparse", model_layout="sharded"
+            ),
+            mesh=mesh,
+        )
+        assert sharded_sparse.count_path == "sparse-sharded"
+        assert _tensors_equal(reference.tensors, sharded_sparse.tensors)
+
+    def test_sparse_rule_rows_tie_order_matches_lax_top_k(self):
+        """Hand-built ties: equal counts must rank by ascending column,
+        exactly lax.top_k's order — the emit_rule_rows contract every
+        family shares."""
+        # three playlists over 5 tracks engineered so row 0 has ties:
+        # pairs (0,1)=2, (0,2)=2, (0,3)=1, (0,4)=1
+        rows = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2], np.int32)
+        tids = np.array([0, 1, 2, 0, 1, 3, 0, 2, 4], np.int32)
+        emitted = sparse.sparse_rule_rows(
+            rows, tids, n_playlists=3, n_tracks=5, min_count=1, k_max=3
+        )
+        assert emitted is not None
+        rule_ids, rule_counts, row_valid, item_counts = emitted
+        from kmlserver_tpu.ops import rules as rules_mod
+
+        x = encode.onehot_matrix(
+            jnp.asarray(rows), jnp.asarray(tids), n_playlists=3, n_tracks=5
+        )
+        ref_ids, ref_counts, ref_valid = jax.device_get(
+            rules_mod.emit_rule_tensors(
+                support.pair_counts(x), jnp.int32(1), k_max=3
+            )
+        )
+        np.testing.assert_array_equal(rule_ids, ref_ids)
+        np.testing.assert_array_equal(rule_counts, ref_counts)
+        np.testing.assert_array_equal(row_valid, ref_valid)
+        np.testing.assert_array_equal(
+            item_counts, np.asarray([3, 2, 2, 1, 1], np.int32)
+        )
+
+    def test_sparse_rule_rows_declines_long_baskets(self):
+        rows = np.repeat(np.arange(2, dtype=np.int32), 8)
+        tids = np.tile(np.arange(8, dtype=np.int32), 2)
+        assert (
+            sparse.sparse_rule_rows(
+                rows, tids, n_playlists=2, n_tracks=8,
+                min_count=1, k_max=4, long_basket_threshold=4,
+            )
+            is None
+        )
+
+
+# ---------------------------------------------------------------------------
+# the measured dispatcher
+# ---------------------------------------------------------------------------
+
+
+class TestDispatcher:
+    def _cfg(self, **kw):
+        return dataclasses.replace(MiningConfig(), **kw)
+
+    def test_override_pins_each_family(self):
+        baskets = synthetic_baskets(
+            n_playlists=500, n_tracks=100, target_rows=2000, seed=1
+        )
+        for path in dispatch_mod.PATHS:
+            plan = dispatch_mod.plan_count_path(
+                self._cfg(count_path=path), 500, 100, 2000,
+                backend="cpu", baskets=baskets,
+            )
+            assert (plan.path, plan.source) == (path, "override")
+
+    def test_unrecognized_override_fails_safe_to_auto(self):
+        """A typo must behave EXACTLY like auto — the current behavior —
+        not silently pick some family."""
+        baskets = synthetic_baskets(
+            n_playlists=500, n_tracks=100, target_rows=2000, seed=1
+        )
+        auto = dispatch_mod.plan_count_path(
+            self._cfg(), 500, 100, 2000, backend="cpu", baskets=baskets
+        )
+        bogus = dispatch_mod.plan_count_path(
+            self._cfg(count_path="sprase"), 500, 100, 2000,
+            backend="cpu", baskets=baskets,
+        )
+        assert (bogus.path, bogus.source) == (auto.path, auto.source)
+
+    def test_explicit_threshold_bypasses_the_table(self):
+        """The historical contract: an int element count (or none) pins
+        dense-vs-bitpack no matter what the table says."""
+        plan = dispatch_mod.plan_count_path(
+            self._cfg(bitpack_threshold_elems=1), 500, 100, 2000,
+            backend="cpu",
+        )
+        assert (plan.path, plan.source) == ("bitpack", "threshold")
+        plan = dispatch_mod.plan_count_path(
+            self._cfg(bitpack_threshold_elems=None), 500, 100, 2000,
+            backend="cpu",
+        )
+        assert (plan.path, plan.source) == ("dense", "threshold")
+
+    def test_table_cell_lookup_and_feasibility(self):
+        table = {
+            "version": 1,
+            "backends": {
+                "cpu": {
+                    "cells": {
+                        dispatch_mod.table_cell(0.0004, 10_000_000): {
+                            "path": "sparse"
+                        },
+                    }
+                }
+            },
+        }
+        baskets = synthetic_baskets(
+            n_playlists=5000, n_tracks=2000, target_rows=4000, seed=4
+        )
+        plan = dispatch_mod.plan_count_path(
+            self._cfg(), 5000, 2000, 4000,
+            backend="cpu", baskets=baskets, table=table,
+        )
+        assert (plan.path, plan.source) == ("sparse", "table")
+        # same cell, but sparse infeasible (no event measurement) →
+        # heuristic fallback
+        plan = dispatch_mod.plan_count_path(
+            self._cfg(), 5000, 2000, 4000, backend="cpu", table=table
+        )
+        assert plan.source == "heuristic"
+
+    def test_heuristic_prefers_sparse_when_nothing_dense_fits(self):
+        """The new capability: neither the dense one-hot nor the bitpack
+        slab fits the budget, the sparse form does → sparse, not a march
+        into an allocator failure."""
+        p, v = 2_000_000, 8_000
+        baskets = synthetic_baskets(
+            n_playlists=2000, n_tracks=600, target_rows=8000, seed=6
+        )
+        # fake the big shape but measure events on the small baskets —
+        # the plan only needs nnz/pair events, not the full workload
+        events, _ = sparse.pair_event_count(baskets.playlist_rows, 2000)
+        cfg = self._cfg(hbm_budget_bytes=2 << 30)
+        plan = dispatch_mod.plan_count_path(
+            cfg, p, v, 8000, backend="cpu", baskets=baskets, table={}
+        )
+        assert plan.path == "sparse"
+        assert plan.source == "heuristic"
+        assert plan.pair_events == events
+
+    def test_sparse_feasibility_charges_the_matrix_off_cpu(self):
+        """Non-CPU backends dispatch the device scatter-add twin, which
+        MATERIALIZES the (V, V) counts — feasibility must charge it
+        there (and on the long-basket fallback), and charge only the
+        event stream on the fully-sparse CPU route."""
+        v, events, budget = 200_000, 1_000_000, 12 << 30
+        assert dispatch_mod.sparse_feasible(v, events, budget, 0, 64)
+        assert not dispatch_mod.sparse_feasible(
+            v, events, budget, 0, 64, backend="tpu"
+        )
+        assert not dispatch_mod.sparse_feasible(
+            v, events, budget, long_rows=500, k_max=64
+        )
+
+    def test_census_override_is_loud_and_truthfully_sourced(self, capsys):
+        """An explicit sparse pin on a census-enabled job cannot run
+        sparse (the census needs device intermediates) — the drop must
+        print a NOTE and the telemetry source must say census-override,
+        never claim the override decided the path that ran."""
+        baskets = synthetic_baskets(
+            n_playlists=400, n_tracks=120, target_rows=2000, seed=3
+        )
+        res = mine(
+            baskets,
+            MiningConfig(
+                min_support=0.01, count_path="sparse", max_itemset_len=3
+            ),
+        )
+        assert not (res.count_path or "").startswith("sparse")
+        assert res.count_path_source == "census-override"
+        assert "sparse decision is overridden" in capsys.readouterr().out
+
+    def test_packaged_table_routes_production_density_to_sparse(self):
+        """The shipped bench-banked table must route a ≥99%-sparse
+        mid-size workload to the sparse family on cpu, and a dense toy
+        workload to dense — the two directions the CI smoke pins."""
+        table = dispatch_mod.load_table()
+        assert table is not None, "packaged dispatch_table.json missing"
+        baskets = synthetic_baskets(
+            n_playlists=60000, n_tracks=8000, target_rows=120000, seed=8
+        )
+        plan = dispatch_mod.plan_count_path(
+            self._cfg(), 60000, 8000, len(baskets.playlist_rows),
+            backend="cpu", baskets=baskets, table=table,
+        )
+        assert (plan.path, plan.source) == ("sparse", "table")
+        dense_plan = dispatch_mod.plan_count_path(
+            self._cfg(), 4000, 1000, 200000, backend="cpu", table=table
+        )
+        assert dense_plan.path == "dense"
+
+    def test_invalid_table_file_degrades_to_heuristic(self, tmp_path):
+        bad = tmp_path / "table.json"
+        bad.write_text("{not json")
+        assert dispatch_mod.load_table(str(bad)) is None
+        missing = dispatch_mod.load_table(str(tmp_path / "nope.json"))
+        assert missing is None
+
+    def test_table_roundtrip_from_sweep_records(self, tmp_path):
+        records = [
+            {
+                "density": 0.0004, "elems": 40_000_000, "rows": 16000,
+                "shape": "20000x2000", "dense_s": None,
+                "bitpack_s": 0.7, "sparse_s": 0.004, "identical": True,
+            },
+            {
+                "density": 0.05, "elems": 4_000_000, "rows": 200000,
+                "shape": "4000x1000", "dense_s": 0.05,
+                "bitpack_s": 0.2, "sparse_s": 0.4, "identical": True,
+            },
+        ]
+        table = dispatch_mod.table_from_records(
+            records, "cpu", measured_on="test/host", banked_at=123.0
+        )
+        path = tmp_path / "t.json"
+        dispatch_mod.save_table(str(path), table)
+        loaded = dispatch_mod.load_table(str(path))
+        cells = loaded["backends"]["cpu"]["cells"]
+        assert cells[dispatch_mod.table_cell(0.0004, 40_000_000)][
+            "path"
+        ] == "sparse"
+        assert cells[dispatch_mod.table_cell(0.05, 4_000_000)][
+            "path"
+        ] == "dense"
+        # merge: a newer sweep overwrites its cells, keeps the others
+        table2 = dispatch_mod.table_from_records(
+            [dict(records[1], dense_s=9.0)], "cpu",
+            measured_on="test/host", banked_at=456.0, base=loaded,
+        )
+        cells2 = table2["backends"]["cpu"]["cells"]
+        assert cells2[dispatch_mod.table_cell(0.0004, 40_000_000)][
+            "path"
+        ] == "sparse"
+        assert cells2[dispatch_mod.table_cell(0.05, 4_000_000)][
+            "path"
+        ] == "bitpack"
+
+    def test_miner_surfaces_plan_provenance(self):
+        baskets = synthetic_baskets(
+            n_playlists=400, n_tracks=120, target_rows=2000, seed=3
+        )
+        res = mine(
+            baskets, MiningConfig(min_support=0.01, count_path="sparse")
+        )
+        assert res.count_path == "sparse-hybrid"
+        assert res.count_path_source == "override"
+        assert res.sparse_events is not None and res.sparse_events > 0
+
+
+# ---------------------------------------------------------------------------
+# sparse ALS
+# ---------------------------------------------------------------------------
+
+
+class TestSparseALS:
+    def _baskets(self):
+        return synthetic_baskets(
+            n_playlists=400, n_tracks=250, target_rows=4000, seed=4
+        )
+
+    def test_deterministic_and_close_to_dense(self):
+        b = self._baskets()
+        cfg = MiningConfig(als_rank=8, als_iters=4)
+        dense = als.train_embeddings(b, cfg)
+        s1 = als.train_embeddings(
+            b, dataclasses.replace(cfg, als_sparse="always")
+        )
+        s2 = als.train_embeddings(
+            b, dataclasses.replace(cfg, als_sparse="always")
+        )
+        assert s1["storage"] == "sparse" and dense["storage"] == "dense"
+        np.testing.assert_array_equal(
+            s1["item_factors"], s2["item_factors"]
+        )
+        assert np.allclose(
+            s1["item_factors"], dense["item_factors"], atol=1e-3
+        )
+        assert s1["final_loss"] == pytest.approx(
+            dense["final_loss"], rel=1e-3
+        )
+
+    def test_guard_skips_with_never_and_trains_with_auto(self):
+        """THE acceptance pin: a shape whose dense interaction matrix
+        busts the HBM guard (skipped today) now trains under auto via
+        the nnz-proportional storage; `never` preserves the old skip."""
+        b = self._baskets()
+        p, v, rank = b.n_playlists, b.n_tracks, 8
+        dense_bytes = 5 * p * v + 8 * rank * (p + v)
+        sparse_bytes = als.sparse_als_bytes(
+            len(b.playlist_rows), p, v, rank
+        )
+        budget = (dense_bytes + sparse_bytes) // 2
+        assert sparse_bytes < budget < dense_bytes
+        tiny = MiningConfig(
+            als_rank=rank, als_iters=2, hbm_budget_bytes=budget
+        )
+        skipped = als.train_embeddings(
+            b, dataclasses.replace(tiny, als_sparse="never")
+        )
+        assert skipped["item_factors"] is None
+        assert "KMLS_ALS_SPARSE=never" in skipped["skipped"]
+        trained = als.train_embeddings(b, tiny)  # auto (default)
+        assert trained["item_factors"] is not None
+        assert trained["storage"] == "sparse"
+        # and even sparse over budget still skips, loudly
+        skip2 = als.train_embeddings(
+            b, dataclasses.replace(tiny, hbm_budget_bytes=1000)
+        )
+        assert skip2["item_factors"] is None
+        assert "also over budget" in skip2["skipped"]
+
+    def test_always_over_budget_skips_instead_of_oom_or_dense(self):
+        """A pinned compressed form past the budget must take the same
+        deterministic loud skip as dense — training dense would silently
+        change the factors the pin fixes, proceeding would OOM after the
+        mine."""
+        b = self._baskets()
+        got = als.train_embeddings(
+            b,
+            MiningConfig(
+                als_rank=8, als_iters=2, als_sparse="always",
+                hbm_budget_bytes=1000,
+            ),
+        )
+        assert got["item_factors"] is None
+        assert "KMLS_ALS_SPARSE=always" in got["skipped"]
+
+    def test_bad_knob_fails_safe_to_auto(self):
+        b = self._baskets()
+        got = als.train_embeddings(
+            b, MiningConfig(als_rank=4, als_iters=2, als_sparse="wat")
+        )
+        assert got["storage"] == "dense"  # auto: dense fits → dense
+
+    def test_knob_is_in_checkpoint_fingerprint(self, tmp_path):
+        from kmlserver_tpu.mining import checkpoint as ckpt
+
+        assert "als_sparse" in ckpt._FINGERPRINT_FIELDS
+        ds = tmp_path / "d.csv"
+        ds.write_text("pid,track_name\n0,a\n")
+        f1 = ckpt.compute_fingerprint(MiningConfig(), str(ds), 1)
+        f2 = ckpt.compute_fingerprint(
+            MiningConfig(als_sparse="always"), str(ds), 1
+        )
+        assert f1 != f2
+
+
+# ---------------------------------------------------------------------------
+# popcount tile knobs: lazy, kernel-build-time env reads
+# ---------------------------------------------------------------------------
+
+
+class TestLazyPopcountKnobs:
+    def test_env_change_after_import_is_honored(self, monkeypatch):
+        from kmlserver_tpu.ops import popcount as pc
+
+        base = pc.padded_shape(100, 1000)
+        monkeypatch.setenv("KMLS_POPCOUNT_WORD_CHUNK", "128")
+        monkeypatch.setenv("KMLS_POPCOUNT_TILE_I", "16")
+        monkeypatch.setenv("KMLS_POPCOUNT_TILE_J", "64")
+        assert pc.resolve_tiles() == (16, 64, 128)
+        assert pc.v_tile() == 64
+        v_pad, w_pad = pc.padded_shape(100, 1000)
+        assert v_pad % 64 == 0 and w_pad % 128 == 0
+        assert (v_pad, w_pad) != base
+        # and the kernel actually computes with the new tiles — a jit
+        # cache keyed on the old sizes would produce wrong tile grids
+        baskets = synthetic_baskets(
+            n_playlists=300, n_tracks=100, target_rows=1500, seed=7
+        )
+        got = np.asarray(
+            pc.popcount_pair_counts(
+                baskets.playlist_rows, baskets.track_ids,
+                n_playlists=300, n_tracks=100, impl="mxu",
+            )
+        )
+        np.testing.assert_array_equal(_dense_counts(baskets), got)
+
+    def test_invalid_word_chunk_rejected_at_build_time(self, monkeypatch):
+        from kmlserver_tpu.ops import popcount as pc
+
+        monkeypatch.setenv("KMLS_POPCOUNT_WORD_CHUNK", "300")
+        with pytest.raises(ValueError, match="multiple of"):
+            pc.resolve_tiles()
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+class TestCountPathTelemetry:
+    def test_job_metrics_render_count_path_gauge(self, tmp_path):
+        from kmlserver_tpu.observability.jobmetrics import JobMetrics
+
+        jm = JobMetrics(str(tmp_path))
+        jm.note_count_path("sparse-hybrid", "table")
+        text = jm.render()
+        assert (
+            'kmls_job_count_path{path="sparse-hybrid",source="table"} 1'
+            in text
+        )
+        assert "# TYPE kmls_job_count_path gauge" in text
+
+    def test_cost_specs_registered_for_sparse_kernels(self):
+        from kmlserver_tpu.observability.costmodel import (
+            KERNEL_COST_SPECS, phase_cost,
+        )
+
+        assert "sparse_count" in KERNEL_COST_SPECS
+        assert "als_sweep_sparse" in KERNEL_COST_SPECS
+        flops, moved = phase_cost(
+            "sparse_count", events=1000, nnz=400, v=100
+        )
+        assert flops > 0 and moved > 0
+        flops, moved = phase_cost(
+            "als_sweep_sparse", nnz=400, p=100, v=50, r=8, iters=4
+        )
+        assert flops > 0 and moved > 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch smoke (chaos marker → the CI chaos job runs it)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestDispatchSmoke:
+    def test_sparse_at_high_sparsity_dense_at_low_identical_answers(self):
+        """The small-shape dispatch-table smoke: the measured table must
+        route a high-sparsity workload to sparse and a dense toy
+        workload to dense, and the routed mines must answer identically
+        to the forced legacy paths."""
+        table = dispatch_mod.load_table()
+        assert table is not None
+        # pruning off so the planned shape IS the mined shape (the miner
+        # re-plans post-prune; this smoke pins the table's decision)
+        cfg = MiningConfig(
+            min_support=0.004, k_max_consequents=16,
+            prune_vocab_threshold=1 << 30,
+        )
+
+        sparse_b = synthetic_baskets(
+            n_playlists=6000, n_tracks=1500, target_rows=18000, seed=21
+        )
+        plan = dispatch_mod.plan_count_path(
+            cfg, 6000, 1500, len(sparse_b.playlist_rows),
+            backend="cpu", baskets=sparse_b, table=table,
+        )
+        assert plan.path == "sparse"
+        routed = mine(sparse_b, cfg)
+        assert routed.count_path.startswith("sparse")
+        forced = mine(
+            sparse_b,
+            dataclasses.replace(
+                cfg, count_path="dense", native_cpu_pair_counts=False
+            ),
+        )
+        assert _tensors_equal(routed.tensors, forced.tensors)
+
+        dense_b = synthetic_baskets(
+            n_playlists=1000, n_tracks=200, target_rows=10000, seed=22
+        )
+        plan_low = dispatch_mod.plan_count_path(
+            cfg, 1000, 200, len(dense_b.playlist_rows),
+            backend="cpu", baskets=dense_b, table=table,
+        )
+        assert plan_low.path == "dense"
+        routed_low = mine(dense_b, cfg)
+        assert not (routed_low.count_path or "").startswith("sparse")
+        forced_low = mine(
+            dense_b, dataclasses.replace(cfg, count_path="sparse")
+        )
+        assert _tensors_equal(routed_low.tensors, forced_low.tensors)
