@@ -56,6 +56,31 @@ def _getenv_hybrid_mode() -> str:
     return "rules"
 
 
+def _getenv_blend_weight() -> tuple[float, bool]:
+    """``KMLS_HYBRID_BLEND_WEIGHT``: a float, or ``measured`` — serve
+    the blend optimum the quality loop published in
+    ``quality.report.json`` (ISSUE 14). → ``(weight, measured)``; the
+    explicit float always wins over a report, and anything unparseable
+    fails SAFE to the default weight with a loud warning (a typo while
+    opting into measurement must not silently pin a wrong float)."""
+    raw = os.getenv("KMLS_HYBRID_BLEND_WEIGHT")
+    if raw in (None, ""):
+        return 0.5, False
+    word = raw.strip().lower()
+    if word == "measured":
+        return 0.5, True
+    try:
+        return float(raw), False
+    except ValueError:
+        import logging
+
+        logging.getLogger("kmlserver_tpu.serving").warning(
+            "KMLS_HYBRID_BLEND_WEIGHT=%r is neither a float nor "
+            "'measured'; using the default 0.5", raw,
+        )
+        return 0.5, False
+
+
 def _getenv_model_layout() -> str:
     """``KMLS_MODEL_LAYOUT``: ``replicated`` (default), ``sharded``, or
     ``auto`` (shard when measured tensor bytes exceed
@@ -138,6 +163,11 @@ KNOB_REGISTRY: dict[str, str] = {
     # --- serving: hybrid rule∪embedding merge (second model family) ---
     "KMLS_HYBRID_MODE": "serving",
     "KMLS_HYBRID_BLEND_WEIGHT": "serving",
+    # --- serving: quality loop (ISSUE 14) ---
+    # per-artifact staleness bound: any served artifact older than this
+    # flags /readyz ready-but-degraded and sets kmls_artifact_stale
+    # (0 = disabled — age gauges stay observability-only)
+    "KMLS_ARTIFACT_MAX_AGE_S": "serving",
     # --- serving: fleet cache affinity (ISSUE 10) ---
     # rendezvous-hash request affinity (freshness/ring.py): count how much
     # real traffic an affinity router would keep ring-local before
@@ -235,6 +265,21 @@ KNOB_REGISTRY: dict[str, str] = {
     # cap on the delta chain length before the pipeline forces a full
     # re-mine (accumulated patch cost + chain-replay cost at cold start)
     "KMLS_DELTA_MAX_CHAIN": "mining",
+    # --- mining: quality loop (ISSUE 14) ---
+    # snapshotting compactor: fold a delta chain of this length into a
+    # new base bundle WITHOUT a full re-mine (0 = disabled; keep below
+    # KMLS_DELTA_MAX_CHAIN so compaction fires before the hard cap)
+    "KMLS_DELTA_COMPACT_AFTER": "mining",
+    # offline ranking evaluation (quality/eval.py): run the optional
+    # checkpointed `eval` phase after `embed` — held-out basket
+    # completion scored through the production kernels, published as
+    # quality.report.json via the manifest + lease path
+    "KMLS_EVAL_ENABLED": "mining",
+    # leave-n-out per playlist, recall@k depth, and the deterministic
+    # cap on evaluated playlists (bounds eval cost at scale; 0 = all)
+    "KMLS_EVAL_HOLDOUT_N": "mining",
+    "KMLS_EVAL_K": "mining",
+    "KMLS_EVAL_MAX_PLAYLISTS": "mining",
     # --- both workloads ---
     "KMLS_NATIVE": "both",
     # continuous freshness (ISSUE 10): mining publishes incremental
@@ -289,6 +334,9 @@ KNOB_REGISTRY: dict[str, str] = {
     # mid-delta zero-5xx replay bracket
     "KMLS_BENCH_FRESHNESS_QPS": "tool",
     "KMLS_BENCH_FRESHNESS_REQUESTS": "tool",
+    # quality-loop phase (ISSUE 14): membership-row volume of the eval/
+    # compaction bracket's synthetic workload (CI smoke shrinks it)
+    "KMLS_BENCH_QUALITY_ROWS": "tool",
     # sparsity-adaptive phase (ISSUE 13): the ≥99%-sparse headline
     # workload's shape (CI smoke shrinks it)
     "KMLS_BENCH_SPARSE_PLAYLISTS": "tool",
@@ -462,6 +510,31 @@ class MiningConfig:
     # accumulated patch drift surface). 0 = unlimited.
     delta_max_chain: int = 16
 
+    # --- quality loop (ISSUE 14) ---
+    # Snapshotting compactor (quality/lifecycle.py): once the delta
+    # chain reaches this length, fold base ∘ chain into a new base
+    # bundle WITHOUT a full re-mine — the canonical delta application
+    # makes the fold bit-identical to the chain it replaces. 0 disables
+    # (KMLS_DELTA_MAX_CHAIN stays the hard full-re-mine backstop; keep
+    # this below it so the cheap snapshot fires first).
+    delta_compact_after: int = 0
+    # Offline ranking evaluation (quality/eval.py): run the optional
+    # checkpointed `eval` phase after `embed` — deterministic held-out
+    # basket-completion recall@k / MRR / coverage per serving mode
+    # through the production kernels, plus the blend-weight sweep —
+    # published as quality.report.json through the manifest+lease path.
+    # Off by default: eval re-trains both model families on the train
+    # split, roughly doubling job compute.
+    eval_enabled: bool = False
+    # Tracks held out per playlist (playlists shorter than holdout+2
+    # are not evaluated — something must remain to seed with).
+    eval_holdout_n: int = 1
+    # recall@k depth — matches serving's K_BEST_TRACKS default.
+    eval_k: int = 10
+    # Deterministic cap on evaluated playlists (hash-selected, not a
+    # prefix slice); bounds eval cost at scale. 0 = evaluate all.
+    eval_max_playlists: int = 2048
+
     # --- mining telemetry (ISSUE 9) ---
     # Write per-phase progress/duration/bytes counters to
     # pickles/job_metrics.prom (node-exporter textfile-collector format)
@@ -567,6 +640,11 @@ class MiningConfig:
             als_sparse=os.getenv("KMLS_ALS_SPARSE", "auto"),
             delta_enabled=_getenv_bool("KMLS_DELTA_ENABLED", False),
             delta_max_chain=_getenv_int("KMLS_DELTA_MAX_CHAIN", 16),
+            delta_compact_after=_getenv_int("KMLS_DELTA_COMPACT_AFTER", 0),
+            eval_enabled=_getenv_bool("KMLS_EVAL_ENABLED", False),
+            eval_holdout_n=_getenv_int("KMLS_EVAL_HOLDOUT_N", 1),
+            eval_k=_getenv_int("KMLS_EVAL_K", 10),
+            eval_max_playlists=_getenv_int("KMLS_EVAL_MAX_PLAYLISTS", 2048),
             job_metrics=_getenv_bool("KMLS_JOB_METRICS", True),
             checkpoint_enabled=_getenv_bool("KMLS_CKPT_ENABLED", True),
             checkpoint_dir=os.getenv("KMLS_CKPT_DIR", ""),
@@ -805,6 +883,19 @@ class ServingConfig:
     # rules-only (embeddings still backfill rule-less candidates),
     # 1 like embed-only.
     hybrid_blend_weight: float = 0.5
+    # KMLS_HYBRID_BLEND_WEIGHT=measured (ISSUE 14): serve the blend
+    # optimum the quality loop's held-out sweep published in
+    # quality.report.json. An explicit float wins (measured stays
+    # False); an absent/unusable report fails safe to the default
+    # weight above, with a warning at load.
+    hybrid_blend_measured: bool = False
+    # Per-artifact staleness bound (ISSUE 14): when any served artifact
+    # (rules/delta-chain/embeddings/popularity) is older than this many
+    # seconds, /readyz reports ready-but-degraded with the stale
+    # artifact named and kmls_artifact_stale{artifact} flips to 1 — an
+    # aging embeddings.npz becomes visible before it misleads.
+    # 0 disables (the age gauges stay observability-only).
+    artifact_max_age_s: float = 0.0
 
     @property
     def pickles_dir(self) -> str:
@@ -815,6 +906,7 @@ class ServingConfig:
         if dotenv_path:
             load_dotenv(dotenv_path)
         base_dir = os.getenv("BASE_DIR", "./api-data/")
+        _blend_weight, _blend_measured = _getenv_blend_weight()
         return ServingConfig(
             version=os.getenv("VERSION", "V1.1"),
             base_dir=base_dir,
@@ -860,7 +952,9 @@ class ServingConfig:
             request_deadline_ms=_getenv_float("KMLS_REQUEST_DEADLINE_MS", 0.0),
             fallback_budget_ms=_getenv_float("KMLS_FALLBACK_BUDGET_MS", 50.0),
             hybrid_mode=_getenv_hybrid_mode(),
-            hybrid_blend_weight=_getenv_float("KMLS_HYBRID_BLEND_WEIGHT", 0.5),
+            hybrid_blend_weight=_blend_weight,
+            hybrid_blend_measured=_blend_measured,
+            artifact_max_age_s=_getenv_float("KMLS_ARTIFACT_MAX_AGE_S", 0.0),
             delta_enabled=_getenv_bool("KMLS_DELTA_ENABLED", False),
             cache_affinity=_getenv_bool("KMLS_CACHE_AFFINITY", False),
             cache_affinity_peers=os.getenv("KMLS_CACHE_AFFINITY_PEERS", ""),

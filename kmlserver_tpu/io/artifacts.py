@@ -59,6 +59,14 @@ EMBEDDINGS_VERSION = 1
 # deltas are applied in place by engine.apply_pending_deltas()).
 DELTA_STATE_FILENAME = "delta.state.json"
 DELTA_BUNDLE_VERSION = 1
+# quality loop (kmlserver_tpu/quality/): the offline ranking-evaluation
+# report the optional `eval` pipeline phase publishes through the same
+# manifest + lease-fenced path — held-out recall@k / MRR / coverage per
+# serving mode plus the blend-weight sweep whose argmax the serving
+# engine reads under KMLS_HYBRID_BLEND_WEIGHT=measured. Deterministic
+# content (no timestamps), so a checkpoint-resumed publication writes
+# byte-identical bytes.
+QUALITY_REPORT_FILENAME = "quality.report.json"
 
 
 def delta_bundle_filename(seq: int) -> str:
@@ -657,6 +665,47 @@ def load_embeddings(path: str) -> dict[str, Any]:
             "iters": int(npz["iters"]) if "iters" in npz.files else 0,
             "reg": float(npz["reg"]) if "reg" in npz.files else 0.0,
         }
+
+
+def quality_report_path(pickles_dir: str) -> str:
+    return os.path.join(pickles_dir, QUALITY_REPORT_FILENAME)
+
+
+def save_quality_report(pickles_dir: str, report: dict[str, Any]) -> str:
+    """Write the quality report atomically with SORTED keys and no
+    whitespace jitter — byte-stable for identical content, which is what
+    lets the mining chaos suite's bit-identity bar (manifest sha256)
+    cover a checkpoint-resumed eval publication."""
+    path = quality_report_path(pickles_dir)
+    _atomic_write_bytes(
+        path,
+        json.dumps(report, indent=1, sort_keys=True).encode("utf-8"),
+    )
+    return path
+
+
+def load_quality_report(pickles_dir: str) -> dict[str, Any] | None:
+    """The parsed quality report, or None when absent/unreadable — the
+    serving engine treats every None as 'no measurement published' and
+    the measured blend mode fails safe to its default."""
+    try:
+        with open(quality_report_path(pickles_dir), "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def remove_quality_report(pickles_dir: str) -> bool:
+    """Retire the quality report (an eval-DISABLED publication must not
+    leave a previous generation's measurements on disk, where the fresh
+    manifest would re-bless a blend optimum measured against models that
+    no longer serve). → True if removed."""
+    try:
+        os.unlink(quality_report_path(pickles_dir))
+        return True
+    except FileNotFoundError:
+        return False
 
 
 def rules_dict_from_tensors(loaded: dict[str, Any]) -> dict[str, dict[str, float]]:

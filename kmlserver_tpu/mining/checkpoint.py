@@ -58,7 +58,13 @@ from ..io.artifacts import _atomic_write_bytes, file_digest, quarantine_file
 #           and checkpoints — only with ``embed_enabled``, but keeps its
 #           slot in the canonical order so resume bookkeeping and the
 #           kill-at-phase chaos matrix cover it like any other phase)
-PHASES = ("encode", "mine", "rules", "embed")
+# eval    — offline ranking evaluation (ISSUE 14; runs only with
+#           ``eval_enabled``): held-out split + both model families
+#           re-trained on the train half + per-mode basket-completion
+#           metrics + the blend-weight sweep — the double-train makes it
+#           the second-most-expensive phase, exactly what checkpointing
+#           exists for; same conditional-slot discipline as `embed`
+PHASES = ("encode", "mine", "rules", "embed", "eval")
 
 STATE_FILENAME = "state.json"
 # v2: the `embed` phase + ALS fields joined the fingerprint identity
@@ -80,7 +86,11 @@ STATE_FILENAME = "state.json"
 #     auto mode's budget-driven resolution rides the checkpointed embed
 #     payload itself (like the HBM skip decision always has), so a
 #     mid-resume budget change cannot splice storages either.
-CKPT_VERSION = 5
+# v6: quality loop (ISSUE 14) — the `eval` phase + its knobs joined the
+#     fingerprint: the phase payload IS the published
+#     quality.report.json, so a resume across an eval-config flip would
+#     publish a report (or omit one) its lineage doesn't describe.
+CKPT_VERSION = 6
 
 # MiningConfig fields that can change the bytes of the final artifacts (or
 # of any phase payload). Anything NOT listed — dispatch/backend knobs like
@@ -110,6 +120,13 @@ _FINGERPRINT_FIELDS = (
     # step additionally writes the freshness base state derived from the
     # phase payloads — see the v4 note above
     "delta_enabled",
+    # quality loop (ISSUE 14): the eval phase's payload is the published
+    # quality report — any knob that changes the split or the metrics
+    # changes the published bytes (see the v6 note above)
+    "eval_enabled",
+    "eval_holdout_n",
+    "eval_k",
+    "eval_max_playlists",
 )
 
 

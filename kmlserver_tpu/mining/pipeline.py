@@ -220,6 +220,15 @@ def run_mining_job(
                         f"WARNING: success telemetry skipped "
                         f"({jm_delta.path}): {exc!r}"
                     )
+            # quality loop (ISSUE 14): once the chain reaches
+            # KMLS_DELTA_COMPACT_AFTER bundles, fold base ∘ chain into a
+            # new base bundle WITHOUT a full re-mine. Never fails the
+            # job: a skipped compaction keeps the chain, the next delta
+            # re-triggers, and KMLS_DELTA_MAX_CHAIN stays the backstop.
+            if res.bundle_path:
+                from ..quality import lifecycle as lifecycle_mod
+
+                lifecycle_mod.maybe_compact(cfg)
             print(f"Job finished at {get_current_time_str()}")
             return JobSummary(
                 dataset=res.dataset,
@@ -414,6 +423,22 @@ def run_mining_job(
                         )
                     jm.note_phase_cost("embed", flops, moved)
 
+        # quality loop (ISSUE 14): offline ranking evaluation over a
+        # deterministic held-out split — trains BOTH model families on
+        # the train half and scores every serving mode through the
+        # production kernels. Its own checkpointed phase (a preempted
+        # job resumes past the double-train), payload = the
+        # deterministic report published below.
+        qual_report = None
+        if cfg.eval_enabled:
+
+            def _eval():
+                from ..quality import eval as qual_mod
+
+                return qual_mod.run_eval_phase(cfg, baskets, mesh=mesh)
+
+            qual_report = phase("eval", _eval)
+
         # ---------- publication (writer only, lease-fenced) ----------
         paths: dict[str, str] = {}
         token = ""
@@ -484,6 +509,19 @@ def run_mining_job(
                     reg=emb_payload["reg"],
                     final_loss=emb_payload["final_loss"],
                 )
+            if qual_report is None:
+                # eval off this generation: a previous report must not
+                # survive into this publication's manifest, where a
+                # blend optimum measured against retired models would be
+                # re-blessed (the embeddings-retirement precedent)
+                artifacts.remove_quality_report(cfg.pickles_dir)
+            else:
+                # fourth writer on the same spine: the quality report
+                # rides the identical atomic-write + manifest + fence
+                # discipline as every other artifact
+                paths["quality_report"] = artifacts.save_quality_report(
+                    cfg.pickles_dir, qual_report
+                )
             if cfg.write_manifest:
                 # integrity sidecar AFTER the artifact set, BEFORE the token:
                 # any reader that sees the new token sees a manifest matching
@@ -493,17 +531,13 @@ def run_mining_job(
                 # about to publish, so a LATER manifest-less writer (the
                 # reference job) retires this manifest just by rewriting the
                 # token — its fresh artifacts are never judged by stale sums.
+                # The file set is quality/lifecycle.py's ONE copy, shared
+                # with the compactor.
+                from ..quality.lifecycle import manifest_filenames
+
                 paths["manifest"] = artifacts.write_manifest(
                     cfg.pickles_dir,
-                    [
-                        cfg.best_tracks_file,
-                        cfg.recommendations_file,
-                        cfg.recommendations_file + artifacts.TENSOR_ARTIFACT_SUFFIX,
-                        cfg.artists_mapping_file,
-                        cfg.track_info_file,
-                        cfg.repeated_tracks_file,
-                        artifacts.EMBEDDINGS_FILENAME,
-                    ],
+                    manifest_filenames(cfg),
                     token=token_value,
                     fencing_token=lease.fencing_token if lease else None,
                 )

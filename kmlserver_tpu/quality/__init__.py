@@ -1,0 +1,19 @@
+"""Quality loop (ISSUE 14): offline ranking evaluation, the measured
+blend optimum, and the artifact lifecycle (delta-chain compaction +
+per-artifact staleness bounds) — the fourth writer/reader pair on the
+PR 2–4 artifact spine.
+
+- ``quality/eval.py``  — deterministic held-out basket-completion
+  harness (leave-n-out per playlist, leakage-guarded by construction)
+  scoring every serving mode through the SAME jitted kernels production
+  dispatches; runs as the optional checkpointed ``eval`` pipeline phase
+  and publishes a versioned ``quality.report.json`` through the
+  manifest + lease path.
+- ``quality/sweep.py`` — the blend-weight sweep over the held-out
+  split; its argmax is the measured optimum ``KMLS_HYBRID_BLEND_WEIGHT=
+  measured`` serves.
+- ``quality/lifecycle.py`` — the snapshotting delta-chain compactor
+  (base ∘ chain folded into a new base bundle without a full re-mine,
+  bit-identity guaranteed by reusing the ONE canonical delta
+  application) plus the staleness-bound constants /readyz enforces.
+"""

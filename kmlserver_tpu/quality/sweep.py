@@ -1,0 +1,72 @@
+"""Blend-weight sweep — the measured optimum the bundle publishes.
+
+``KMLS_HYBRID_BLEND_WEIGHT`` was a knob nobody swept: PR 6 shipped the
+hybrid rule∪embedding blend with ``w = 0.5`` because 0.5 is what you
+write when you have no measurement. This module sweeps the weight over
+the held-out basket-completion split (``quality/eval.py``) and its
+argmax becomes the published ``measured_blend_weight`` in
+``quality.report.json`` — the serve-time blend then becomes a measured
+decision exactly like ISSUE 13's dispatch table: the serving engine
+reads it under ``KMLS_HYBRID_BLEND_WEIGHT=measured``, an explicit float
+still wins, and an absent report fails safe to the default.
+
+The sweep re-MERGES host-side only: the expensive kernel candidates are
+computed once by the harness, and each grid point re-ranks them through
+the engine's own ``blend_candidates`` — so a 21-point sweep costs 21
+host merges, not 21 device passes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+# the serving default (ServingConfig.hybrid_blend_weight) — the sweep's
+# baseline point and the fail-safe when no report is published
+DEFAULT_BLEND_WEIGHT = 0.5
+
+# 21-point grid over [0, 1]: w=0 is NOT rules-only (embeddings still
+# backfill rule-less candidates) and w=1 is NOT embed-only (rule-only
+# rows keep their answers), so the endpoints are legitimate candidates
+WEIGHT_GRID = tuple(round(w, 2) for w in np.arange(0.0, 1.0001, 0.05))
+
+
+def sweep_blend_weight(
+    compose_at: Callable[[float, int], list[str]],
+    target_names: list[list[str]],
+    n_eval: int,
+    k: int,
+) -> dict[str, Any]:
+    """Sweep ``WEIGHT_GRID`` → the full recall curve + the argmax.
+
+    ``compose_at(w, e)`` returns the blended answer for eval playlist
+    ``e`` at weight ``w`` (the harness passes its production-semantics
+    composer). Ties argmax toward the LOWEST weight — deterministic, and
+    biased toward the rule model the reference system is built on."""
+    from .eval import _rank_metrics
+
+    weights: list[float] = []
+    recalls: list[float] = []
+    mrrs: list[float] = []
+    for w in WEIGHT_GRID:
+        per_recall, per_rr = [], []
+        for e in range(n_eval):
+            recall, rr = _rank_metrics(compose_at(w, e), target_names[e], k)
+            per_recall.append(recall)
+            per_rr.append(rr)
+        weights.append(float(w))
+        recalls.append(round(float(np.mean(per_recall)), 6))
+        mrrs.append(round(float(np.mean(per_rr)), 6))
+    best_i = max(range(len(weights)), key=lambda i: (recalls[i], -weights[i]))
+    return {
+        "weights": weights,
+        "recall_at_k": recalls,
+        "mrr": mrrs,
+        "best_weight": weights[best_i],
+        "best_recall_at_k": recalls[best_i],
+        "best_mrr": mrrs[best_i],
+    }
+
+
+__all__ = ["DEFAULT_BLEND_WEIGHT", "WEIGHT_GRID", "sweep_blend_weight"]

@@ -322,6 +322,10 @@ class RecommendApp:
                     return _json_response(403, {"detail": "localhost only"})
                 return self._debug_profile(query)
             if path == "/metrics":
+                # ONE age snapshot per scrape: the age gauges and the
+                # stale flags must describe the same instant, and the
+                # underlying os.stat pass must not run three times
+                ages = self._artifact_ages()
                 text = self.metrics.render(
                     self.engine.reload_counter, self.engine.finished_loading,
                     cache=self.cache,
@@ -334,7 +338,8 @@ class RecommendApp:
                     ),
                     cost=getattr(self.engine, "cost_model", None),
                     slo=self.slo,
-                    artifact_ages=self._artifact_ages(),
+                    artifact_ages=ages,
+                    artifact_stale=self._artifact_stale_flags(ages),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -378,6 +383,19 @@ class RecommendApp:
                 self.engine, "delta_rejected_total", 0
             ),
             "delta_seq": getattr(self.engine, "delta_seq", 0),
+            # quality loop (ISSUE 14): the published chain length (the
+            # compaction trigger's observable) and the EFFECTIVE hybrid
+            # blend weight (the measured optimum under
+            # KMLS_HYBRID_BLEND_WEIGHT=measured, else the knob)
+            "delta_chain_length": getattr(
+                self.engine, "delta_chain_length", 0
+            ),
+            "hybrid_blend_weight": round(
+                getattr(
+                    self.engine, "blend_weight",
+                    getattr(self.cfg, "hybrid_blend_weight", 0.5),
+                ), 4
+            ),
             "freshness_lag_seconds": round(
                 getattr(self.engine, "freshness_lag_s", lambda: 0.0)(), 3
             ),
@@ -425,6 +443,31 @@ class RecommendApp:
         first load, or with an engine test double predating the API)."""
         ages_fn = getattr(self.engine, "artifact_ages", None)
         return ages_fn() if callable(ages_fn) else {}
+
+    def _stale_artifacts(
+        self, ages: dict | None = None
+    ) -> list[tuple[str, float]]:
+        """Artifacts over the KMLS_ARTIFACT_MAX_AGE_S bound, as sorted
+        (name, age) pairs — empty with the bound disabled (0). ``ages``
+        lets a caller that already snapshotted the age dict reuse it
+        (one os.stat pass per scrape, and age + staleness always come
+        from the SAME snapshot)."""
+        max_age = getattr(self.cfg, "artifact_max_age_s", 0.0)
+        if max_age <= 0:
+            return []
+        if ages is None:
+            ages = self._artifact_ages()
+        return sorted(
+            (name, age) for name, age in ages.items() if age > max_age
+        )
+
+    def _artifact_stale_flags(self, ages: dict) -> dict:
+        """artifact → 0/1 staleness flags for the kmls_artifact_stale
+        gauge, derived from the SAME age snapshot the age gauges render
+        (all 0 with the bound disabled — the series still exists
+        wherever ages do, so dashboards can alert on a flip)."""
+        stale = {name for name, _age in self._stale_artifacts(ages)}
+        return {name: int(name in stale) for name in ages}
 
     def _debug_profile(self, query: str) -> Response:
         """``GET /debug/profile?seconds=N`` (ISSUE 12): capture a
@@ -627,6 +670,21 @@ class RecommendApp:
             # bundle serves rules-only — answered, but flagged so the
             # operator knows the second model family is dark
             reasons.append("embedding artifact unusable (serving rules-only)")
+        # staleness bound (ISSUE 14): any served artifact older than
+        # KMLS_ARTIFACT_MAX_AGE_S flags ready-but-degraded BY NAME — an
+        # aging embeddings.npz becomes an operator signal before it
+        # misleads. 0 (the default) keeps the age gauges purely
+        # observational.
+        stale = self._stale_artifacts()
+        if stale:
+            max_age = self.cfg.artifact_max_age_s
+            reasons.append(
+                "artifacts stale (> "
+                f"{max_age:.0f}s): "
+                + ", ".join(
+                    f"{name} ({age:.0f}s)" for name, age in stale
+                )
+            )
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         if callable(ejected_fn):
             ejected = ejected_fn()

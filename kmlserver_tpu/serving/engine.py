@@ -152,6 +152,29 @@ def _staging_is_safe() -> bool:
     return _HOST_STAGING_SAFE
 
 
+def blend_candidates(
+    rule_pairs: list[tuple[str, float]],
+    emb_pairs: list[tuple[str, float]],
+    weight: float,
+    k_best: int,
+) -> list[str]:
+    """THE hybrid blend merge — union of both model families' (name,
+    score) candidates with blended scores ``(1-w)·conf + w·sim`` and the
+    deterministic tie order (score desc, name asc) that keeps every
+    replica and epoch composing identical answers. One copy on purpose:
+    the serving engine's ``_compose_answer`` AND the offline quality
+    harness (quality/eval.py) both rank through it, so the measured
+    blend optimum can never describe a merge production doesn't run."""
+    w = min(max(weight, 0.0), 1.0)
+    scores: dict[str, float] = {}
+    for name, conf in rule_pairs:
+        scores[name] = (1.0 - w) * float(conf)
+    for name, sim in emb_pairs:
+        scores[name] = scores.get(name, 0.0) + w * float(sim)
+    ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+    return [n for n, _ in ranked[:k_best]]
+
+
 def stable_seed(seed_tracks: list[str]) -> int:
     """Process-independent replacement for the reference's salted
     ``hash(tuple(sorted(seed_tracks)))`` (rest_api/app/main.py:214)."""
@@ -303,6 +326,17 @@ class RecommendEngine:
         # rejection backoff for the POLLING path only (direct
         # apply_pending_deltas calls always go through, like load())
         self._delta_backoff_until = 0.0
+        # bundles in the CURRENT generation's delta chain file (applied
+        # or not) — the compaction trigger's observability surface,
+        # rendered as kmls_delta_chain_length; 0 when no chain (or a
+        # chain bound to another generation) is on the PVC
+        self.delta_chain_length = 0
+        # ---- quality loop (ISSUE 14) ----
+        # the blend optimum read from quality.report.json at load time
+        # (None: no report, unusable report, or measured mode off) —
+        # committed WITH the bundle swap so answers and weight always
+        # describe the same generation
+        self.measured_blend_weight: float | None = None
         self._kernel = None  # resolved lazily: donation needs the backend
         # dispatches whose (batch, length) shape was never pre-warmed —
         # each one paid a jit compile on the serving path; must stay 0
@@ -450,6 +484,20 @@ class RecommendEngine:
             self._host_state = getattr(self, "_candidate_host_state", None)
             self._base_npz_sha = getattr(self, "_candidate_npz_sha", None)
             self._delta_backoff_until = 0.0
+            # chain-length gauge: bundles already published for THIS
+            # generation (apply_pending_deltas keeps it current as the
+            # chain grows; a chain for another generation reads as 0)
+            self.delta_chain_length = 0
+            if self.cfg.delta_enabled:
+                chain = artifacts.read_delta_state(self.cfg.pickles_dir)
+                if chain is not None and chain.get("base_token") == (
+                    self.cache_value
+                ):
+                    self.delta_chain_length = len(chain.get("entries", ()))
+            # quality loop: the measured blend optimum commits WITH the
+            # bundle it was measured against (fail-soft — no report or a
+            # malformed one serves the configured default, loudly)
+            self.measured_blend_weight = self._read_measured_blend_weight()
             manifest = artifacts.load_manifest(self.cfg.pickles_dir)
             if manifest is not None and manifest.get("token") == self.cache_value:
                 self._applied_written_at = float(
@@ -967,6 +1015,37 @@ class RecommendEngine:
                     )
                     bundle.emb_warmed_shapes.add((batch, length))
 
+    def _read_measured_blend_weight(self) -> float | None:
+        """The quality loop's published blend optimum (ISSUE 14), or
+        None — measured mode off, no report on the PVC, or a report
+        without a usable weight. Fail-SOFT: the serving default is
+        always a legitimate answer; a missing measurement must degrade
+        the decision, never the reload."""
+        if not getattr(self.cfg, "hybrid_blend_measured", False):
+            return None
+        report = artifacts.load_quality_report(self.cfg.pickles_dir)
+        weight = report.get("measured_blend_weight") if report else None
+        if isinstance(weight, (int, float)) and 0.0 <= float(weight) <= 1.0:
+            return float(weight)
+        logger.warning(
+            "KMLS_HYBRID_BLEND_WEIGHT=measured but no usable "
+            "quality.report.json on the PVC (report %s); serving the "
+            "default weight %.2f",
+            "absent" if report is None else "carries no measured weight",
+            self.cfg.hybrid_blend_weight,
+        )
+        return None
+
+    @property
+    def blend_weight(self) -> float:
+        """The EFFECTIVE hybrid blend weight: the measured optimum when
+        KMLS_HYBRID_BLEND_WEIGHT=measured published one, else the
+        configured float (which is also the fail-safe when measurement
+        was requested but no report exists)."""
+        if self.measured_blend_weight is not None:
+            return self.measured_blend_weight
+        return self.cfg.hybrid_blend_weight
+
     @property
     def embedding_active(self) -> bool:
         """True when the published bundle carries ALS item factors (the
@@ -1125,6 +1204,10 @@ class RecommendEngine:
         with self._reload_lock:
             if state.get("base_token") != self.cache_value:
                 return 0  # chain for another generation: inert here
+            # chain-length gauge: the compaction trigger must be visible
+            # BEFORE the compactor acts on it, whether or not anything
+            # below is new enough to apply
+            self.delta_chain_length = len(state.get("entries", ()))
             pending = [
                 e for e in sorted(
                     state.get("entries", []), key=lambda e: e.get("seq", 0)
@@ -1429,16 +1512,18 @@ class RecommendEngine:
             # scenario the second model family exists for)
             songs = [n for n, _ in emb_pairs]
             return songs, ("embed" if songs else "empty")
-        # blend: union of both candidate lists, scores mixed by the knob
-        w = min(max(self.cfg.hybrid_blend_weight, 0.0), 1.0)
-        scores: dict[str, float] = {}
-        for i, c in zip(ids_row, confs_row):
-            if i >= 0:
-                scores[bundle.vocab[int(i)]] = (1.0 - w) * float(c)
-        for name, sim in emb_pairs:
-            scores[name] = scores.get(name, 0.0) + w * sim
-        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
-        songs = [n for n, _ in ranked[: self.cfg.k_best_tracks]]
+        # blend: union of both candidate lists, scores mixed by the
+        # effective weight (the knob, or the measured optimum under
+        # KMLS_HYBRID_BLEND_WEIGHT=measured) — one shared merge with the
+        # offline harness, so eval numbers describe this exact ranking
+        rule_pairs = [
+            (bundle.vocab[int(i)], float(c))
+            for i, c in zip(ids_row, confs_row)
+            if i >= 0
+        ]
+        songs = blend_candidates(
+            rule_pairs, emb_pairs, self.blend_weight, self.cfg.k_best_tracks
+        )
         return songs, ("hybrid" if songs else "empty")
 
     def recommend(self, seed_tracks: list[str]) -> tuple[list[str], str]:

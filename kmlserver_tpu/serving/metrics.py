@@ -97,6 +97,19 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_delta_rejected_total": "counter:serving",
     "kmls_delta_seq": "gauge:serving",
     "kmls_freshness_lag_seconds": "gauge:serving",
+    # --- serving: quality loop (ISSUE 14) ---
+    # published delta-chain length for the serving generation — the
+    # compaction trigger (KMLS_DELTA_COMPACT_AFTER), observable before
+    # the compactor acts on it
+    "kmls_delta_chain_length": "gauge:serving",
+    # the EFFECTIVE hybrid blend weight: the measured optimum when
+    # KMLS_HYBRID_BLEND_WEIGHT=measured published one, else the knob —
+    # dashboards see which weight actually ranks answers
+    "kmls_hybrid_blend_weight": "gauge:serving",
+    # per-artifact staleness flag: 1 when the artifact's age exceeds
+    # KMLS_ARTIFACT_MAX_AGE_S (always 0 with the bound disabled) — the
+    # alertable twin of kmls_artifact_age_seconds
+    "kmls_artifact_stale": "gauge:serving",
     # --- serving: observability (ISSUE 9) ---
     # peak-hold event-loop/scheduler stall estimate, decayed — the
     # runtime-health signal the admission ladder also folds in
@@ -401,6 +414,7 @@ class ServingMetrics:
         self, reload_counter: int, finished_loading: bool,
         cache=None, dispatch_counts=None, robustness=None,
         shard_counts=None, cost=None, slo=None, artifact_ages=None,
+        artifact_stale=None,
     ) -> str:
         """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
         ``dispatch_counts`` (the engine's per-replica dispatch counters),
@@ -540,6 +554,16 @@ class ServingMetrics:
                 f'kmls_artifact_age_seconds{{artifact="{name}"}} '
                 f"{artifact_ages[name]:.3f}"
                 for name in sorted(artifact_ages)
+            ]
+        if artifact_stale:
+            # the alertable staleness flag (ISSUE 14): 1 = the artifact
+            # is over KMLS_ARTIFACT_MAX_AGE_S (and /readyz says so too);
+            # rendered wherever ages are, all-0 with the bound disabled
+            lines.append("# TYPE kmls_artifact_stale gauge")
+            lines += [
+                f'kmls_artifact_stale{{artifact="{name}"}} '
+                f"{int(artifact_stale[name])}"
+                for name in sorted(artifact_stale)
             ]
         if robustness:
             # dedupe by series name (ISSUE 9 satellite): a robustness key

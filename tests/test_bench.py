@@ -436,6 +436,17 @@ class TestTpuSuiteWiring:
             "fleet_baseline_hit_ratio": 0.62, "fleet_multiplier": 1.31,
             "platform": "cpu",
         },
+        "quality": {
+            "recall_rules": 0.27, "recall_embed": 0.41,
+            "recall_blend": 0.41, "recall_blend_best": 0.43,
+            "recall_popularity": 0.11, "mrr_blend": 0.22,
+            "coverage_blend": 1.0, "measured_weight": 0.15,
+            "weight_roundtrip": True, "eval_playlists": 320,
+            "full_job_s": 4.2, "remine_s": 1.2, "compact_s": 0.14,
+            "compact_speedup": 8.4, "compact_folded": 2,
+            "compact_identical": True, "http_5xx": 0, "errors": 0,
+            "p99_ms": 6.1, "platform": "cpu",
+        },
         "costattrib": {
             "qps": 800.0, "requests": 4000, "p50_ms": 0.6, "p99_ms": 6.9,
             "mfu": 7.2e-05, "roofline": "bandwidth",
@@ -516,6 +527,12 @@ class TestTpuSuiteWiring:
         assert final["freshness_http_5xx"] == 0
         assert final["freshness_fleet_multiplier"] == 1.31
         assert final["freshness_platform"] == "cpu"
+        # ... and so does the quality-loop bracket (ISSUE 14)
+        assert final["quality_recall_blend"] == 0.43
+        assert final["quality_weight_roundtrip"] is True
+        assert final["quality_compact_identical"] is True
+        assert final["quality_http_5xx"] == 0
+        assert final["quality_platform"] == "cpu"
         # the supplementary CPU replay lands under cpu_-prefixed keys
         assert final["cpu_replay_achieved_qps"] == 1010.0
 
@@ -978,7 +995,7 @@ class TestBenchStateResume:
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
             "loadshape_cpu", "mine_resume_cpu", "als_hybrid_cpu",
-            "confserve_cpu", "scale_sparse_cpu",
+            "confserve_cpu", "scale_sparse_cpu", "quality_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()
@@ -1356,6 +1373,56 @@ class TestCompactLine:
         assert parsed["freshness_speedup"] == 10.93
         assert parsed["freshness_http_5xx"] == 0
         assert parsed["freshness_fleet_multiplier"] == 1.306
+
+    def test_record_quality_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-14 quality-loop bracket's judged keys (held-out
+        recall per mode, the measured blend weight + its serve-time
+        round-trip, compacted-snapshot identity + zero 5xx through the
+        mid-replay swap) must land in the compact line without
+        regressing the ≤1,800 budget."""
+        canned = {
+            "recall_rules": 0.2656, "recall_embed": 0.4094,
+            "recall_blend": 0.4094, "recall_blend_best": 0.4281,
+            "recall_popularity": 0.1125, "mrr_blend": 0.2193,
+            "coverage_blend": 1.0, "measured_weight": 0.15,
+            "weight_roundtrip": True, "eval_playlists": 320,
+            "full_job_s": 4.21, "remine_s": 1.18, "compact_s": 0.14,
+            "compact_speedup": 8.43, "compact_folded": 2,
+            "compact_identical": True, "http_5xx": 0, "errors": 0,
+            "p99_ms": 6.1, "platform": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_quality(result)
+        assert result["quality_recall_blend"] == 0.4281
+        assert result["quality_recall_rules"] == 0.2656
+        assert result["quality_blend_weight"] == 0.15
+        assert result["quality_weight_roundtrip"] is True
+        assert result["quality_compact_identical"] is True
+        assert result["quality_compact_speedup"] == 8.43
+        assert result["quality_http_5xx"] == 0
+        assert result["quality_platform"] == "cpu"
+        # only the judged claims ride the compact line (sweep-curve/
+        # MRR/coverage detail is sidecar-only, like the siblings)
+        for key in ("quality_recall_blend", "quality_recall_rules",
+                    "quality_recall_embed", "quality_blend_weight",
+                    "quality_weight_roundtrip",
+                    "quality_compact_identical", "quality_compact_s",
+                    "quality_compact_speedup", "quality_http_5xx",
+                    "quality_errors"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["quality_recall_blend"] == 0.4281
+        assert parsed["quality_weight_roundtrip"] is True
+        assert parsed["quality_compact_identical"] is True
+        assert parsed["quality_http_5xx"] == 0
 
     def test_record_costattrib_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-12 cost-attribution bracket's judged keys

@@ -74,6 +74,11 @@ def _make_pvc(base, rng_seed=0):
         # zombie fencing) exercises it too — and the bit-identity
         # assertions cover embeddings.npz via the manifest sha256
         embed_enabled=True, als_rank=8, als_iters=4,
+        # eval phase ON too (ISSUE 14): the fourth writer's kill-at-eval
+        # resume must republish a byte-identical quality.report.json —
+        # the manifest sha256 comparison covers it because the report is
+        # deterministic by construction (no timestamps, no tokens)
+        eval_enabled=True, eval_max_playlists=32,
     )
 
 

@@ -1,0 +1,644 @@
+"""Quality loop (ISSUE 14): offline ranking evaluation, the measured
+blend optimum, and the artifact lifecycle (compaction + staleness).
+
+The load-bearing contracts:
+
+- the held-out split is DETERMINISTIC (runs, hosts, input order) and
+  leaks nothing into the train half — asserted by construction over
+  both dataset shapes;
+- the measured blend optimum beats BOTH pure modes on held-out recall@k
+  and the whole decision is pinned end to end: sweep → report →
+  published bundle → serve-time blend under
+  ``KMLS_HYBRID_BLEND_WEIGHT=measured``;
+- the compacted snapshot is bit-identical to base ∘ chain ≡ a full
+  re-mine — tensors AND answers, replicated AND sharded layouts — with
+  the PR 10 selective cache invalidation surviving the swap and zero
+  5xx through a mid-replay compaction (chaos);
+- ``KMLS_ARTIFACT_MAX_AGE_S`` turns artifact ages into a /readyz
+  degraded reason + the ``kmls_artifact_stale`` gauge, and
+  ``kmls_delta_chain_length`` makes the compaction trigger observable.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import TrackTable, write_tracks_csv
+from kmlserver_tpu.data.synthetic import synthetic_baskets
+from kmlserver_tpu.io import artifacts
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.mining.vocab import Baskets, Vocab
+from kmlserver_tpu.quality import lifecycle
+from kmlserver_tpu.quality.eval import holdout_split, run_eval_phase
+from kmlserver_tpu.quality.sweep import WEIGHT_GRID
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.engine import RecommendEngine, blend_candidates
+
+
+# ---------------------------------------------------------------------------
+# data constructions
+# ---------------------------------------------------------------------------
+
+
+def clustered_baskets(
+    n_clusters=8, cluster_size=32, per_cluster=40, seed=0
+) -> Baskets:
+    """A workload where the two model families have COMPLEMENTARY
+    strengths, so the blend genuinely beats both pure modes: per-cluster
+    anchor tracks co-occur often enough for rules to mine them exactly,
+    the per-cluster tail sits below min_support (embeddings catch the
+    cluster geometry the rules cannot), and cross-cluster noise keeps
+    the embedding ranking imperfect."""
+    rng = np.random.default_rng(seed)
+    v = n_clusters * cluster_size
+    names = [f"Track {i:07d}" for i in range(v)]
+    vocab = Vocab(names=names, index={n: i for i, n in enumerate(names)})
+    rows, tids = [], []
+    n_playlists = n_clusters * per_cluster
+    for p in range(n_playlists):
+        base = (p % n_clusters) * cluster_size
+        anchors = base + rng.choice(4, size=3, replace=False)
+        tail = base + 4 + rng.choice(cluster_size - 4, size=3, replace=False)
+        noise = rng.choice(v, size=2, replace=False)
+        for t in np.concatenate([anchors, tail, noise]):
+            rows.append(p)
+            tids.append(int(t))
+    key = np.unique(
+        np.asarray(rows, dtype=np.int64) * v + np.asarray(tids, dtype=np.int64)
+    )
+    return Baskets(
+        playlist_rows=(key // v).astype(np.int32),
+        track_ids=(key % v).astype(np.int32),
+        n_playlists=n_playlists,
+        vocab=vocab,
+    )
+
+
+def baskets_to_csv(path: str, baskets: Baskets) -> None:
+    write_tracks_csv(
+        str(path),
+        TrackTable(
+            pid=baskets.playlist_rows.astype(np.int64),
+            track_name=np.asarray(
+                [baskets.vocab.names[int(t)] for t in baskets.track_ids],
+                dtype=object,
+            ),
+        ),
+    )
+
+
+def _eval_cfg(**overrides) -> MiningConfig:
+    base = dict(
+        min_support=0.05, embed_enabled=True, als_rank=12, als_iters=6,
+        eval_enabled=True, eval_max_playlists=0,
+    )
+    base.update(overrides)
+    return MiningConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# the held-out split
+# ---------------------------------------------------------------------------
+
+
+class TestHoldoutSplit:
+    def test_deterministic_across_runs_and_input_order(self, rng):
+        baskets = synthetic_baskets(200, 120, 2400, seed=4)
+        a = holdout_split(baskets, n_holdout=1)
+        b = holdout_split(baskets, n_holdout=1)
+        assert a.eval_rows == b.eval_rows
+        assert a.seed_names == b.seed_names
+        assert a.target_names == b.target_names
+        # input PAIR ORDER must not matter (a re-encoded dataset can
+        # deliver the same membership set in any order)
+        perm = rng.permutation(len(baskets.playlist_rows))
+        shuffled = Baskets(
+            playlist_rows=baskets.playlist_rows[perm],
+            track_ids=baskets.track_ids[perm],
+            n_playlists=baskets.n_playlists,
+            vocab=baskets.vocab,
+        )
+        c = holdout_split(shuffled, n_holdout=1)
+        assert c.eval_rows == a.eval_rows
+        assert c.target_names == a.target_names
+        assert np.array_equal(
+            np.sort(c.train.playlist_rows * 1000 + c.train.track_ids),
+            np.sort(a.train.playlist_rows * 1000 + a.train.track_ids),
+        )
+
+    @pytest.mark.parametrize(
+        "shape",
+        [
+            # ds1- and ds2-proportioned synthetic shapes (scaled down)
+            dict(n_playlists=300, n_tracks=220, target_rows=5200, seed=11),
+            dict(n_playlists=225, n_tracks=217, target_rows=2400, seed=12),
+        ],
+        ids=["ds1-shaped", "ds2-shaped"],
+    )
+    def test_zero_leakage_by_construction(self, shape):
+        baskets = synthetic_baskets(**shape)
+        split = holdout_split(baskets, n_holdout=1)
+        v = np.int64(baskets.n_tracks)
+        all_keys = set(
+            (
+                baskets.playlist_rows.astype(np.int64) * v
+                + baskets.track_ids
+            ).tolist()
+        )
+        train_keys = set(
+            (
+                split.train.playlist_rows.astype(np.int64) * v
+                + split.train.track_ids
+            ).tolist()
+        )
+        held_keys = set()
+        index = baskets.vocab.index
+        for row, targets in zip(split.eval_rows, split.target_names):
+            for name in targets:
+                held_keys.add(int(row) * int(v) + index[name])
+        assert held_keys, "split held nothing out"
+        assert not (train_keys & held_keys)
+        assert train_keys | held_keys == all_keys
+
+    def test_min_basket_and_holdout_n(self):
+        # playlists: sizes 2, 3, 5 — leave-1-out needs >= 3 tracks
+        rows = [0, 0, 1, 1, 1, 2, 2, 2, 2, 2]
+        tids = [0, 1, 0, 1, 2, 0, 1, 2, 3, 4]
+        names = [f"t{i}" for i in range(5)]
+        baskets = Baskets(
+            playlist_rows=np.asarray(rows, dtype=np.int32),
+            track_ids=np.asarray(tids, dtype=np.int32),
+            n_playlists=3,
+            vocab=Vocab(names=names, index={n: i for i, n in enumerate(names)}),
+        )
+        split = holdout_split(baskets, n_holdout=1)
+        assert split.eval_rows == [1, 2]
+        for seeds, targets in zip(split.seed_names, split.target_names):
+            assert len(targets) == 1
+            assert len(seeds) >= 2
+        # leave-2-out: only the 5-track playlist stays eligible
+        split2 = holdout_split(baskets, n_holdout=2)
+        assert split2.eval_rows == [2]
+        assert len(split2.target_names[0]) == 2
+
+    def test_max_playlists_cap_is_deterministic(self):
+        baskets = synthetic_baskets(300, 150, 3600, seed=6)
+        a = holdout_split(baskets, max_playlists=40)
+        b = holdout_split(baskets, max_playlists=40)
+        assert len(a.eval_rows) == 40
+        assert a.eval_rows == b.eval_rows
+        assert a.n_eligible > 40
+
+
+# ---------------------------------------------------------------------------
+# the eval harness + sweep
+# ---------------------------------------------------------------------------
+
+
+class TestEvalReport:
+    def test_report_deterministic(self):
+        baskets = clustered_baskets(n_clusters=4, cluster_size=16,
+                                    per_cluster=20, seed=2)
+        cfg = _eval_cfg(als_rank=8, als_iters=4)
+        a = run_eval_phase(cfg, baskets)
+        b = run_eval_phase(cfg, baskets)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+    def test_measured_blend_beats_both_pure_modes(self):
+        """THE acceptance pin: the sweep's argmax recall@k strictly
+        exceeds rules-only AND embed-only on the held-out split."""
+        baskets = clustered_baskets(seed=0)
+        report = run_eval_phase(_eval_cfg(), baskets)
+        modes = report["modes"]
+        best = report["sweep"]["best_recall_at_k"]
+        assert 0.0 < best <= 1.0
+        assert best > modes["rules"]["recall_at_k"]
+        assert best > modes["embed"]["recall_at_k"]
+        assert report["measured_blend_weight"] in WEIGHT_GRID
+        assert report["measured_blend_weight"] == report["sweep"]["best_weight"]
+        # the sweep curve covers the whole grid
+        assert report["sweep"]["weights"] == [float(w) for w in WEIGHT_GRID]
+        assert len(report["sweep"]["recall_at_k"]) == len(WEIGHT_GRID)
+        # popularity fallback is measured too, and the models beat it
+        assert best > modes["popularity"]["recall_at_k"]
+
+    def test_eval_without_embeddings_degrades_to_rules(self):
+        baskets = clustered_baskets(n_clusters=4, cluster_size=16,
+                                    per_cluster=20, seed=3)
+        report = run_eval_phase(_eval_cfg(embed_enabled=False), baskets)
+        assert report["measured_blend_weight"] is None
+        assert report["sweep"] is None
+        assert "embed" not in report["modes"]
+        assert report["modes"]["blend"] == report["modes"]["rules"]
+
+
+# ---------------------------------------------------------------------------
+# end to end: sweep → report → bundle → serve-time blend
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def quality_pvc(tmp_path):
+    """A PVC published with embed + eval on (clustered workload) →
+    (mining_cfg, report)."""
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    baskets_to_csv(str(ds_dir / "2023_spotify_ds1.csv"), clustered_baskets())
+    cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.05,
+        embed_enabled=True, als_rank=12, als_iters=6,
+        eval_enabled=True, eval_max_playlists=256,
+    )
+    run_mining_job(cfg)
+    report = artifacts.load_quality_report(cfg.pickles_dir)
+    assert report is not None
+    return cfg, report
+
+
+class TestMeasuredBlendServing:
+    def _engine(self, base_dir, **overrides) -> RecommendEngine:
+        cfg = ServingConfig(
+            base_dir=str(base_dir), pickle_dir="pickles/", **overrides
+        )
+        engine = RecommendEngine(cfg)
+        assert engine.load()
+        return engine
+
+    def test_measured_weight_served_end_to_end(self, tmp_path, quality_pvc):
+        _cfg, report = quality_pvc
+        w = report["measured_blend_weight"]
+        assert w is not None
+        measured = self._engine(tmp_path, hybrid_blend_measured=True)
+        assert measured.measured_blend_weight == w
+        assert measured.blend_weight == w
+        # answers under `measured` are identical to an engine pinning
+        # the same float explicitly — the report value IS the serve-time
+        # decision, not a parallel implementation
+        explicit = self._engine(tmp_path, hybrid_blend_weight=w)
+        vocab = measured.bundle.vocab
+        seed_sets = [[vocab[i], vocab[(i * 7 + 3) % len(vocab)]]
+                     for i in range(0, 60, 3)]
+        assert measured.recommend_many(seed_sets) == explicit.recommend_many(
+            seed_sets
+        )
+
+    def test_explicit_float_wins_over_measured(self, tmp_path, quality_pvc):
+        engine = self._engine(
+            tmp_path, hybrid_blend_weight=0.9, hybrid_blend_measured=False
+        )
+        assert engine.measured_blend_weight is None
+        assert engine.blend_weight == 0.9
+
+    def test_absent_report_fails_safe_to_default(self, tmp_path, quality_pvc):
+        cfg, _report = quality_pvc
+        artifacts.remove_quality_report(cfg.pickles_dir)
+        engine = self._engine(tmp_path, hybrid_blend_measured=True)
+        assert engine.measured_blend_weight is None
+        assert engine.blend_weight == engine.cfg.hybrid_blend_weight
+
+    def test_eval_disabled_publication_retires_report(
+        self, tmp_path, quality_pvc
+    ):
+        cfg, _report = quality_pvc
+        run_mining_job(dataclasses.replace(cfg, eval_enabled=False))
+        assert artifacts.load_quality_report(cfg.pickles_dir) is None
+
+    def test_malformed_report_fails_safe(self, tmp_path, quality_pvc):
+        cfg, _report = quality_pvc
+        artifacts.save_quality_report(
+            cfg.pickles_dir, {"version": 1, "measured_blend_weight": "nope"}
+        )
+        engine = self._engine(tmp_path, hybrid_blend_measured=True)
+        assert engine.measured_blend_weight is None
+
+    def test_blend_candidates_is_the_one_merge(self):
+        """The engine and the harness share the merge — pin its tie
+        order (score desc, name asc) and the weight endpoints."""
+        rules = [("b", 0.4), ("a", 0.4)]
+        emb = [("c", 0.4), ("a", 0.2)]
+        assert blend_candidates(rules, emb, 0.0, 3) == ["a", "b", "c"]
+        assert blend_candidates(rules, emb, 1.0, 3) == ["c", "a", "b"]
+        # ties at equal blended score resolve name-ascending
+        assert blend_candidates([("x", 0.5)], [("y", 0.5)], 0.5, 2) == [
+            "x", "y",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+def _grow_chain(csv_path, cfg, n_deltas, rng, first_pid=10_000_000):
+    """Append playlists and publish ``n_deltas`` delta bundles."""
+    for i in range(n_deltas):
+        lines = []
+        for p in range(6):
+            pid = first_pid + i * 1000 + p
+            for t in (10 + 17 * i + rng.integers(0, 24, size=10)):
+                lines.append(f"{pid},Track {int(t):07d}")
+        with open(csv_path, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+        summary = run_mining_job(cfg)
+        assert summary.delta_seq == i + 1, summary
+
+
+@pytest.fixture
+def chain_pvc(tmp_path, rng):
+    """A delta-armed PVC with a 2-bundle chain → (cfg, csv_path)."""
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    csv_path = str(ds_dir / "2023_spotify_ds1.csv")
+    baskets_to_csv(
+        csv_path, synthetic_baskets(150, 100, 3000, seed=5)
+    )
+    cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.05,
+        delta_enabled=True,
+    )
+    run_mining_job(cfg)
+    _grow_chain(csv_path, cfg, 2, rng)
+    return cfg, csv_path
+
+
+def _control_remine(tmp_path, csv_path, cfg, layout="replicated"):
+    base2 = tmp_path / f"control_{layout}"
+    ds2 = base2 / "datasets"
+    ds2.mkdir(parents=True)
+    shutil.copy(csv_path, str(ds2 / os.path.basename(csv_path)))
+    cfg2 = dataclasses.replace(
+        cfg, base_dir=str(base2), datasets_dir=str(ds2),
+        delta_enabled=False, model_layout=layout,
+    )
+    run_mining_job(cfg2)
+    return cfg2
+
+
+def _npz(cfg) -> dict:
+    return artifacts.load_rule_tensors(
+        artifacts.tensor_artifact_path(
+            os.path.join(cfg.pickles_dir, cfg.recommendations_file)
+        )
+    )
+
+
+class TestCompaction:
+    @pytest.mark.parametrize("layout", ["replicated", "sharded"])
+    def test_compacted_equals_full_remine(self, tmp_path, rng, layout):
+        """base ∘ chain == compacted snapshot == full re-mine: tensors
+        AND answers, both layouts."""
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        csv_path = str(ds_dir / "2023_spotify_ds1.csv")
+        baskets_to_csv(csv_path, synthetic_baskets(150, 100, 3000, seed=5))
+        cfg = MiningConfig(
+            base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+            min_support=0.05, delta_enabled=True, model_layout=layout,
+        )
+        run_mining_job(cfg)
+        _grow_chain(csv_path, cfg, 2, rng)
+        result = lifecycle.compact_delta_chain(cfg)
+        assert result.n_folded == 2
+        assert artifacts.read_delta_state(cfg.pickles_dir) is None
+        control = _control_remine(tmp_path, csv_path, cfg, layout=layout)
+        a, b = _npz(cfg), _npz(control)
+        assert a["vocab"] == b["vocab"]
+        for key in ("rule_ids", "rule_counts", "item_counts"):
+            assert np.array_equal(a[key], b[key]), key
+        assert a["n_playlists"] == b["n_playlists"]
+        # answers: the compacted PVC serves identically to the control
+        eng_a = RecommendEngine(ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/",
+            model_layout=layout,
+        ))
+        assert eng_a.load()
+        eng_b = RecommendEngine(ServingConfig(
+            base_dir=str(control.base_dir), pickle_dir="pickles/",
+            model_layout=layout,
+        ))
+        assert eng_b.load()
+        vocab = eng_a.bundle.vocab
+        seeds = [[vocab[i], vocab[(i + 13) % len(vocab)]]
+                 for i in range(0, len(vocab), 9)]
+        assert eng_a.recommend_many(seeds) == eng_b.recommend_many(seeds)
+
+    def test_auto_trigger_and_rearm(self, tmp_path, rng, chain_pvc):
+        cfg, csv_path = chain_pvc
+        # third delta under KMLS_DELTA_COMPACT_AFTER=3 triggers the fold
+        cfg3 = dataclasses.replace(cfg, delta_compact_after=3)
+        lines = [f"{30_000_000 + p},Track {int(t):07d}"
+                 for p in range(5)
+                 for t in (40 + rng.integers(0, 20, size=8))]
+        with open(csv_path, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+        summary = run_mining_job(cfg3)
+        assert summary.delta_seq == 3
+        assert artifacts.read_delta_state(cfg.pickles_dir) is None
+        # the base state rolled onto the new token: the NEXT delta
+        # extends the compacted base instead of full-re-mining
+        _grow_chain(csv_path, cfg, 1, rng, first_pid=40_000_000)
+
+    def test_below_threshold_does_not_compact(self, chain_pvc):
+        cfg, _csv = chain_pvc
+        assert lifecycle.maybe_compact(
+            dataclasses.replace(cfg, delta_compact_after=5)
+        ) is None
+        assert lifecycle.maybe_compact(cfg) is None  # 0 = disabled
+        assert artifacts.read_delta_state(cfg.pickles_dir) is not None
+
+    def test_no_chain_is_ineligible(self, tmp_path, rng):
+        ds_dir = tmp_path / "datasets"
+        ds_dir.mkdir()
+        baskets_to_csv(
+            str(ds_dir / "2023_spotify_ds1.csv"),
+            synthetic_baskets(60, 40, 900, seed=1),
+        )
+        cfg = MiningConfig(
+            base_dir=str(tmp_path), datasets_dir=str(ds_dir),
+            min_support=0.05, delta_enabled=True,
+        )
+        run_mining_job(cfg)
+        with pytest.raises(lifecycle.CompactionIneligible):
+            lifecycle.compact_delta_chain(cfg)
+
+    def test_torn_chain_entry_is_ineligible(self, chain_pvc):
+        cfg, _csv = chain_pvc
+        state = artifacts.read_delta_state(cfg.pickles_dir)
+        bundle_path = os.path.join(
+            cfg.pickles_dir, state["entries"][0]["file"]
+        )
+        with open(bundle_path, "r+b") as fh:
+            fh.truncate(os.path.getsize(bundle_path) // 2)
+        with pytest.raises(lifecycle.CompactionIneligible):
+            lifecycle.compact_delta_chain(cfg)
+        # nothing was published: the chain file is still there and the
+        # base generation still serves
+        assert artifacts.read_delta_state(cfg.pickles_dir) is not None
+
+    @pytest.mark.chaos
+    def test_selective_invalidation_survives_the_swap(
+        self, tmp_path, rng, chain_pvc
+    ):
+        """Compaction swaps the base; the PR 10 selective invalidation
+        must keep working for deltas published AFTER the swap."""
+        cfg, csv_path = chain_pvc
+        scfg = ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/",
+            delta_enabled=True,
+        )
+        app = RecommendApp(scfg)
+        assert app.engine.load()
+        assert app.engine.apply_pending_deltas() == 2
+        lifecycle.compact_delta_chain(cfg)
+        assert app.engine.is_data_stale()
+        assert app.engine.load()  # ordinary full hot swap, zero drama
+        assert app.engine.delta_seq == 0
+        # post-compaction delta: applies in place + invalidates
+        # selectively (no epoch bump for the rules-only bundle set)
+        assert app.cache is not None
+        before = app.cache.selective_invalidations
+        _grow_chain(csv_path, cfg, 1, rng, first_pid=50_000_000)
+        assert app.engine.apply_pending_deltas() == 1
+        assert app.cache.selective_invalidations == before + 1
+
+    @pytest.mark.chaos
+    def test_zero_5xx_through_mid_replay_compaction(
+        self, tmp_path, rng, chain_pvc
+    ):
+        """Requests hammering the app while the chain compacts and the
+        poll loop hot-swaps the new base: never a 5xx."""
+        cfg, _csv = chain_pvc
+        scfg = ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/",
+            delta_enabled=True, batch_window_ms=0.5,
+            shed_queue_budget_ms=0.0,
+        )
+        app = RecommendApp(scfg)
+        assert app.engine.load()
+        app.engine.apply_pending_deltas()
+        vocab = app.engine.bundle.vocab
+        statuses: list[int] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def poller():
+            while not stop.is_set():
+                app.engine.reload_if_required()
+                time.sleep(0.005)
+
+        def client(worker: int):
+            i = 0
+            while not stop.is_set():
+                seeds = [vocab[(worker * 31 + i * 7) % len(vocab)]]
+                status, _h, _b = app.handle(
+                    "POST", "/api/recommend/",
+                    json.dumps({"songs": seeds}).encode(),
+                )
+                with lock:
+                    statuses.append(status)
+                i += 1
+
+        threads = [threading.Thread(target=poller, daemon=True)] + [
+            threading.Thread(target=client, args=(w,), daemon=True)
+            for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        result = lifecycle.compact_delta_chain(cfg)
+        deadline = time.time() + 10.0
+        while app.engine.cache_value != result.token and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert app.engine.cache_value == result.token, "swap never landed"
+        assert statuses, "no traffic flowed"
+        assert all(s < 500 for s in statuses), (
+            f"5xx during compaction swap: {sorted(set(statuses))}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# staleness bounds + chain-length observability
+# ---------------------------------------------------------------------------
+
+
+class TestStalenessBound:
+    def _app(self, base_dir, **overrides) -> RecommendApp:
+        app = RecommendApp(ServingConfig(
+            base_dir=str(base_dir), pickle_dir="pickles/", **overrides
+        ))
+        assert app.engine.load()
+        return app
+
+    def test_stale_artifact_degrades_readyz_and_sets_gauge(
+        self, tmp_path, chain_pvc
+    ):
+        app = self._app(tmp_path, artifact_max_age_s=1e-6)
+        time.sleep(0.01)  # every artifact is now older than the bound
+        reasons = app.degraded_reasons()
+        assert any("artifacts stale" in r and "rules" in r for r in reasons)
+        status, _h, body = app.handle("GET", "/readyz", None)
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "degraded"
+        assert any("stale" in r for r in payload["reasons"])
+        _s, _h, metrics_body = app.handle("GET", "/metrics", None)
+        text = metrics_body.decode()
+        assert 'kmls_artifact_stale{artifact="rules"} 1' in text
+
+    def test_disabled_bound_stays_observational(self, tmp_path, chain_pvc):
+        app = self._app(tmp_path)  # artifact_max_age_s = 0 (default)
+        assert not any(
+            "stale" in r for r in app.degraded_reasons()
+        )
+        _s, _h, body = app.handle("GET", "/metrics", None)
+        text = body.decode()
+        # the series still exists (all-zero) wherever ages do
+        assert 'kmls_artifact_stale{artifact="rules"} 0' in text
+        status, _h, rbody = app.handle("GET", "/readyz", None)
+        assert json.loads(rbody)["status"] == "ready"
+
+
+class TestChainLengthGauge:
+    def test_chain_length_tracks_published_chain(self, tmp_path, chain_pvc):
+        cfg, csv_path = chain_pvc
+        app = RecommendApp(ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/", delta_enabled=True,
+        ))
+        assert app.engine.load()
+        # load() already sees the 2-bundle chain, before anything applies
+        assert app.engine.delta_chain_length == 2
+        _s, _h, body = app.handle("GET", "/metrics", None)
+        assert "kmls_delta_chain_length 2" in body.decode()
+        app.engine.apply_pending_deltas()
+        assert app.engine.delta_chain_length == 2
+        # compaction retires the chain; the reload reads 0
+        lifecycle.compact_delta_chain(cfg)
+        assert app.engine.load()
+        assert app.engine.delta_chain_length == 0
+
+    def test_delta_disabled_reads_zero(self, tmp_path, chain_pvc):
+        app = RecommendApp(ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/",
+        ))
+        assert app.engine.load()
+        assert app.engine.delta_chain_length == 0
+
+    def test_blend_weight_gauge_rendered(self, tmp_path, chain_pvc):
+        app = RecommendApp(ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/",
+            hybrid_blend_weight=0.25,
+        ))
+        assert app.engine.load()
+        _s, _h, body = app.handle("GET", "/metrics", None)
+        assert "kmls_hybrid_blend_weight 0.25" in body.decode()
