@@ -62,6 +62,7 @@ class AnalysisConfig:
     readme: str = "README.md"
     manifest_files: tuple[str, ...] = (
         "kubernetes/deployment.yaml",
+        "kubernetes/statefulset.yaml",
         "kubernetes/job.yaml",
         "kubernetes/job-multihost.yaml",
     )
@@ -182,7 +183,15 @@ class AnalysisConfig:
     # scope -> manifest files at least one of which must mention the knob
     knob_scope_manifests: dict[str, tuple[str, ...]] = dataclasses.field(
         default_factory=lambda: {
-            "serving": ("kubernetes/deployment.yaml",),
+            # a serving knob may be bound in either serving manifest —
+            # the stateless Deployment or the fleet-identity StatefulSet
+            # (ISSUE 15); "both"-scope routing below keys on the
+            # basename containing "deployment", so the StatefulSet joins
+            # the serving group here without widening that rule
+            "serving": (
+                "kubernetes/deployment.yaml",
+                "kubernetes/statefulset.yaml",
+            ),
             "mining": (
                 "kubernetes/job.yaml",
                 "kubernetes/job-multihost.yaml",

@@ -175,6 +175,15 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_CACHE_AFFINITY": "serving",
     "KMLS_CACHE_AFFINITY_PEERS": "serving",
     "KMLS_CACHE_AFFINITY_SELF": "serving",
+    # --- serving: fleet cache routing (ISSUE 15) ---
+    # stable replica identity for the routing tier (kubernetes/
+    # statefulset.yaml binds SELF from the pod name; PEERS lists the
+    # StatefulSet ordinals). Setting PEERS arms owner-aware serving:
+    # the ring (same rendezvous implementation the router and
+    # simulate_fleet use), X-KMLS-Cache-Owner stamping on non-owned
+    # answers, and the kmls_cache_misrouted_total drift counter.
+    "KMLS_FLEET_SELF": "serving",
+    "KMLS_FLEET_PEERS": "serving",
     # --- serving: observability (ISSUE 9) ---
     # span tracing: baseline sample rate for OK traces (0 = tracing off —
     # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
@@ -334,6 +343,13 @@ KNOB_REGISTRY: dict[str, str] = {
     # mid-delta zero-5xx replay bracket
     "KMLS_BENCH_FRESHNESS_QPS": "tool",
     "KMLS_BENCH_FRESHNESS_REQUESTS": "tool",
+    # fleet cache-routing phase (ISSUE 15): aggregate rate / volume /
+    # replica count / per-replica LRU entries for the multi-process
+    # routed-vs-independent bracket (the CI smoke shrinks all four)
+    "KMLS_BENCH_FLEET_QPS": "tool",
+    "KMLS_BENCH_FLEET_REQUESTS": "tool",
+    "KMLS_BENCH_FLEET_REPLICAS": "tool",
+    "KMLS_BENCH_FLEET_CACHE": "tool",
     # quality-loop phase (ISSUE 14): membership-row volume of the eval/
     # compaction bracket's synthetic workload (CI smoke shrinks it)
     "KMLS_BENCH_QUALITY_ROWS": "tool",
@@ -832,6 +848,21 @@ class ServingConfig:
     cache_affinity_peers: str = ""
     cache_affinity_self: str = ""
 
+    # --- fleet cache routing (ISSUE 15) ---
+    # Stable replica identity for the ROUTING tier (the acted-on twin of
+    # the measurement knobs above): a non-empty fleet_peers arms
+    # owner-aware serving — the app builds the canonical rendezvous ring
+    # over these identities, answers every request locally (mis-routed
+    # traffic degrades gracefully, never fails), stamps
+    # X-KMLS-Cache-Owner on answers this replica does not own, and
+    # counts non-owned misses as kmls_cache_misrouted_total so routing
+    # drift at the ingress/client is observable. Under the StatefulSet
+    # recipe (kubernetes/statefulset.yaml) fleet_self is the pod's own
+    # stable ordinal name; empty falls back to the hostname, which IS
+    # that name in-cluster.
+    fleet_self: str = ""
+    fleet_peers: str = ""
+
     # --- observability (ISSUE 9): span tracing + runtime health ---
     # Baseline retention probability for OK traces once tracing is on.
     # 0 (default) disables tracing entirely: no trace context, no id
@@ -959,6 +990,8 @@ class ServingConfig:
             cache_affinity=_getenv_bool("KMLS_CACHE_AFFINITY", False),
             cache_affinity_peers=os.getenv("KMLS_CACHE_AFFINITY_PEERS", ""),
             cache_affinity_self=os.getenv("KMLS_CACHE_AFFINITY_SELF", ""),
+            fleet_self=os.getenv("KMLS_FLEET_SELF", ""),
+            fleet_peers=os.getenv("KMLS_FLEET_PEERS", ""),
             trace_sample=_getenv_float("KMLS_TRACE_SAMPLE", 0.0),
             trace_buffer=_getenv_int("KMLS_TRACE_BUFFER", 512),
             trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),

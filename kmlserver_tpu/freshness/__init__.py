@@ -9,10 +9,12 @@ The third writer/reader pair on the PR 2-4 artifact spine:
   a versioned delta bundle through the lease + fencing-token path) and
   the ONE canonical base∘delta application both sides share;
 - :mod:`.ring` — rendezvous-hash request affinity over the replica
-  fleet, plus the simulated-topology harness that measures the
-  fleet-wide effective-hit-ratio multiplier before committing to a
-  shared external cache tier.
+  fleet, the simulated-topology harness that measures the fleet-wide
+  effective-hit-ratio multiplier, and (ISSUE 15) the health-aware
+  :class:`~.ring.FleetRouter` that ACTS on it — consistent-hash request
+  routing with circuit-breaker peer ejection and bounded remap on
+  membership change, making N replicas behave as one logical cache.
 """
 
 from .delta import DeltaIneligible, apply_delta_to_tensors  # noqa: F401
-from .ring import RendezvousRing  # noqa: F401
+from .ring import FleetRouter, RendezvousRing, seeds_key  # noqa: F401

@@ -27,11 +27,28 @@ affinity router would keep local, before anyone deploys one.
 an N-replica topology of bounded caches under affinity vs round-robin
 routing and report the effective-hit-ratio multiplier (the bench
 ``freshness`` phase runs it at the reference's 3-replica shape).
+
+**The routing half** (ISSUE 15): :class:`FleetRouter` is the live
+client/ingress router the measurement above was collecting decision
+data for. It routes each key to its rendezvous owner over the SAME ring
+the simulation uses — one canonical implementation, so the simulated
+multiplier is a prediction the fleet bench can falsify — and treats a
+failing peer exactly like the PR 3 replica circuit breaker treats a
+sick device replica: ``eject_threshold`` consecutive failures eject it
+from routing (traffic spills to the next-highest rendezvous weight for
+each key, the same bounded remap a peer removal would cause), and a
+half-open probe every ``probe_interval_s`` re-admits it on the first
+success. The serving side stays symmetric: replicas identified by
+``KMLS_FLEET_SELF`` / ``KMLS_FLEET_PEERS`` answer mis-routed traffic
+locally (degrade, never fail) while stamping ``X-KMLS-Cache-Owner`` and
+counting ``kmls_cache_misrouted_total`` so routing drift is observable.
 """
 
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
 from collections import OrderedDict
 
 
@@ -59,12 +76,127 @@ class RendezvousRing:
     def owner_index(self, key: str) -> int:
         return self.peers.index(self.owner(key))
 
+    def ranked(self, key: str) -> list[str]:
+        """Every peer in descending rendezvous weight for ``key`` — THE
+        spill order. ``ranked(key)[0]`` is :meth:`owner`; removing the
+        owner promotes ``ranked(key)[1]``, exactly the peer a ring built
+        without the owner would elect (each survivor keeps its weight),
+        so a router that spills down this list on peer loss remaps ONLY
+        the lost peer's keys — the bounded-remap property."""
+        return sorted(
+            self.peers, key=lambda p: (_weight(p, key), p), reverse=True
+        )
+
 
 def seeds_key(seeds: list[str]) -> str:
     """The ring key for a seed set — same canonicalization as the answer
     cache (sorted, duplicates kept), so the owner of a request is the
     owner of its cache entry."""
     return "\x1f".join(sorted(seeds))
+
+
+class _PeerHealth:
+    __slots__ = ("consecutive_failures", "ejected", "next_probe_at")
+
+    def __init__(self) -> None:
+        self.consecutive_failures = 0
+        self.ejected = False
+        self.next_probe_at = 0.0
+
+
+class FleetRouter:
+    """Health-aware rendezvous routing over the live peer set — the
+    client/ingress half of the fleet cache tier (ISSUE 15).
+
+    :meth:`route` returns the highest-weight NON-ejected peer for a key
+    (the rendezvous owner while everyone is healthy). Failure handling
+    mirrors the PR 3 replica circuit breaker, peer-for-peer:
+
+    - ``eject_threshold`` CONSECUTIVE failures (``mark_failure``) eject
+      a peer from routing; its keys spill to each key's next-highest
+      rendezvous weight — the same bounded remap an actual membership
+      change would cause, so survivors' caches never stampede;
+    - an ejected peer is half-open probed: once per ``probe_interval_s``
+      :meth:`route` hands it ONE request; ``mark_success`` re-admits it
+      (its keys return — again only its own keys remap), another
+      failure re-arms the probe timer;
+    - with EVERY peer ejected the router fails open to the rendezvous
+      owner (routing somewhere beats routing nowhere — the serving side
+      degrades, never fails).
+
+    Thread-safe (a pacing thread routes while worker threads mark);
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        peers: list[str],
+        *,
+        eject_threshold: int = 3,
+        probe_interval_s: float = 1.0,
+        clock=time.monotonic,
+    ):
+        self.ring = RendezvousRing(peers)
+        self.eject_threshold = max(1, eject_threshold)
+        self.probe_interval_s = probe_interval_s
+        self._clock = clock
+        self._health = {p: _PeerHealth() for p in self.ring.peers}
+        self._lock = threading.Lock()
+        self.ejections = 0
+        self.readmissions = 0
+        self.probes = 0
+        self.spills = 0
+
+    @property
+    def peers(self) -> list[str]:
+        return self.ring.peers
+
+    def route(self, key: str) -> str:
+        now = self._clock()
+        ranked = self.ring.ranked(key)
+        with self._lock:
+            for i, peer in enumerate(ranked):
+                health = self._health[peer]
+                if not health.ejected:
+                    if i > 0:
+                        self.spills += 1
+                    return peer
+                if now >= health.next_probe_at:
+                    # half-open: ONE request per probe interval auditions
+                    # the ejected peer; everything else keeps spilling
+                    health.next_probe_at = now + self.probe_interval_s
+                    self.probes += 1
+                    return peer
+            # every peer ejected: fail open to the rendezvous owner
+            return ranked[0]
+
+    def mark_failure(self, peer: str) -> None:
+        with self._lock:
+            health = self._health.get(peer)
+            if health is None:
+                return
+            health.consecutive_failures += 1
+            if health.ejected:
+                # failed probe: push the next audition out a full interval
+                health.next_probe_at = self._clock() + self.probe_interval_s
+            elif health.consecutive_failures >= self.eject_threshold:
+                health.ejected = True
+                health.next_probe_at = self._clock() + self.probe_interval_s
+                self.ejections += 1
+
+    def mark_success(self, peer: str) -> None:
+        with self._lock:
+            health = self._health.get(peer)
+            if health is None:
+                return
+            health.consecutive_failures = 0
+            if health.ejected:
+                health.ejected = False
+                self.readmissions += 1
+
+    def ejected_peers(self) -> list[str]:
+        with self._lock:
+            return [p for p, h in self._health.items() if h.ejected]
 
 
 class _BoundedSet:
@@ -99,12 +231,16 @@ def simulate_fleet(
     if policy not in ("affinity", "roundrobin", "random"):
         raise ValueError(f"unknown routing policy {policy!r}")
     peers = [f"replica-{i}" for i in range(max(1, n_replicas))]
+    # the ONE ring implementation: the same RendezvousRing the live
+    # FleetRouter (and the app's owner stamping) routes on, so the
+    # simulated multiplier is a prediction the fleet bench can falsify —
+    # drift between simulation and routing is impossible by construction
     ring = RendezvousRing(peers) if policy == "affinity" else None
     caches = [_BoundedSet(capacity) for _ in peers]
     hits = 0
     for i, key in enumerate(keys):
         if ring is not None:
-            idx = ring.peers.index(ring.owner(key))
+            idx = ring.owner_index(key)
         elif policy == "roundrobin":
             idx = i % len(peers)
         else:

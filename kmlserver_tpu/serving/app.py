@@ -97,11 +97,13 @@ class RecommendApp:
 
     # class-level defaults so hand-assembled test apps (``__new__`` +
     # attribute injection, no ``__init__``) keep working as the surface
-    # grows — the affinity layer is default-off anyway
+    # grows — the affinity/routing layer is default-off anyway
     ring = None
     _ring_self = ""
+    fleet_routing = False
     affinity_local_total = 0
     affinity_remote_total = 0
+    misrouted_total = 0
     slo = None
     _profile_thread = None
     _profile_lock = threading.Lock()
@@ -166,25 +168,65 @@ class RecommendApp:
         listeners = getattr(self.engine, "delta_listeners", None)
         if listeners is not None:
             listeners.append(self._on_delta_applied)
-        # fleet cache affinity (freshness/ring.py): default-off counters
-        # measuring what fraction of traffic a rendezvous-hash router
-        # would keep on THIS replica — the decision data for affinity
-        # routing vs a shared external cache tier
+        # fleet cache tier (freshness/ring.py). Two arming levels over
+        # ONE ring implementation — the same RendezvousRing the client
+        # router and simulate_fleet use, so measurement, simulation and
+        # routing can never disagree on an owner:
+        #   KMLS_CACHE_AFFINITY=1        — measurement only (PR 10): count
+        #       what fraction of traffic a router would keep local;
+        #   KMLS_FLEET_PEERS non-empty   — owner-aware serving (ISSUE 15):
+        #       the routing tier is live at the client/ingress, so a
+        #       request this replica does not own is routing DRIFT —
+        #       answer it locally (degrade gracefully, never fail), stamp
+        #       X-KMLS-Cache-Owner, and count non-owned misses as
+        #       kmls_cache_misrouted_total. The affinity counters keep
+        #       running either way (local fraction ≈ routing health).
         self.ring = None
         self._ring_self = ""
+        self.fleet_routing = False
         self.affinity_local_total = 0
         self.affinity_remote_total = 0
-        if cfg.cache_affinity:
+        self.misrouted_total = 0
+        fleet_peers = [
+            p.strip()
+            for p in (getattr(cfg, "fleet_peers", "") or "").split(",")
+            if p.strip()
+        ]
+        if fleet_peers or cfg.cache_affinity:
             import socket as socket_mod
 
             from ..freshness.ring import RendezvousRing
 
-            me = cfg.cache_affinity_self or socket_mod.gethostname()
-            peers = [
-                p.strip()
-                for p in (cfg.cache_affinity_peers or "").split(",")
-                if p.strip()
-            ]
+            if fleet_peers:
+                me = (
+                    getattr(cfg, "fleet_self", "")
+                    or socket_mod.gethostname()
+                )
+                peers = fleet_peers
+                self.fleet_routing = True
+                if me not in peers:
+                    # a SELF missing from PEERS means this replica would
+                    # route ownership on an (N+1)-peer ring no client or
+                    # sibling uses — the misrouted metric would measure
+                    # the misconfig's noise, not routing drift. Keep
+                    # serving (degrade, never fail) but say it loudly:
+                    # this is the scaled-without-updating-PEERS drift the
+                    # StatefulSet recipe warns about.
+                    logger.error(
+                        "KMLS_FLEET_SELF %r is not in KMLS_FLEET_PEERS "
+                        "%r — this replica's ownership ring now differs "
+                        "from the fleet's; kmls_cache_misrouted_total "
+                        "will measure the misconfiguration, not routing "
+                        "drift. Fix the peer list (it must track the "
+                        "replica set exactly).", me, peers,
+                    )
+            else:
+                me = cfg.cache_affinity_self or socket_mod.gethostname()
+                peers = [
+                    p.strip()
+                    for p in (cfg.cache_affinity_peers or "").split(",")
+                    if p.strip()
+                ]
             if me not in peers:
                 peers.append(me)
             self.ring = RendezvousRing(peers)
@@ -403,6 +445,16 @@ class RecommendApp:
             # router would keep on this replica (0/0 with the layer off)
             "cache_affinity_local_total": self.affinity_local_total,
             "cache_affinity_remote_total": self.affinity_remote_total,
+            # fleet cache routing (ISSUE 15): non-owned MISSES this
+            # replica answered locally — routing drift at the ingress/
+            # client (0 while routing is healthy or the tier is off) —
+            # and the configured routing-ring size (0 = tier unarmed)
+            "cache_misrouted_total": self.misrouted_total,
+            "fleet_peers": (
+                len(self.ring.peers)
+                if (self.fleet_routing and self.ring is not None)
+                else 0
+            ),
         }
         ejected_fn = getattr(self.batcher, "ejected_replicas", None)
         state["replicas_ejected"] = (
@@ -621,6 +673,40 @@ class RecommendApp:
             return "overload"
         return None
 
+    def _stamp_owner(
+        self, headers: dict, songs: list[str] | None, cached: bool
+    ) -> None:
+        """Owner-aware serving (ISSUE 15): with the fleet routing tier
+        armed (KMLS_FLEET_PEERS), a request whose rendezvous owner is
+        another replica is mis-routed traffic — it is still ANSWERED
+        locally (mis-routes degrade gracefully, never fail), but the
+        response stamps ``X-KMLS-Cache-Owner`` so the router/operator
+        can see the drift, and a non-owned MISS (work the owner's cache
+        already holds) counts ``kmls_cache_misrouted_total``. Cache hits
+        are stamped but not counted: a hit did no duplicate device work.
+        GIL-coalesced adds, same benign-race budget as the affinity
+        counters. This is the ONE owner computation in routing mode —
+        the affinity counters ride the same digest instead of paying a
+        second one in _cache_lookup_or_lead (answered requests only;
+        sheds/errors never reach a response builder, which is exactly
+        the traffic the ownership fraction should describe)."""
+        if not self.fleet_routing or self.ring is None or not songs:
+            return
+        from ..freshness.ring import seeds_key
+
+        owner = self.ring.owner(seeds_key(songs))
+        if owner == self._ring_self:
+            self.affinity_local_total += 1
+            return
+        self.affinity_remote_total += 1
+        # identities come from operator env config: strip CR/LF so a
+        # malformed peer list can never smuggle a header line
+        headers["X-KMLS-Cache-Owner"] = (
+            owner.replace("\r", "").replace("\n", "")
+        )
+        if not cached:
+            self.misrouted_total += 1
+
     def _degraded_response(
         self, t0: float, songs: list[str], reason: str, trace=None
     ) -> Response:
@@ -645,6 +731,7 @@ class RecommendApp:
             },
         )
         headers["X-KMLS-Degraded"] = reason
+        self._stamp_owner(headers, songs, cached=False)
         if trace is not None:
             # the ladder decision rides a span attribute: "overload" IS
             # the admission controller's degrade rung; deadline/replica-
@@ -726,7 +813,7 @@ class RecommendApp:
 
     def _recommend_result_response(
         self, t0: float, recs: list[str], source: str, cached: bool = False,
-        trace=None,
+        trace=None, songs: list[str] | None = None,
     ) -> Response:
         # compose span: answer-available (the future just resolved — the
         # caller invokes this immediately after) → response bytes built
@@ -740,6 +827,7 @@ class RecommendApp:
                 "version": self.cfg.version,
             },
         )
+        self._stamp_owner(headers, songs, cached=cached)
         if cached:
             # lets load harnesses (serving/replay.py) split cached vs
             # computed latency without guessing from timing
@@ -788,11 +876,14 @@ class RecommendApp:
         only when set — test doubles keep their bare ``submit(seeds)``
         signature. "off" covers: cache disabled, no batcher, or a batcher
         without ``submit`` (test doubles) — callers compute inline there."""
-        if self.ring is not None:
-            # affinity accounting on the ONE path both transports share:
-            # is THIS replica the rendezvous owner of the request's cache
-            # key? (counters only — no routing yet; GIL-coalesced adds,
-            # same benign-race budget as the batcher's in-flight counts)
+        if self.ring is not None and not self.fleet_routing:
+            # affinity accounting (measurement mode) on the ONE path both
+            # transports share: is THIS replica the rendezvous owner of
+            # the request's cache key? (counters only — no routing;
+            # GIL-coalesced adds, same benign-race budget as the
+            # batcher's in-flight counts). In ROUTING mode _stamp_owner
+            # drives these counters from its single owner computation
+            # instead — one seeds sort + N digests per request, not two.
             from ..freshness.ring import seeds_key
 
             if self.ring.owner(seeds_key(songs)) == self._ring_self:
@@ -902,7 +993,7 @@ class RecommendApp:
                 return self._degraded_response(t0, songs, reason, trace=trace)
             return self._recommend_error_response(exc, trace=trace)
         return self._recommend_result_response(
-            t0, recs, source, cached=cached, trace=trace
+            t0, recs, source, cached=cached, trace=trace, songs=songs
         )
 
     # ---------- async-transport entry points ----------
@@ -945,7 +1036,7 @@ class RecommendApp:
                 )
             return (
                 self._recommend_result_response(
-                    t0, recs, source, cached=cached, trace=trace
+                    t0, recs, source, cached=cached, trace=trace, songs=songs
                 ),
                 None, t0, None,
             )
@@ -976,7 +1067,8 @@ class RecommendApp:
         if state == "hit":
             return (
                 self._recommend_result_response(
-                    t0, payload[0], payload[1], cached=True, trace=trace
+                    t0, payload[0], payload[1], cached=True, trace=trace,
+                    songs=songs,
                 ),
                 None, t0, None,
             )
@@ -995,7 +1087,10 @@ class RecommendApp:
                 songs = getattr(future, "_kmls_seeds", None) or []
                 return self._degraded_response(t0, songs, reason, trace=trace)
             return self._recommend_error_response(exc, trace=trace)
-        return self._recommend_result_response(t0, recs, source, trace=trace)
+        return self._recommend_result_response(
+            t0, recs, source, trace=trace,
+            songs=getattr(future, "_kmls_seeds", None),
+        )
 
     def _get_client(self) -> Response:
         """Render the HTML test client with a sampled seed + static sample

@@ -70,6 +70,13 @@ METRIC_REGISTRY: dict[str, str] = {
     # for affinity routing vs a shared external cache tier.
     "kmls_cache_affinity_local_total": "counter:serving",
     "kmls_cache_affinity_remote_total": "counter:serving",
+    # fleet cache routing (ISSUE 15): with KMLS_FLEET_PEERS armed, a
+    # non-owned miss answered locally is routing DRIFT at the ingress/
+    # client — the counter a dashboard alerts on when the consistent-
+    # hash tier stops keeping keys on their owners — plus the configured
+    # routing-ring size (0 = tier unarmed)
+    "kmls_cache_misrouted_total": "counter:serving",
+    "kmls_fleet_peers": "gauge:serving",
     # --- serving: dispatch / layout ---
     "kmls_device_dispatch_total": "counter:serving",
     "kmls_shard_dispatch_total": "counter:serving",

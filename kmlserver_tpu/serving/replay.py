@@ -594,6 +594,33 @@ def replay_pooled(
     )
 
 
+def _parse_http_head(head: bytes) -> tuple[int, int, bytes]:
+    """One copy of the pipelined clients' response-head parse →
+    ``(status, content_length, lowercased head)`` — shared by
+    :func:`replay_async_http` and :func:`replay_fleet_http` so the two
+    drivers can never diverge in what they count as an answer."""
+    head_lower = head.lower()
+    clen = 0
+    for line in head_lower.split(b"\r\n"):
+        if line.startswith(b"content-length"):
+            clen = int(line.split(b":", 1)[1])
+    return int(head.split(b" ", 2)[1]), clen, head_lower
+
+
+async def _open_http_conn(host: str, port: int):
+    """Persistent loadgen connection with TCP_NODELAY (the header and
+    body go out as separate-enough writes that Nagle would serialize
+    them behind delayed ACKs)."""
+    import asyncio
+    import socket as socket_mod
+
+    reader, writer = await asyncio.open_connection(host, port)
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        sock.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
+    return reader, writer
+
+
 def replay_async_http(
     url: str,
     payloads: list[list[str]],
@@ -617,7 +644,6 @@ def replay_async_http(
     burst wait included, so an overloaded server (or client) shows up
     as latency/drops, never as reduced offered load."""
     import asyncio
-    import socket as socket_mod
     import urllib.parse
 
     u = urllib.parse.urlsplit(url)
@@ -649,13 +675,7 @@ def replay_async_http(
         queue: "asyncio.Queue" = asyncio.Queue(maxsize=max_queue)
 
         async def connect():
-            reader, writer = await asyncio.open_connection(host, port)
-            sock = writer.get_extra_info("socket")
-            if sock is not None:
-                sock.setsockopt(
-                    socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1
-                )
-            return reader, writer
+            return await _open_http_conn(host, port)
 
         async def worker() -> None:
             nonlocal errors
@@ -686,13 +706,8 @@ def replay_async_http(
                     writer.write(b"".join(reqs[i] for _, i in burst))
                     for t_arr, _i in burst:
                         head = await reader.readuntil(b"\r\n\r\n")
-                        clen = 0
-                        head_lower = head.lower()
-                        for line in head_lower.split(b"\r\n"):
-                            if line.startswith(b"content-length"):
-                                clen = int(line.split(b":", 1)[1])
+                        status, clen, head_lower = _parse_http_head(head)
                         body = await reader.readexactly(clen)
-                        status = int(head.split(b" ", 2)[1])
                         done += 1
                         t_done = time.perf_counter()
                         if trace_log is not None:
@@ -773,6 +788,299 @@ def replay_async_http(
         by_source=by_source,
         **_cache_split_fields(lat_cached, lat_uncached, n_ok),
     )
+
+
+def replay_fleet_http(
+    peer_urls: dict[str, str],
+    payloads: list[list[str]],
+    *,
+    qps: float,
+    policy: str = "ring",
+    n_conns: int = 4,
+    pipeline: int = 16,
+    max_queue: int = 8192,
+    eject_threshold: int = 3,
+    probe_interval_s: float = 1.0,
+    redispatch_max: int = 4,
+    window_end: int | None = None,
+    events: list | None = None,
+) -> tuple[ReplayReport, dict]:
+    """Open-loop HTTP replay against an N-replica FLEET with client-side
+    consistent-hash routing (ISSUE 15) — the load generator half of the
+    fleet cache tier, and the local stand-in for a consistent-hash
+    ingress. One event loop; per peer, ``n_conns`` persistent pipelined
+    connections (the ``replay_async_http`` transport).
+
+    ``policy``:
+
+    - ``ring`` — each request routes to the rendezvous owner of its
+      canonicalized seed-set key via :class:`~..freshness.ring
+      .FleetRouter`: the SAME ring implementation ``simulate_fleet``
+      scores and the serving side stamps owners with, so the simulated
+      hit-ratio multiplier is a prediction this replay can falsify. A
+      peer failing ``eject_threshold`` consecutive sends is ejected
+      (PR 3 circuit-breaker semantics) and its keys spill to their
+      next-highest rendezvous weight — the bounded remap — with a
+      half-open probe every ``probe_interval_s`` for re-admission.
+    - ``roundrobin`` — the independent-caches baseline: the same fleet,
+      no affinity, every replica re-warms the same head.
+
+    A send that dies mid-burst re-dispatches its UNanswered requests
+    through the router (up to ``redispatch_max`` attempts each) before
+    counting an error, so a replica kill mid-replay must surface as
+    remap + survivor latency, never as drops. Latency always runs from
+    the scheduled arrival — retries included.
+
+    ``window_end`` additionally splits cache-outcome accounting at that
+    request index (the fleet bench judges the hit-ratio multiplier on
+    the pre-kill window so the kill's cold remap doesn't blur the
+    routed-vs-independent comparison). → ``(ReplayReport, fleet)`` where
+    ``fleet`` carries hit ratios, per-peer answer counts, 5xx/reroute/
+    ejection counters, and owner-stamped (misrouted) observations."""
+    import asyncio
+    import urllib.parse
+
+    from ..freshness.ring import FleetRouter, seeds_key
+
+    if policy not in ("ring", "roundrobin"):
+        raise ValueError(f"unknown fleet routing policy {policy!r}")
+    peers = sorted(peer_urls)
+    router = FleetRouter(
+        peers,
+        eject_threshold=eject_threshold,
+        probe_interval_s=probe_interval_s,
+    )
+    addr: dict[str, tuple[str, int]] = {}
+    for peer, url in peer_urls.items():
+        u = urllib.parse.urlsplit(url)
+        addr[peer] = (u.hostname or "127.0.0.1", u.port or 80)
+    keys = [seeds_key(p) for p in payloads]
+    reqs: list[bytes] = []
+    for seeds in payloads:
+        body = json.dumps({"songs": seeds}).encode()
+        reqs.append(
+            b"POST /api/recommend/ HTTP/1.1\r\nHost: replay\r\n"
+            b"Content-Type: application/json\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+    arrival = np.cumsum(
+        np.random.default_rng(12345).exponential(
+            1.0 / qps, size=len(payloads)
+        )
+    )
+
+    lat_ms: list[float] = []
+    lat_cached: list[float] = []
+    lat_uncached: list[float] = []
+    stats = {
+        "http_5xx": 0, "owner_stamped": 0, "rerouted": 0, "errors": 0,
+        "win_total": 0, "win_hits": 0,
+    }
+    answered_by = {p: 0 for p in peers}
+
+    async def _run() -> None:
+        queues = {p: asyncio.Queue(maxsize=max_queue) for p in peers}
+        outstanding = [0]
+        drained = asyncio.Event()
+        drained.set()
+
+        def _enter() -> None:
+            outstanding[0] += 1
+            drained.clear()
+
+        def _leave() -> None:
+            outstanding[0] -= 1
+            if outstanding[0] <= 0:
+                drained.set()
+
+        def _redispatch(item, failed_peer: str) -> None:
+            """One failed request back out (spill), or an honest error
+            once its re-dispatch budget is spent. Ring policy spills
+            through the router; the round-robin BASELINE must stay
+            hash-free even on retries — routing its failures to
+            rendezvous owners would warm owner caches exactly like the
+            routed leg and inflate the baseline hit ratio the multiplier
+            is judged against — so it retries on the next peer in fixed
+            cyclic order instead."""
+            t_arr, idx, attempts = item
+            if attempts >= redispatch_max:
+                stats["errors"] += 1
+                _leave()
+                return
+            if policy == "ring":
+                target = router.route(keys[idx])
+            else:
+                step = 1 + (attempts % max(len(peers) - 1, 1))
+                target = peers[(peers.index(failed_peer) + step) % len(peers)]
+            stats["rerouted"] += 1
+            try:
+                queues[target].put_nowait((t_arr, idx, attempts + 1))
+            except asyncio.QueueFull:
+                stats["errors"] += 1
+                _leave()
+
+        def _account(peer: str, item, status: int, head_lower: bytes) -> None:
+            t_arr, idx, _attempts = item
+            if status >= 500:
+                stats["http_5xx"] += 1
+                stats["errors"] += 1
+                _leave()
+                return
+            if status != 200:
+                stats["errors"] += 1
+                _leave()
+                return
+            dt_ms = (time.perf_counter() - t_arr) * 1e3
+            lat_ms.append(dt_ms)
+            hit = b"x-kmls-cache: hit" in head_lower
+            (lat_cached if hit else lat_uncached).append(dt_ms)
+            if b"x-kmls-cache-owner:" in head_lower:
+                stats["owner_stamped"] += 1
+            if window_end is not None and idx < window_end:
+                stats["win_total"] += 1
+                stats["win_hits"] += int(hit)
+            answered_by[peer] += 1
+            _leave()
+
+        async def connect(peer: str):
+            return await _open_http_conn(*addr[peer])
+
+        async def worker(peer: str) -> None:
+            queue = queues[peer]
+            reader = writer = None
+            while True:
+                item = await queue.get()
+                if item is None:
+                    if writer is not None:
+                        writer.close()
+                    return
+                burst = [item]
+                while len(burst) < pipeline:
+                    try:
+                        extra = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if extra is None:
+                        queue.put_nowait(None)
+                        break
+                    burst.append(extra)
+                if writer is None:
+                    try:
+                        reader, writer = await connect(peer)
+                    except OSError:
+                        # peer unreachable: one failure mark per burst
+                        # (the breaker counts failure EVENTS, like the
+                        # batcher's per-batch accounting), spill the work
+                        router.mark_failure(peer)
+                        for it in burst:
+                            _redispatch(it, peer)
+                        continue
+                done = 0
+                try:
+                    writer.write(b"".join(reqs[i] for _, i, _a in burst))
+                    for it in burst:
+                        head = await reader.readuntil(b"\r\n\r\n")
+                        status, clen, head_lower = _parse_http_head(head)
+                        await reader.readexactly(clen)
+                        done += 1
+                        _account(peer, it, status, head_lower)
+                    router.mark_success(peer)
+                except Exception:
+                    # answered prefix already accounted; the unanswered
+                    # tail spills through the router (a mid-replay kill
+                    # must read as remap, not as drops)
+                    router.mark_failure(peer)
+                    for it in burst[done:]:
+                        _redispatch(it, peer)
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    reader = writer = None
+
+        workers = [
+            asyncio.create_task(worker(p))
+            for p in peers
+            for _ in range(n_conns)
+        ]
+        fired: set = set()
+        t0 = time.perf_counter()
+        for i in range(len(payloads)):
+            wait = arrival[i] - (time.perf_counter() - t0)
+            if wait > 0:
+                await asyncio.sleep(wait)
+            if events:
+                for j, (at_index, fn) in enumerate(events):
+                    if j not in fired and i >= at_index:
+                        fired.add(j)
+                        fn()
+            target = (
+                router.route(keys[i])
+                if policy == "ring"
+                else peers[i % len(peers)]
+            )
+            _enter()
+            try:
+                queues[target].put_nowait((t0 + arrival[i], i, 0))
+            except asyncio.QueueFull:
+                stats["errors"] += 1
+                _leave()
+        # every request is answered, errored, or re-dispatched before the
+        # pool shuts down — re-dispatches re-enter a queue, so sentinels
+        # can only go out once the in-flight count settles to zero
+        try:
+            await asyncio.wait_for(drained.wait(), timeout=120.0)
+        except asyncio.TimeoutError:
+            # wedged (a peer hung mid-response past every retry): count
+            # the stuck tail honestly and tear the pool down
+            stats["errors"] += max(outstanding[0], 0)
+            for w in workers:
+                w.cancel()
+            await asyncio.gather(*workers, return_exceptions=True)
+            return
+        for p in peers:
+            for _ in range(n_conns):
+                queues[p].put_nowait(None)
+        await asyncio.gather(*workers)
+
+    start = time.perf_counter()
+    asyncio.run(_run())
+    duration = time.perf_counter() - start
+    lat_sorted = sorted(lat_ms)
+    n_ok = len(lat_sorted)
+    n_errors = stats["errors"]
+    report = ReplayReport(
+        target_qps=qps,
+        offered_qps=(n_ok + n_errors) / duration if duration > 0 else 0.0,
+        achieved_qps=n_ok / duration if duration > 0 else 0.0,
+        duration_s=duration,
+        n_requests=len(payloads),
+        n_errors=n_errors,
+        p50_ms=_percentile(lat_sorted, 0.50),
+        p95_ms=_percentile(lat_sorted, 0.95),
+        p99_ms=_percentile(lat_sorted, 0.99),
+        by_source={"fleet": n_ok},
+        **_cache_split_fields(lat_cached, lat_uncached, n_ok),
+    )
+    fleet = {
+        "policy": policy,
+        "peers": peers,
+        "answered_by": dict(answered_by),
+        "hit_ratio": (len(lat_cached) / n_ok) if n_ok else 0.0,
+        "window_hit_ratio": (
+            stats["win_hits"] / stats["win_total"]
+            if stats["win_total"]
+            else None
+        ),
+        "window_requests": stats["win_total"],
+        "http_5xx": stats["http_5xx"],
+        "rerouted": stats["rerouted"],
+        "ejections": router.ejections,
+        "readmissions": router.readmissions,
+        "spills": router.spills,
+        "owner_stamped": stats["owner_stamped"],
+    }
+    return report, fleet
 
 
 def pooled_http_sender_factory(url: str, trace_log: ClientTraceLog | None = None):
@@ -871,6 +1179,18 @@ def main() -> int:
         help="burst-shape rate multiplier over --qps",
     )
     parser.add_argument(
+        "--fleet", default=None, metavar="PEER=URL,...",
+        help="replay against an N-replica fleet with client-side "
+             "consistent-hash routing (freshness/ring.py): comma-"
+             "separated peer=url pairs whose peer names match each "
+             "server's KMLS_FLEET_SELF. Overrides --url.",
+    )
+    parser.add_argument(
+        "--fleet-policy", choices=("ring", "roundrobin"), default="ring",
+        help="fleet routing policy: ring (rendezvous owner, the cache "
+             "tier) or roundrobin (the independent-caches baseline)",
+    )
+    parser.add_argument(
         "--trace-log", default=None, metavar="PATH",
         help="write echoed X-KMLS-Trace ids + client send/recv wall "
              "clocks as JSONL (HTTP targets only; requires the server's "
@@ -887,6 +1207,35 @@ def main() -> int:
         )
         reshape = lambda p: p  # noqa: E731
 
+    if args.fleet:
+        peer_urls = dict(
+            pair.split("=", 1)
+            for pair in args.fleet.split(",")
+            if "=" in pair
+        )
+        if not peer_urls:
+            print("--fleet needs at least one peer=url pair")
+            return 1
+        if args.shape != "constant" or args.trace_log:
+            # refuse rather than silently pace a constant stream under a
+            # burst/trace label — the operator would read un-shaped
+            # numbers as shaped evidence
+            print(
+                "--fleet supports constant arrivals only (no --shape/"
+                "--trace-log yet); drop the unsupported flag"
+            )
+            return 1
+        vocab = _local_vocab()
+        payloads = reshape(
+            sample_seed_sets(vocab, args.requests, zipf_s=args.zipf_s)
+        )
+        report, fleet = replay_fleet_http(
+            peer_urls, payloads, qps=args.qps, policy=args.fleet_policy,
+        )
+        out = json.loads(report.to_json())
+        out["fleet"] = fleet
+        print(json.dumps(out))
+        return 0
     if args.url:
         vocab = _local_vocab()
         if not vocab:

@@ -16,10 +16,11 @@ def _load(name):
 
 def test_all_manifests_parse():
     paths = glob.glob(os.path.join(REPO, "kubernetes", "*.yaml"))
-    assert len(paths) == 6
+    assert len(paths) == 7
     for p in paths + [os.path.join(REPO, "argocd_manifest.yaml")]:
         with open(p) as fh:
-            # multi-doc manifests (job-multihost.yaml: Service + Job)
+            # multi-doc manifests (job-multihost.yaml / statefulset.yaml:
+            # Service + workload)
             docs = list(yaml.safe_load_all(fh))
             assert docs and all(d is not None for d in docs), p
 
@@ -157,6 +158,48 @@ def test_deployment_env_contract_probes_and_tpu():
     } <= _env_names(container)
     assert container["resources"]["requests"]["google.com/tpu"]
     assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "fast-api-claim"
+
+
+def test_statefulset_fleet_identity_contract():
+    """The fleet cache tier's identity recipe (ISSUE 15): a headless
+    Service + StatefulSet give each pod the STABLE ordinal name the
+    rendezvous ring hashes over, and the KMLS_FLEET_* knobs mirror that
+    identity into the app — SELF from the pod's own name via the
+    downward API, PEERS listing exactly spec.replicas ordinals (the
+    peer list and the replica count must not drift apart, or the ring
+    routes keys at pods that don't exist)."""
+    with open(os.path.join(REPO, "kubernetes", "statefulset.yaml")) as fh:
+        docs = list(yaml.safe_load_all(fh))
+    svc = next(d for d in docs if d["kind"] == "Service")
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+    # headless: per-pod DNS records, no VIP — the router addresses
+    # ordinals directly (k8s spells headless as the literal string
+    # "None", which YAML faithfully keeps a string)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+    assert svc["spec"]["selector"] == sts["spec"]["selector"]["matchLabels"]
+    spec = sts["spec"]["template"]["spec"]
+    container = spec["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+    # SELF = the pod's own stable name (metadata.name), not a literal
+    self_ref = env["KMLS_FLEET_SELF"]["valueFrom"]["fieldRef"]["fieldPath"]
+    assert self_ref == "metadata.name"
+    # PEERS = exactly spec.replicas ordinals of this StatefulSet
+    name = sts["metadata"]["name"]
+    peers = env["KMLS_FLEET_PEERS"]["value"].split(",")
+    assert sorted(peers) == [
+        f"{name}-{i}" for i in range(sts["spec"]["replicas"])
+    ]
+    # same serving contracts as the Deployment: /readyz readiness,
+    # /healthz liveness, the shared PVC
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert (
+        spec["volumes"][0]["persistentVolumeClaim"]["claimName"]
+        == "fast-api-claim"
+    )
+    # no bootstrap ordering: readiness is artifacts-on-PVC, not peers
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
 
 
 def test_hpa_scales_on_exported_utilization_signal():

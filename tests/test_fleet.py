@@ -1,0 +1,281 @@
+"""Fleet cache tier (ISSUE 15) — consistent-hash request routing.
+
+Three layers under test, all on ONE ring implementation
+(kmlserver_tpu/freshness/ring.py — the unification is itself a pinned
+property here, so the PR 10 simulated multiplier stays a falsifiable
+prediction about the live router):
+
+- :class:`RendezvousRing` edge cases — empty/single peer sets, the
+  minimal-remap bound on membership change (property-tested both
+  directions), and byte-stable hashing (digests pinned, so owners agree
+  across processes, hosts, and Python builds);
+- :class:`FleetRouter` — circuit-breaker peer ejection (PR 3 semantics:
+  consecutive-failure threshold, spill to next-highest rendezvous
+  weight, half-open probe re-admission), under a fake clock;
+- :func:`replay_fleet_http` routing policy — the routed client's owner
+  choice is the ring's, request for request.
+
+The multi-process acceptance (2-3 real servers, routed replay, peer
+kill, delta apply) lives in the bench `fleet` phase and CI's fleet
+smoke; app-level owner-aware serving (X-KMLS-Cache-Owner +
+kmls_cache_misrouted_total) is pinned in tests/test_freshness.py next
+to its affinity siblings.
+"""
+
+import pytest
+
+from kmlserver_tpu.freshness.ring import (
+    FleetRouter,
+    RendezvousRing,
+    _weight,
+    seeds_key,
+    simulate_fleet,
+)
+
+# ---------------------------------------------------------------------------
+# ring edge cases
+# ---------------------------------------------------------------------------
+
+
+class TestRingEdgeCases:
+    def test_empty_peer_set_raises(self):
+        with pytest.raises(ValueError):
+            RendezvousRing([])
+        with pytest.raises(ValueError):
+            RendezvousRing(["", "   "])
+        with pytest.raises(ValueError):
+            FleetRouter([])
+
+    def test_single_peer_owns_everything(self):
+        ring = RendezvousRing(["only"])
+        for i in range(50):
+            key = f"k{i}"
+            assert ring.owner(key) == "only"
+            assert ring.ranked(key) == ["only"]
+            assert ring.owner_index(key) == 0
+        # and the simulation degenerates to one plain LRU
+        assert simulate_fleet(["a"] * 10, 1, 8, "affinity") == \
+            pytest.approx(0.9)
+
+    def test_duplicate_and_padded_peers_collapse(self):
+        a = RendezvousRing(["p0", "p1"])
+        b = RendezvousRing([" p1 ", "p0", "p0"])
+        assert a.peers == b.peers
+        for i in range(50):
+            assert a.owner(f"k{i}") == b.owner(f"k{i}")
+
+    def test_ranked_head_is_owner_and_order_is_total(self):
+        ring = RendezvousRing([f"pod-{i}" for i in range(5)])
+        for i in range(200):
+            key = f"key-{i}"
+            ranked = ring.ranked(key)
+            assert ranked[0] == ring.owner(key)
+            assert sorted(ranked) == ring.peers
+
+    def test_peer_removal_remap_is_minimal_and_exact(self):
+        """Removing a peer remaps EXACTLY the keys it owned — each
+        survivor keeps its weight, so every other key keeps its owner,
+        and each remapped key lands on its next-highest weight (the
+        FleetRouter's spill target). ~1/N of keys move."""
+        peers = [f"pod-{i}" for i in range(5)]
+        full = RendezvousRing(peers)
+        reduced = RendezvousRing(peers[:-1])
+        keys = [f"key-{i}" for i in range(2000)]
+        moved = 0
+        for key in keys:
+            before = full.owner(key)
+            after = reduced.owner(key)
+            if before == "pod-4":
+                moved += 1
+                assert after == full.ranked(key)[1]
+            else:
+                assert after == before
+        # binomial around 2000/5 = 400; 6 sigma ≈ 120
+        assert 280 <= moved <= 520
+
+    def test_peer_addition_moves_at_most_its_own_share(self):
+        """The ≤ 1/N remap bound on ADD: every key that moves moves TO
+        the new peer (nothing shuffles between survivors), and the moved
+        fraction concentrates around 1/(N+1)."""
+        peers = [f"pod-{i}" for i in range(4)]
+        before_ring = RendezvousRing(peers)
+        after_ring = RendezvousRing(peers + ["pod-new"])
+        keys = [f"key-{i}" for i in range(2000)]
+        moved = 0
+        for key in keys:
+            before = before_ring.owner(key)
+            after = after_ring.owner(key)
+            if before != after:
+                moved += 1
+                assert after == "pod-new"
+        # binomial around 2000/5 = 400; 6 sigma ≈ 120 → well under 2/N
+        assert moved <= 520
+
+    def test_hashing_is_byte_stable_across_processes_and_hosts(self):
+        """Rendezvous weights are keyed blake2b digests — no per-process
+        salt (unlike ``hash()``), no platform dependence. Pinned VALUES:
+        if these move, every deployed replica disagrees with every
+        client about ownership, silently. The serving side, the router,
+        and simulate_fleet all route through this one function."""
+        assert _weight("replica-0", "k0") == 7985035379626015798
+        assert _weight("replica-1", "k0") == 588770993634544374
+        ring = RendezvousRing(["replica-0", "replica-1", "replica-2"])
+        assert [ring.owner(f"k{i}") for i in range(8)] == [
+            "replica-2", "replica-1", "replica-2", "replica-1",
+            "replica-2", "replica-1", "replica-1", "replica-1",
+        ]
+
+    def test_simulation_and_router_share_the_owner_function(self):
+        """The unification satellite, pinned as behavior: a healthy
+        FleetRouter routes every key exactly where simulate_fleet's
+        affinity policy banks it — one ring, drift impossible."""
+        peers = [f"replica-{i}" for i in range(3)]
+        ring = RendezvousRing(peers)
+        router = FleetRouter(peers)
+        for i in range(300):
+            key = seeds_key([f"s{i}", f"t{i % 7}"])
+            assert router.route(key) == ring.owner(key)
+            assert ring.owner_index(key) == ring.peers.index(ring.owner(key))
+
+
+# ---------------------------------------------------------------------------
+# the health-aware router (PR 3 circuit-breaker semantics, peer-for-peer)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRouter:
+    def _router(self, clock, **kw):
+        kw.setdefault("eject_threshold", 3)
+        kw.setdefault("probe_interval_s", 5.0)
+        return FleetRouter(
+            ["a", "b", "c"], clock=lambda: clock[0], **kw
+        )
+
+    def test_healthy_routing_is_owner_routing(self):
+        clock = [0.0]
+        router = self._router(clock)
+        for i in range(100):
+            key = f"k{i}"
+            assert router.route(key) == router.ring.owner(key)
+        assert router.spills == 0
+        assert router.ejections == 0
+
+    def test_failures_below_threshold_keep_the_owner(self):
+        clock = [0.0]
+        router = self._router(clock)
+        key = "some-key"
+        owner = router.ring.owner(key)
+        router.mark_failure(owner)
+        router.mark_failure(owner)
+        assert router.route(key) == owner
+        # success resets the consecutive count — two more failures still
+        # don't eject (the breaker counts CONSECUTIVE failures)
+        router.mark_success(owner)
+        router.mark_failure(owner)
+        router.mark_failure(owner)
+        assert router.route(key) == owner
+        assert router.ejections == 0
+
+    def test_eject_spills_to_next_highest_weight(self):
+        clock = [0.0]
+        router = self._router(clock)
+        key = "some-key"
+        ranked = router.ring.ranked(key)
+        for _ in range(3):
+            router.mark_failure(ranked[0])
+        assert router.ejected_peers() == [ranked[0]]
+        assert router.ejections == 1
+        # every key the dead peer owned spills to ITS OWN second choice;
+        # keys owned by survivors never move (bounded remap, live)
+        spilled_before = router.spills
+        for i in range(200):
+            k = f"key-{i}"
+            r = router.ring.ranked(k)
+            expect = r[1] if r[0] == ranked[0] else r[0]
+            assert router.route(k) == expect
+        assert router.spills > spilled_before
+
+    def test_half_open_probe_and_readmission(self):
+        clock = [0.0]
+        router = self._router(clock)
+        key = "some-key"
+        ranked = router.ring.ranked(key)
+        for _ in range(3):
+            router.mark_failure(ranked[0])
+        # inside the probe interval: spill only
+        clock[0] = 4.0
+        assert router.route(key) == ranked[1]
+        # past it: exactly ONE probe request auditions the ejected peer
+        clock[0] = 6.0
+        assert router.route(key) == ranked[0]
+        assert router.route(key) == ranked[1]  # second ask spills again
+        # the probe failed: next audition waits a full interval
+        router.mark_failure(ranked[0])
+        clock[0] = 10.0
+        assert router.route(key) == ranked[1]
+        clock[0] = 12.0
+        assert router.route(key) == ranked[0]
+        # the probe succeeded: re-admitted, owner routing resumes
+        router.mark_success(ranked[0])
+        assert router.readmissions == 1
+        assert router.ejected_peers() == []
+        for _ in range(10):
+            assert router.route(key) == ranked[0]
+
+    def test_all_peers_ejected_fails_open_to_owner(self):
+        clock = [0.0]
+        router = self._router(clock)
+        for peer in ("a", "b", "c"):
+            for _ in range(3):
+                router.mark_failure(peer)
+        key = "k"
+        # probes exhausted for this instant → the rendezvous owner
+        # (routing somewhere beats routing nowhere; serving degrades,
+        # never fails)
+        router.route(key)  # may be a probe
+        router.route(key)
+        router.route(key)
+        assert router.route(key) == router.ring.owner(key)
+
+    def test_unknown_peer_marks_are_ignored(self):
+        clock = [0.0]
+        router = self._router(clock)
+        router.mark_failure("never-heard-of-it")
+        router.mark_success("nor-this-one")
+        assert router.ejected_peers() == []
+
+
+# ---------------------------------------------------------------------------
+# the routed replay client's policy glue
+# ---------------------------------------------------------------------------
+
+
+class TestRoutedReplayPolicy:
+    def test_unknown_policy_raises(self):
+        from kmlserver_tpu.serving.replay import replay_fleet_http
+
+        with pytest.raises(ValueError):
+            replay_fleet_http(
+                {"a": "http://127.0.0.1:1"}, [["x"]], qps=10.0,
+                policy="bogus",
+            )
+
+    def test_routed_replay_against_dead_fleet_reports_errors_not_hang(self):
+        """Every peer unreachable: the router ejects them all, every
+        request burns its re-dispatch budget, and the report carries
+        honest errors — the client never wedges or raises."""
+        from kmlserver_tpu.serving.replay import replay_fleet_http
+
+        # closed ports (connect refused fast): 3 dead peers
+        peer_urls = {
+            f"replica-{i}": f"http://127.0.0.1:{9}" for i in range(3)
+        }
+        payloads = [[f"s{i}"] for i in range(40)]
+        report, fleet = replay_fleet_http(
+            peer_urls, payloads, qps=2000.0, redispatch_max=2,
+            eject_threshold=2, probe_interval_s=0.05,
+        )
+        assert report.n_errors == len(payloads)
+        assert fleet["http_5xx"] == 0
+        assert fleet["ejections"] >= 1
+        assert report.achieved_qps == 0.0

@@ -704,6 +704,126 @@ class TestRendezvousRing:
         assert 0 < app.affinity_local_total < 40
 
 
+class TestOwnerAwareServing:
+    """Fleet cache ROUTING identity (ISSUE 15): with KMLS_FLEET_PEERS
+    armed, a request this replica does not own is answered locally —
+    mis-routed traffic degrades gracefully, never fails — but stamps
+    ``X-KMLS-Cache-Owner`` and counts non-owned MISSES as
+    ``kmls_cache_misrouted_total``, so routing drift at the ingress/
+    client is observable per pod."""
+
+    def _fleet_app(self, delta_pvc, self_name="pod-a"):
+        _, serving_cfg, _ = delta_pvc
+        cfg = dataclasses.replace(
+            serving_cfg,
+            fleet_self=self_name,
+            fleet_peers="pod-a,pod-b,pod-c",
+        )
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        assert app.fleet_routing
+        return app
+
+    def _seed_sets_by_ownership(self, app, n=60):
+        owned, foreign = [], []
+        for i in range(n):
+            seeds = [f"s{i % 12:03d}", f"probe-{i}"]
+            owner = app.ring.owner(seeds_key(seeds))
+            (owned if owner == app._ring_self else foreign).append(seeds)
+        assert owned and foreign  # 3 peers: both sides populated
+        return owned, foreign
+
+    def _post(self, app, seeds):
+        return app.handle(
+            "POST", "/api/recommend/",
+            json.dumps({"songs": seeds}).encode(),
+        )
+
+    def test_foreign_keys_stamp_owner_and_count_misses(self, delta_pvc):
+        app = self._fleet_app(delta_pvc)
+        owned, foreign = self._seed_sets_by_ownership(app)
+        seeds = foreign[0]
+        status, headers, _ = self._post(app, seeds)
+        assert status == 200  # answered locally: degrade, never fail
+        assert headers["X-KMLS-Cache-Owner"] == app.ring.owner(
+            seeds_key(seeds)
+        )
+        assert app.misrouted_total == 1
+        # the hit repeats the stamp (the drift observable) but does NOT
+        # re-count: a hit did no duplicate device work
+        status, headers, _ = self._post(app, seeds)
+        assert status == 200
+        assert headers.get("X-KMLS-Cache") == "hit"
+        assert headers["X-KMLS-Cache-Owner"] == app.ring.owner(
+            seeds_key(seeds)
+        )
+        assert app.misrouted_total == 1
+
+    def test_owned_keys_never_stamp(self, delta_pvc):
+        app = self._fleet_app(delta_pvc)
+        owned, _ = self._seed_sets_by_ownership(app)
+        for seeds in owned[:5]:
+            status, headers, _ = self._post(app, seeds)
+            assert status == 200
+            assert "X-KMLS-Cache-Owner" not in headers
+        assert app.misrouted_total == 0
+
+    def test_fleet_identity_arms_affinity_counters_too(self, delta_pvc):
+        app = self._fleet_app(delta_pvc)
+        owned, foreign = self._seed_sets_by_ownership(app)
+        for seeds in owned[:3]:
+            self._post(app, seeds)
+        for seeds in foreign[:4]:
+            self._post(app, seeds)
+        assert app.affinity_local_total == 3
+        assert app.affinity_remote_total == 4
+
+    def test_metrics_carry_misrouted_and_fleet_peers(self, delta_pvc):
+        app = self._fleet_app(delta_pvc)
+        _, foreign = self._seed_sets_by_ownership(app)
+        self._post(app, foreign[0])
+        _, _, body = app.handle("GET", "/metrics", b"")
+        text = body.decode()
+        assert "kmls_cache_misrouted_total 1" in text
+        assert "kmls_fleet_peers 3" in text
+
+    def test_unarmed_app_has_no_owner_surface(self, delta_pvc):
+        _, serving_cfg, _ = delta_pvc
+        app = RecommendApp(serving_cfg)
+        assert app.engine.load()
+        assert not app.fleet_routing
+        status, headers, _ = self._post(app, ["s000"])
+        assert status == 200
+        assert "X-KMLS-Cache-Owner" not in headers
+        _, _, body = app.handle("GET", "/metrics", b"")
+        text = body.decode()
+        assert "kmls_cache_misrouted_total 0" in text
+        assert "kmls_fleet_peers 0" in text
+
+    def test_degraded_answers_still_stamp_owner(self, delta_pvc):
+        """Mis-routed traffic must degrade gracefully, never fail: even
+        an answer that fell back to the popularity ranking carries the
+        owner stamp (and counts — it did local work the owner's cache
+        may already hold)."""
+        _, serving_cfg, _ = delta_pvc
+        cfg = dataclasses.replace(
+            serving_cfg,
+            fleet_self="pod-a",
+            fleet_peers="pod-a,pod-b,pod-c",
+            request_deadline_ms=0.000001,  # everything degrades
+        )
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        _, foreign = TestOwnerAwareServing._seed_sets_by_ownership(
+            self, app
+        )
+        status, headers, _ = self._post(app, foreign[0])
+        assert status == 200
+        assert headers.get("X-KMLS-Degraded")
+        assert "X-KMLS-Cache-Owner" in headers
+        assert app.misrouted_total == 1
+
+
 # ---------------------------------------------------------------------------
 # /debug/traces loopback restriction + the tracejoin smoke
 # ---------------------------------------------------------------------------
