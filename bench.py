@@ -392,6 +392,18 @@ _COMPACT_PRIORITY = (
     "fleet_multiplier_achieved", "fleet_multiplier_simulated",
     "fleet_p99_ms", "fleet_http_5xx", "fleet_errors",
     "fleet_identity_ok",
+    # judged serve-mesh claims (ISSUE 16): gang answers bit-identical to
+    # the single-process kernels with zero compiles, max servable
+    # catalog = per-host budget x gang size, and zero 5xx / zero drops
+    # through a mid-replay gang-member SIGKILL (the refusal + ejection
+    # counters prove the shard loss actually happened) — ranked with the
+    # fleet block below the TPU serving evidence (CPU-measured by
+    # construction, the socket transport stands in for GSPMD-over-DCN);
+    # per-peer, budget-bytes and replay detail is sidecar-only
+    "meshserve_p50_ms", "meshserve_p99_ms", "meshserve_sharded_p50_ms",
+    "meshserve_identical", "meshserve_gang", "meshserve_unwarmed",
+    "meshserve_max_catalog_bytes", "meshserve_http_5xx",
+    "meshserve_errors", "meshserve_mesh_unavailable", "meshserve_ejections",
     # judged quality-loop claims (ISSUE 14): held-out recall@k per
     # serving mode (blend at the MEASURED optimum vs both pure modes),
     # the measured weight round-tripping report → bundle → serve time,
@@ -3039,6 +3051,270 @@ with tempfile.TemporaryDirectory(prefix="kmls_shardserve_") as base:
     }))
 """
 
+# the pod-spanning serve-mesh bracket (ISSUE 16): the same over-budget
+# catalog served two ways — single-PROCESS sharded (the ISSUE 7 ceiling:
+# whatever one host's devices hold) vs a 2-member serve GANG where each
+# member holds only its vocab slab and the answer merges over the socket
+# mesh transport. Identity leg pins gang answers bit-identical to the
+# replicated reference AND the single-process sharded kernel on BOTH
+# members with zero compiles post-publish; the chaos leg runs 2 REAL
+# gang server processes + 1 solo replica behind the routed replay client
+# and SIGKILLs a gang member mid-replay — the gang must degrade exactly
+# like a dead replica (503 + X-KMLS-Mesh-Unavailable → whole-gang
+# ejection → spill to the solo peer), never as a 5xx or a drop.
+_MESHSERVE_BENCH = r"""
+import dataclasses, json, os, re, signal, socket, subprocess, sys
+import tempfile, threading, time, urllib.request
+import numpy as np
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.engine import RecommendEngine
+from kmlserver_tpu.serving.replay import replay_fleet_http, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_MESHSERVE_QPS", "500"))
+n_req = int(os.environ.get("KMLS_BENCH_MESHSERVE_REQUESTS", "4000"))
+GANG = 2
+n_devices = len(jax.devices())
+assert n_devices >= GANG, f"mesh bracket needs >={GANG} virtual devices"
+
+def gang_ports():
+    # a base port where base..base+GANG-1 are all free: bare-host
+    # coordinator addressing derives member ports by rank offset
+    for base in range(29170, 29970, 10):
+        socks = []
+        try:
+            for r in range(GANG):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + r))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free consecutive port pair")
+
+with tempfile.TemporaryDirectory(prefix="kmls_meshserve_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = dataclasses.replace(
+        MiningConfig.from_env(dotenv_path=None), base_dir=base,
+        datasets_dir=ds_dir, min_support=0.05,
+    )
+    run_mining_job(mcfg)
+
+    common = dict(
+        base_dir=base, batch_max_size=32, max_seed_tracks=8,
+        native_serve=False,
+    )
+    rep = RecommendEngine(dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), serve_devices=1, **common
+    ))
+    assert rep.load()
+    catalog_bytes = int(
+        np.asarray(rep.bundle.rule_ids).nbytes
+        + np.asarray(rep.bundle.rule_confs).nbytes
+    )
+    # budget HALF the catalog: neither one virtual device nor one gang
+    # member can hold a replica — the single-process comparator must
+    # measure its way to sharded, the gang spans the rest over sockets
+    budget = max(catalog_bytes // 2, 1)
+    shd = RecommendEngine(dataclasses.replace(
+        ServingConfig.from_env(dotenv_path=None), serve_devices=n_devices,
+        model_layout="auto", device_budget_bytes=budget, **common
+    ))
+    assert shd.load()
+    assert shd.bundle.layout == "sharded", shd.bundle.layout
+
+    mesh_base = gang_ports()
+    members = []
+    for rank in range(GANG):
+        m = RecommendEngine(dataclasses.replace(
+            ServingConfig.from_env(dotenv_path=None),
+            device_budget_bytes=budget,
+            serve_gang_coordinator=f"127.0.0.1:{mesh_base}",
+            serve_gang_size=GANG, serve_gang_rank=rank,
+            serve_gang_port=mesh_base + rank,
+            **common,
+        ))
+        members.append(m)
+    for rank, m in enumerate(members):
+        assert m.load(), f"gang rank {rank} failed to load"
+        assert m.bundle.layout == "mesh", m.bundle.layout
+
+    bundle = shd.bundle
+    rng = np.random.default_rng(0)
+    known = [
+        s for s in bundle.vocab if bundle.known_mask[bundle.index[s]]
+    ]
+    sets = [
+        list(rng.choice(known, size=int(rng.integers(1, 5)), replace=False))
+        for _ in range(32)
+    ]
+    ref_ans = rep.recommend_many_async(sets)()
+    identical = (
+        ref_ans == shd.recommend_many_async(sets)()
+        and all(ref_ans == m.recommend_many_async(sets)() for m in members)
+    )
+
+    def bracket(engine, reps=40):
+        engine.recommend_many_async(sets)()  # warm the bucket
+        lat = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            engine.recommend_many_async(sets)()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        return lat[len(lat) // 2], lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+
+    shd_p50, shd_p99 = bracket(shd)
+    mesh_p50, mesh_p99 = bracket(members[0])
+    unwarmed = sum(m.unwarmed_dispatches for m in members)
+    missing = members[0].mesh_missing_shards()
+    assert missing == [], f"gang dark mid-bracket: {missing}"
+    for m in members:  # free the mesh ports before the HTTP leg
+        if m.mesh_worker is not None:
+            m.mesh_worker.stop()
+        if m.mesh_coordinator is not None:
+            m.mesh_coordinator.close()
+    print(
+        f"identity leg: identical={identical}, unwarmed={unwarmed}, "
+        f"sharded p50 {shd_p50:.2f}ms vs mesh p50 {mesh_p50:.2f}ms",
+        file=sys.stderr, flush=True,
+    )
+
+    # ---- chaos leg: 2 REAL gang server processes + 1 solo replica.
+    # The ring lists the gang ONCE (rank 0's URL is the gang's front
+    # door); mid-replay SIGKILL of rank 1 darkens a SHARD, and the
+    # routed client must see only 503+X-KMLS-Mesh-Unavailable refusals
+    # (ejection + spill to solo), zero 5xx, zero drops.
+    http_base = gang_ports()  # fresh pair for the server gang
+    procs, ports, logs = {}, {}, {}
+    def _terminate_all():
+        for proc in procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    def start_server(name, gang_rank=None):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # servers don't need the virtual mesh
+        env.update({
+            "BASE_DIR": base, "KMLS_PORT": "0",
+            "KMLS_SHED_QUEUE_BUDGET_MS": "0",
+            "KMLS_FLEET_SELF": "gang" if gang_rank is not None else "solo",
+            "KMLS_FLEET_PEERS": "gang,solo",
+        })
+        if gang_rank is not None:
+            env.update({
+                "KMLS_SERVE_GANG_COORDINATOR": f"127.0.0.1:{http_base}",
+                "KMLS_SERVE_GANG_SIZE": str(GANG),
+                "KMLS_SERVE_GANG_RANK": str(gang_rank),
+                # bare-host addressing: member rank r binds base + r
+                "KMLS_SERVE_GANG_PORT": str(http_base + gang_rank),
+            })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kmlserver_tpu.serving.server"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        lines = []
+        logs[name] = lines
+        def drain():
+            for line in proc.stdout:
+                lines.append(line.rstrip())
+                m = re.search(r"serving on \S+?:(\d+)", line)
+                if m and name not in ports:
+                    ports[name] = int(m.group(1))
+        threading.Thread(target=drain, daemon=True).start()
+        procs[name] = proc
+        return proc
+
+    try:
+        for rank in range(GANG):
+            start_server(f"gang-{rank}", gang_rank=rank)
+        start_server("solo")
+        t_wait = time.time()
+        while len(ports) < GANG + 1 and time.time() - t_wait < 120:
+            time.sleep(0.1)
+        assert len(ports) == GANG + 1, f"servers never reported ports: {ports}"
+        def wait_ready(url, deadline_s=180):
+            t0 = time.time()
+            while time.time() - t0 < deadline_s:
+                try:
+                    with urllib.request.urlopen(url + "/readyz", timeout=5) as r:
+                        if r.status == 200:
+                            return True
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            return False
+        urls = {
+            name: f"http://127.0.0.1:{port}" for name, port in ports.items()
+        }
+        for name, url in urls.items():
+            assert wait_ready(url), f"{name} never went ready"
+        print(f"mesh fleet up: {urls}", file=sys.stderr, flush=True)
+
+        vocab = sorted(known)
+        payloads = sample_seed_sets(
+            vocab, n_req, rng_seed=61, zipf_s=1.1, zipf_pool=2048,
+        )
+        kill_at = int(n_req * 0.5)
+        victim = procs[f"gang-{GANG - 1}"]
+        events = [(kill_at, lambda: victim.send_signal(signal.SIGKILL))]
+        # the gang is ONE ring peer, fronted by rank 0
+        ring_urls = {"gang": urls["gang-0"], "solo": urls["solo"]}
+        rep_http, fleet = replay_fleet_http(
+            ring_urls, payloads, qps=qps, policy="ring", events=events,
+        )
+    finally:
+        _terminate_all()
+
+    assert fleet["http_5xx"] == 0, f"5xx through shard loss: {fleet}"
+    assert rep_http.n_errors == 0, f"drops through shard loss: {rep_http}"
+    assert fleet["mesh_unavailable"] >= 1, f"no mesh refusals seen: {fleet}"
+    assert fleet["ejections"] >= 1, f"gang never ejected: {fleet}"
+    print(json.dumps({
+        "gang_size": GANG,
+        "identical": bool(identical),
+        "unwarmed_dispatches": unwarmed,
+        "catalog_bytes": catalog_bytes,
+        "host_budget_bytes": budget,
+        "max_catalog_bytes": budget * GANG,
+        "sharded_p50_ms": round(shd_p50, 3),
+        "sharded_p99_ms": round(shd_p99, 3),
+        "mesh_p50_ms": round(mesh_p50, 3),
+        "mesh_p99_ms": round(mesh_p99, 3),
+        "replay_qps": qps,
+        "replay_requests": n_req,
+        "achieved_qps": rep_http.achieved_qps,
+        "replay_p99_ms": rep_http.p99_ms,
+        "http_5xx": fleet["http_5xx"],
+        "errors": rep_http.n_errors,
+        "mesh_unavailable": fleet["mesh_unavailable"],
+        "ejections": fleet["ejections"],
+        "failed_shards": fleet["failed_shards"],
+        "answered_by": fleet["answered_by"],
+        "platform": dev.platform,
+    }))
+"""
+
 # vocab-sharded mining bracket (ISSUE 7): a basket matrix whose dense
 # single-device formulation busts the (deliberately small) HBM budget is
 # mined through the sharded count→emit pipeline on a 1x8 vocab mesh —
@@ -4082,6 +4358,14 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         _record_fleet(result, bank="fleet_cpu", budget_s=240)
         em.checkpoint()
 
+    # pod-spanning serve-mesh bracket (ISSUE 16): CPU-measured by
+    # construction (socket transport stands in for GSPMD-over-DCN) —
+    # the gang-vs-sharded identity + shard-loss zero-5xx evidence must
+    # ride the TPU artifact too
+    if "meshserve_identical" not in result:
+        _record_meshserve(result, bank="meshserve_cpu", budget_s=240)
+        em.checkpoint()
+
     # quality-loop bracket (ISSUE 14): CPU-measured by construction —
     # the held-out recall / measured-weight / compaction-identity
     # evidence must ride the TPU artifact too
@@ -4205,6 +4489,13 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # that exceeds one (virtual) device's budget, answers stay
         # bit-identical to replicated, zero compiles post-publish
         _record_shardserve(result)
+        em.checkpoint()
+
+    if _remaining() > 240:
+        # pod-spanning serve mesh (ISSUE 16): a 2-member gang over the
+        # socket transport vs single-process sharded on the same
+        # over-budget catalog, + the mid-replay gang-member SIGKILL
+        _record_meshserve(result)
         em.checkpoint()
 
     if _remaining() > 240:
@@ -4952,6 +5243,63 @@ def _record_shardserve(
         ("sharded_p50_ms", "shardserve_sharded_p50_ms"),
         ("sharded_p99_ms", "shardserve_sharded_p99_ms"),
         ("platform", "shardserve_platform"),
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_meshserve(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The pod-spanning serve-mesh bracket (ISSUE 16): a 2-member gang
+    (each holding only its vocab slab, merging over the socket mesh
+    transport) serves the SAME over-budget catalog as the single-process
+    sharded kernel — answers pinned bit-identical to replicated AND
+    sharded on BOTH members, zero compiles post-publish, max servable
+    catalog = per-host budget x gang size. The chaos leg SIGKILLs a
+    gang member mid-replay behind the routed client: zero 5xx, zero
+    drops, whole-gang ejection with the dark shard blamed. CPU-platform
+    by construction (socket transport), self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "meshserve", _MESHSERVE_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"meshserve: gang of {res['gang_size']}, identical="
+        f"{res['identical']}, unwarmed={res['unwarmed_dispatches']}, "
+        f"sharded p50 {res['sharded_p50_ms']:.2f}ms vs mesh p50 "
+        f"{res['mesh_p50_ms']:.2f}ms, max catalog "
+        f"{res['max_catalog_bytes'] / 1e6:.1f} MB across the gang; chaos "
+        f"leg {res['http_5xx']} 5xx / {res['errors']} drops through a "
+        f"gang-member SIGKILL ({res['mesh_unavailable']} mesh refusals, "
+        f"{res['ejections']} ejections)"
+    )
+    for src, dst in (
+        ("gang_size", "meshserve_gang"),
+        ("identical", "meshserve_identical"),
+        ("unwarmed_dispatches", "meshserve_unwarmed"),
+        ("catalog_bytes", "meshserve_catalog_bytes"),
+        ("host_budget_bytes", "meshserve_host_budget_bytes"),
+        ("max_catalog_bytes", "meshserve_max_catalog_bytes"),
+        ("sharded_p50_ms", "meshserve_sharded_p50_ms"),
+        ("sharded_p99_ms", "meshserve_sharded_p99_ms"),
+        ("mesh_p50_ms", "meshserve_p50_ms"),
+        ("mesh_p99_ms", "meshserve_p99_ms"),
+        ("achieved_qps", "meshserve_achieved_qps"),
+        ("replay_p99_ms", "meshserve_replay_p99_ms"),
+        ("http_5xx", "meshserve_http_5xx"),
+        ("errors", "meshserve_errors"),
+        ("mesh_unavailable", "meshserve_mesh_unavailable"),
+        ("ejections", "meshserve_ejections"),
+        ("platform", "meshserve_platform"),
     ):
         if src in res and res[src] is not None:
             val = res[src]

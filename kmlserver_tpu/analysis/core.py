@@ -63,6 +63,7 @@ class AnalysisConfig:
     manifest_files: tuple[str, ...] = (
         "kubernetes/deployment.yaml",
         "kubernetes/statefulset.yaml",
+        "kubernetes/serve-gang.yaml",
         "kubernetes/job.yaml",
         "kubernetes/job-multihost.yaml",
     )
@@ -191,6 +192,9 @@ class AnalysisConfig:
             "serving": (
                 "kubernetes/deployment.yaml",
                 "kubernetes/statefulset.yaml",
+                # the pod-spanning serve-gang recipe (ISSUE 16) binds
+                # the KMLS_SERVE_GANG_* knobs
+                "kubernetes/serve-gang.yaml",
             ),
             "mining": (
                 "kubernetes/job.yaml",

@@ -93,6 +93,24 @@ def _getenv_model_layout() -> str:
     return validate_layout(os.getenv("KMLS_MODEL_LAYOUT", "replicated"))
 
 
+def _getenv_gang_rank() -> int:
+    """``KMLS_SERVE_GANG_RANK``: explicit rank, falling back to the same
+    identity recipe as the mining bootstrap (``JOB_COMPLETION_INDEX``,
+    then the hostname's trailing StatefulSet ordinal —
+    ``parallel.distributed.gang_rank_fallback`` is the canonical copy;
+    this mirror keeps config import-light)."""
+    raw = os.getenv("KMLS_SERVE_GANG_RANK")
+    if raw not in (None, ""):
+        return int(raw)
+    idx = os.getenv("JOB_COMPLETION_INDEX")
+    if idx is not None and idx.isdigit():
+        return int(idx)
+    import socket
+
+    _, _, ordinal = socket.gethostname().rpartition("-")
+    return int(ordinal) if ordinal.isdigit() else 0
+
+
 def _getenv_bitpack_threshold() -> int | str | None:
     """``KMLS_BITPACK_THRESHOLD_ELEMS``: "auto" (HBM-fit dispatch, the
     default), "none"/"never" (dense always), or an explicit element count."""
@@ -184,6 +202,18 @@ KNOB_REGISTRY: dict[str, str] = {
     # answers, and the kmls_cache_misrouted_total drift counter.
     "KMLS_FLEET_SELF": "serving",
     "KMLS_FLEET_PEERS": "serving",
+    # --- serving: pod-spanning serve mesh (ISSUE 16) ---
+    # gang bootstrap mirroring the mining job's KMLS_PROCESS_ID recipe
+    # (kubernetes/serve-gang.yaml binds RANK from the StatefulSet pod
+    # index): COORDINATOR is rank 0's partial-fetch address, SIZE the
+    # gang width (== spec.replicas), PORT the base partial-protocol
+    # port. SIZE > 1 arms the "mesh" layout: each member holds only its
+    # vocab slab yet the gang presents ONE logical replica (and one
+    # ring peer) to the dispatcher.
+    "KMLS_SERVE_GANG_COORDINATOR": "serving",
+    "KMLS_SERVE_GANG_SIZE": "serving",
+    "KMLS_SERVE_GANG_RANK": "serving",
+    "KMLS_SERVE_GANG_PORT": "serving",
     # --- serving: observability (ISSUE 9) ---
     # span tracing: baseline sample rate for OK traces (0 = tracing off —
     # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
@@ -350,6 +380,11 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_BENCH_FLEET_REQUESTS": "tool",
     "KMLS_BENCH_FLEET_REPLICAS": "tool",
     "KMLS_BENCH_FLEET_CACHE": "tool",
+    # serve-mesh phase (ISSUE 16): rate / volume for the 2-process-gang
+    # vs single-process-sharded identity + chaos bracket (CI smoke
+    # shrinks both)
+    "KMLS_BENCH_MESHSERVE_QPS": "tool",
+    "KMLS_BENCH_MESHSERVE_REQUESTS": "tool",
     # quality-loop phase (ISSUE 14): membership-row volume of the eval/
     # compaction bracket's synthetic workload (CI smoke shrinks it)
     "KMLS_BENCH_QUALITY_ROWS": "tool",
@@ -863,6 +898,25 @@ class ServingConfig:
     fleet_self: str = ""
     fleet_peers: str = ""
 
+    # --- pod-spanning serve mesh (ISSUE 16) ---
+    # Gang bootstrap mirroring the mining job's KMLS_PROCESS_ID recipe:
+    # serve_gang_size > 1 arms the "mesh" layout — engine.load() on each
+    # gang member holds only its own vocab slab (rows
+    # [rank·slab, (rank+1)·slab)), serves per-slab top-k partials to its
+    # peers over the partial-fetch protocol (serving/mesh.py), and
+    # merges all slabs' partials exactly like the single-process sharded
+    # kernel's all_gather + max-merge — the gang presents ONE logical
+    # replica to the dispatcher and ONE ring member to the FleetRouter.
+    # coordinator is rank 0's partial-fetch address ("host:port"; the
+    # k8s recipe points it at the headless-Service ordinal-0 DNS name,
+    # the CPU simulation at 127.0.0.1 with per-rank ports base+rank);
+    # rank falls back to the hostname's trailing ordinal (the
+    # StatefulSet pod identity), mirroring JOB_COMPLETION_INDEX.
+    serve_gang_coordinator: str = ""
+    serve_gang_size: int = 1
+    serve_gang_rank: int = 0
+    serve_gang_port: int = 8477
+
     # --- observability (ISSUE 9): span tracing + runtime health ---
     # Baseline retention probability for OK traces once tracing is on.
     # 0 (default) disables tracing entirely: no trace context, no id
@@ -992,6 +1046,12 @@ class ServingConfig:
             cache_affinity_self=os.getenv("KMLS_CACHE_AFFINITY_SELF", ""),
             fleet_self=os.getenv("KMLS_FLEET_SELF", ""),
             fleet_peers=os.getenv("KMLS_FLEET_PEERS", ""),
+            serve_gang_coordinator=os.getenv(
+                "KMLS_SERVE_GANG_COORDINATOR", ""
+            ),
+            serve_gang_size=_getenv_int("KMLS_SERVE_GANG_SIZE", 1),
+            serve_gang_rank=_getenv_gang_rank(),
+            serve_gang_port=_getenv_int("KMLS_SERVE_GANG_PORT", 8477),
             trace_sample=_getenv_float("KMLS_TRACE_SAMPLE", 0.0),
             trace_buffer=_getenv_int("KMLS_TRACE_BUFFER", 512),
             trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),

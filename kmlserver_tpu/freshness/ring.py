@@ -96,12 +96,20 @@ def seeds_key(seeds: list[str]) -> str:
 
 
 class _PeerHealth:
-    __slots__ = ("consecutive_failures", "ejected", "next_probe_at")
+    __slots__ = (
+        "consecutive_failures", "ejected", "next_probe_at", "failed_shard"
+    )
 
     def __init__(self) -> None:
         self.consecutive_failures = 0
         self.ejected = False
         self.next_probe_at = 0.0
+        # when the peer is a pod-gang (ISSUE 16) and its failure named a
+        # missing vocab shard (X-KMLS-Mesh-Unavailable), the blamed rank
+        # — None for a plain transport/5xx failure. Observability only:
+        # ejection/spill/probe mechanics are identical either way (a
+        # gang missing one shard is as unservable as a dead replica).
+        self.failed_shard = None
 
 
 class FleetRouter:
@@ -170,12 +178,19 @@ class FleetRouter:
             # every peer ejected: fail open to the rendezvous owner
             return ranked[0]
 
-    def mark_failure(self, peer: str) -> None:
+    def mark_failure(self, peer: str, shard: int | None = None) -> None:
+        """Count one failure against ``peer``. ``shard`` carries the
+        blamed gang rank when the peer is a pod-gang that answered
+        gang-degraded (503 + ``X-KMLS-Mesh-Unavailable`` — a dead gang
+        MEMBER); the breaker mechanics are shard-blind — shard loss
+        degrades exactly like replica loss."""
         with self._lock:
             health = self._health.get(peer)
             if health is None:
                 return
             health.consecutive_failures += 1
+            if shard is not None:
+                health.failed_shard = int(shard)
             if health.ejected:
                 # failed probe: push the next audition out a full interval
                 health.next_probe_at = self._clock() + self.probe_interval_s
@@ -190,6 +205,7 @@ class FleetRouter:
             if health is None:
                 return
             health.consecutive_failures = 0
+            health.failed_shard = None
             if health.ejected:
                 health.ejected = False
                 self.readmissions += 1
@@ -197,6 +213,18 @@ class FleetRouter:
     def ejected_peers(self) -> list[str]:
         with self._lock:
             return [p for p, h in self._health.items() if h.ejected]
+
+    def failed_shards(self) -> dict[str, int]:
+        """peer → last blamed gang rank, for peers whose most recent
+        failure named a missing shard (cleared on success) — how an
+        operator reading the replay/router report tells 'the gang lost
+        member 1' apart from 'the whole pod died'."""
+        with self._lock:
+            return {
+                p: h.failed_shard
+                for p, h in self._health.items()
+                if h.failed_shard is not None
+            }
 
 
 class _BoundedSet:

@@ -188,6 +188,36 @@ def _sharded_serve_bytes(dims: dict) -> float:
     ) * 8.0
 
 
+def _mesh_serve_flops(dims: dict) -> float:
+    # ONE gang member's share of the pod-spanning lookup: the sharded
+    # kernel's per-shard half (1/shards of the candidate-lane gather,
+    # one slab partial top-k at GLOBAL width) plus the coordinator-side
+    # merge over the rank-stacked partials — peers' slab work runs on
+    # peer processes and is attributed there
+    b, length, k_max = _d(dims, "b"), _d(dims, "l"), _d(dims, "k_max")
+    v, shards, k_best = _d(dims, "v"), _d(dims, "shards"), _d(dims, "k_best", 10)
+    return b * (
+        2.0 * length * k_max / max(shards, 1.0)
+        + 2.0 * v * _log2k(k_best)
+        + 2.0 * shards * k_best
+    )
+
+
+def _mesh_serve_bytes(dims: dict) -> float:
+    # slab gather (1/shards of the rule lanes) + the partial and merge
+    # passes' (b, v+1) score vectors + the gang exchange: the seed batch
+    # sent to every peer and (shards-1) stacked (b, k_best) partials
+    # received over DCN (or the simulation transport's sockets)
+    b, length, k_max = _d(dims, "b"), _d(dims, "l"), _d(dims, "k_max")
+    v, shards, k_best = _d(dims, "v"), _d(dims, "shards"), _d(dims, "k_best", 10)
+    return (
+        b * length * (k_max * 8.0 / max(shards, 1.0) + 4.0)
+        + 2.0 * b * (v + 1.0) * 8.0
+        + (shards - 1.0) * b * (k_best * 8.0 + length * 4.0)
+        + b * k_best * 8.0
+    )
+
+
 def _embed_flops(dims: dict) -> float:
     # lax.scan over l seed slots: one (b, r) x (r, v) matmul each
     # (2·b·r·v), the running max-merge (b·v per step), final top-k
@@ -298,6 +328,12 @@ KERNEL_COST_SPECS: dict[str, CostSpec] = {
         "serve_sharded", _sharded_serve_flops, _sharded_serve_bytes,
         "vocab-sharded lookup + all_gather max-merge (ops/serve.py "
         "sharded_recommend_fn; dims + shards)",
+    ),
+    "serve_mesh": CostSpec(
+        "serve_mesh", _mesh_serve_flops, _mesh_serve_bytes,
+        "pod-spanning gang lookup: local slab partial + rank-stacked "
+        "merge (ops/serve.py shard_partial_topk/merge_partial_topk via "
+        "serving/mesh.py; dims + shards)",
     ),
     "serve_native": CostSpec(
         "serve_native", _serve_flops, _serve_bytes,

@@ -123,6 +123,77 @@ recommend_batch_donated = partial(
 # ---------------------------------------------------------------------------
 
 
+def _shard_partial_topk_impl(
+    rule_ids_loc: jax.Array,  # int32 (V_loc, K) — GLOBAL consequent ids
+    rule_confs_loc: jax.Array,  # float32 (V_loc, K)
+    seed_ids: jax.Array,  # int32 (B, L), -1 padded, GLOBAL ids, replicated
+    lo: jax.Array,  # int32 scalar: this shard's first global row
+    *,
+    v: int,
+    k_best: int,
+):
+    """One shard's (B, k_best) top-k partial at GLOBAL ids and width.
+
+    The seed batch is mapped onto this shard's row range [lo, lo+V_loc)
+    (seeds outside contribute nothing — the replicated kernel's
+    membership semantics, partitioned), its rows gathered, and the
+    candidates pushed through THE shared epilogue at the full vocab
+    width. ``lo`` is a traced scalar so one compiled program serves
+    every shard — inside shard_map it is ``axis_index * v_loc``; on a
+    serve-mesh gang member it is ``rank * v_loc``."""
+    v_loc = rule_ids_loc.shape[0]
+    b = seed_ids.shape[0]
+    in_shard = (seed_ids >= lo) & (seed_ids < lo + v_loc)
+    local_seeds = jnp.where(in_shard, seed_ids - lo, -1)
+    safe_seeds = jnp.where(local_seeds >= 0, local_seeds, 0)
+    gathered_ids = rule_ids_loc[safe_seeds]  # (B, L, K)
+    gathered_confs = rule_confs_loc[safe_seeds]
+    valid = (gathered_ids >= 0) & (local_seeds >= 0)[..., None]
+    return _masked_topk_from_candidates(
+        jnp.where(valid, gathered_ids, -1).reshape(b, -1),
+        jnp.where(valid, gathered_confs, 0.0).reshape(b, -1),
+        v=v, k_best=k_best,
+    )
+
+
+def _merge_partial_topk_impl(
+    all_ids: jax.Array,  # int32 (S, B, k_best) partials, SHARD order
+    all_confs: jax.Array,  # float32 (S, B, k_best)
+    *,
+    v: int,
+    k_best: int,
+):
+    """Cross-shard max-merge of per-shard partials → final (B, k_best).
+
+    Every shard's masked partial lanes become candidates for one more
+    pass through the shared epilogue. The leading axis must be in shard
+    order (all_gather's axis order inside shard_map; ascending gang rank
+    on the serve mesh) — the epilogue's scatter-max is order-invariant
+    in value, and top_k's index tie order sees only GLOBAL ids, so the
+    merge is bit-identical either way."""
+    s, b, k = all_ids.shape
+    return _masked_topk_from_candidates(
+        jnp.swapaxes(all_ids, 0, 1).reshape(b, s * k),
+        jnp.swapaxes(all_confs, 0, 1).reshape(b, s * k),
+        v=v, k_best=k_best,
+    )
+
+
+# Jitted module-level twins for the multi-process serve mesh
+# (serving/mesh.py): each gang member runs shard_partial_topk over its
+# resident vocab slab, the coordinator stacks the partials in rank order
+# and runs merge_partial_topk — the SAME two functions the shard_map
+# kernel below composes, which is what makes gang answers bit-identical
+# to the single-process sharded kernel by construction rather than by
+# parallel maintenance (pinned in tests/test_mesh.py).
+shard_partial_topk = partial(jax.jit, static_argnames=("v", "k_best"))(
+    _shard_partial_topk_impl
+)
+merge_partial_topk = partial(jax.jit, static_argnames=("v", "k_best"))(
+    _merge_partial_topk_impl
+)
+
+
 def _sharded_recommend_local(
     rule_ids_loc: jax.Array,  # int32 (V_loc, K) — GLOBAL consequent ids
     rule_confs_loc: jax.Array,  # float32 (V_loc, K)
@@ -134,29 +205,14 @@ def _sharded_recommend_local(
 ):
     v_loc = rule_ids_loc.shape[0]
     v = v_loc * n_shards  # padded global vocab width
-    b = seed_ids.shape[0]
     lo = jax.lax.axis_index(axis).astype(jnp.int32) * v_loc
-    in_shard = (seed_ids >= lo) & (seed_ids < lo + v_loc)
-    local_seeds = jnp.where(in_shard, seed_ids - lo, -1)
-    safe_seeds = jnp.where(local_seeds >= 0, local_seeds, 0)
-    gathered_ids = rule_ids_loc[safe_seeds]  # (B, L, K)
-    gathered_confs = rule_confs_loc[safe_seeds]
-    valid = (gathered_ids >= 0) & (local_seeds >= 0)[..., None]
-    # per-shard top-k partial: the SAME epilogue as the replicated kernel
-    # over this shard's candidate lanes (global ids, global width)
-    part_ids, part_confs = _masked_topk_from_candidates(
-        jnp.where(valid, gathered_ids, -1).reshape(b, -1),
-        jnp.where(valid, gathered_confs, 0.0).reshape(b, -1),
-        v=v, k_best=k_best,
+    part_ids, part_confs = _shard_partial_topk_impl(
+        rule_ids_loc, rule_confs_loc, seed_ids, lo, v=v, k_best=k_best,
     )
     all_ids = jax.lax.all_gather(part_ids, axis)  # (S, B, k_best)
     all_confs = jax.lax.all_gather(part_confs, axis)
-    # cross-shard max-merge: every shard's masked partial lanes become
-    # candidates for one more pass through the shared epilogue
-    return _masked_topk_from_candidates(
-        jnp.swapaxes(all_ids, 0, 1).reshape(b, n_shards * k_best),
-        jnp.swapaxes(all_confs, 0, 1).reshape(b, n_shards * k_best),
-        v=v, k_best=k_best,
+    return _merge_partial_topk_impl(
+        all_ids, all_confs, v=v, k_best=k_best,
     )
 
 

@@ -53,6 +53,15 @@ NUM_PROCESSES_ENV = "KMLS_NUM_PROCESSES"
 PROCESS_ID_ENV = "KMLS_PROCESS_ID"
 K8S_INDEX_ENV = "JOB_COMPLETION_INDEX"
 
+# Serve-gang bootstrap (ISSUE 16): the SERVING twin of the mining env
+# triple above — a StatefulSet gang of API pods whose vocab slabs form
+# one logical replica (kubernetes/serve-gang.yaml). Kept as separate env
+# names so a pod can, in principle, belong to a mining world AND a serve
+# gang without the two bootstraps clobbering each other.
+SERVE_GANG_COORDINATOR_ENV = "KMLS_SERVE_GANG_COORDINATOR"
+SERVE_GANG_SIZE_ENV = "KMLS_SERVE_GANG_SIZE"
+SERVE_GANG_RANK_ENV = "KMLS_SERVE_GANG_RANK"
+
 _initialized = False
 
 
@@ -93,6 +102,97 @@ def maybe_initialize() -> bool:
         num_processes=num_processes,
         process_id=process_id,
     )
+    _initialized = True
+    return True
+
+
+def gang_rank_fallback(default: int = 0) -> int:
+    """The serve gang's rank-from-identity recipe when
+    ``KMLS_SERVE_GANG_RANK`` is unset: under a StatefulSet the hostname
+    IS the stable ordinal identity (``serve-gang-1`` → rank 1) — the
+    serving twin of the mining Job's ``JOB_COMPLETION_INDEX`` fallback
+    (indexed Jobs inject that; StatefulSets don't, but their pod name
+    carries the same information)."""
+    raw = os.getenv(K8S_INDEX_ENV)
+    if raw is not None and raw.isdigit():
+        return int(raw)
+    import socket
+
+    host = socket.gethostname()
+    _, _, ordinal = host.rpartition("-")
+    return int(ordinal) if ordinal.isdigit() else default
+
+
+def serve_gang_env() -> tuple[str, int, int] | None:
+    """→ (coordinator, gang_size, rank) or None (no gang armed) — the
+    serve-mesh twin of :func:`distributed_env`, same fail-fast contract:
+    a rank outside the declared gang size is a config error surfaced at
+    boot, never a bootstrap hang."""
+    coordinator = os.getenv(SERVE_GANG_COORDINATOR_ENV)
+    if not coordinator:
+        return None
+    size = int(os.getenv(SERVE_GANG_SIZE_ENV, "1"))
+    raw = os.getenv(SERVE_GANG_RANK_ENV)
+    rank = int(raw) if raw not in (None, "") else gang_rank_fallback()
+    if rank >= size:
+        raise ValueError(
+            f"serve gang rank {rank} >= gang size {size}: set "
+            f"{SERVE_GANG_SIZE_ENV} to the StatefulSet's replica count"
+        )
+    return coordinator, size, rank
+
+
+def maybe_initialize_serve_gang(
+    coordinator: str, size: int, rank: int
+) -> bool:
+    """Join the REAL-collectives serve mesh (pjit/GSPMD over DCN): reuse
+    the mining bootstrap's ``jax.distributed.initialize`` with the serve
+    gang's triple, so on TPU the vocab axis of the sharded bundle spans
+    the gang's pods as one global mesh. Idempotent via the same
+    ``_initialized`` latch (one process joins ONE world — a pod is
+    either a mining rank or a serve-gang member, and re-entry from a
+    reload is a no-op either way).
+
+    Returns False without initializing when the backend cannot run
+    multi-process GSPMD (the CPU sandbox) — there the engine serves the
+    gang through the simulation transport (serving/mesh.py), which is
+    bit-identical by construction. On-chip validation of this path is
+    the standing TPU-window item."""
+    global _initialized
+    if size <= 1:
+        return False
+    if _initialized:
+        return True
+    # Gate on the platform ENV, not jax.default_backend(): probing the
+    # backend would initialize it, and jax.distributed.initialize must
+    # run before any backend touch on a real accelerator gang.
+    platforms = {
+        p.strip() for p in os.getenv("JAX_PLATFORMS", "").lower().split(",")
+        if p.strip()
+    }
+    if platforms and platforms <= {"cpu"}:
+        logger.info(
+            "serve gang %d/%d on the CPU backend: multi-process GSPMD "
+            "unavailable — serving via the simulation transport",
+            rank, size,
+        )
+        return False
+    logger.info(
+        "joining serve-gang runtime: coordinator=%s rank=%d/%d",
+        coordinator, rank, size,
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=size,
+            process_id=rank,
+        )
+    except Exception as exc:  # fail soft: the sim transport still serves
+        logger.warning(
+            "serve-gang collective bootstrap failed (%s); falling back "
+            "to the simulation transport", exc,
+        )
+        return False
     _initialized = True
     return True
 

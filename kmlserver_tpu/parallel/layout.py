@@ -76,6 +76,30 @@ def resolve_layout(
     return "replicated"
 
 
+def resolve_serve_span(
+    layout: str,
+    tensor_bytes: int,
+    budget_bytes: int,
+    n_devices: int,
+    gang_size: int = 1,
+) -> str:
+    """→ ``"mesh"``, ``"sharded"``, or ``"replicated"`` — the serving
+    engine's layout decision with the pod-spanning serve mesh (ISSUE 16)
+    layered on top of :func:`resolve_layout`.
+
+    An armed serve gang (``KMLS_SERVE_GANG_SIZE`` > 1) is decisive: each
+    gang member holds only its own vocab slab, so replicating or
+    locally sharding the full tensors on any one member would defeat the
+    deployment (and double-serve rows another member owns). The layout
+    knob keeps steering the SINGLE-process question — how this member's
+    slab sits on its local devices is a follow-up the mesh bundle keeps
+    trivial (one slab, default placement) until a pod has more than one
+    local device to matter."""
+    if gang_size > 1:
+        return "mesh"
+    return resolve_layout(layout, tensor_bytes, budget_bytes, n_devices)
+
+
 def mining_mesh(cfg, mesh):
     """Apply the model-layout knob to the mining mesh: under the
     ``sharded`` layout the vocab (``tp``) axis is the one that must span

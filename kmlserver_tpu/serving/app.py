@@ -43,6 +43,7 @@ from .batcher import (
 )
 from .cache import RecommendCache
 from .engine import RecommendEngine
+from .mesh import MeshShardUnavailable
 from .metrics import ServingMetrics
 
 logger = logging.getLogger("kmlserver_tpu.serving")
@@ -382,6 +383,7 @@ class RecommendApp:
                     slo=self.slo,
                     artifact_ages=ages,
                     artifact_stale=self._artifact_stale_flags(ages),
+                    mesh_shards=self._mesh_shard_states(),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -742,6 +744,64 @@ class RecommendApp:
             self._trace_finish(trace, "degraded", headers)
         return status, headers, payload
 
+    # ---------- pod-spanning serve mesh (ISSUE 16) ----------
+
+    def _mesh_missing_shards(self, probe: bool = False) -> list[int]:
+        """Missing gang ranks from the engine's mesh coordinator — empty
+        when the serve mesh is off, the gang is whole, or the engine is a
+        test double predating the API. ``probe=True`` makes the caller a
+        re-form detector: the coordinator re-auditions dark peers (rate-
+        limited to one probe per interval), so a restarted gang member is
+        re-admitted by the very traffic that found it missing."""
+        fn = getattr(self.engine, "mesh_missing_shards", None)
+        if not callable(fn):
+            return []
+        return fn(probe=probe)
+
+    def _mesh_shard_states(self) -> dict | None:
+        """``{"serving": n, "missing": m}`` for the
+        kmls_serve_mesh_shards gauge, or None with the mesh off — the
+        series only exists on gang members, so a replicated pod never
+        exports a phantom one-member gang."""
+        gang = getattr(self.engine, "gang", None)
+        if gang is None:
+            return None
+        missing = self._mesh_missing_shards()
+        return {
+            "serving": gang.size - len(missing), "missing": len(missing)
+        }
+
+    def _mesh_shard_response(
+        self, t0: float, songs: list[str], rank: int, trace=None
+    ) -> Response:
+        """Answer policy when a vocab shard (a gang member) is dark.
+        With the fleet routing tier armed this gang is NOT the last line
+        of defense — 503 + ``X-KMLS-Mesh-Unavailable: <rank>`` tells the
+        router which shard to blame and spills the key to the next ring
+        peer (the replay client counts it ``mesh_unavailable``, never
+        http_5xx; Retry-After paces re-dispatch against the re-admission
+        probe). Standalone, the degradation contract holds: shard loss
+        costs answer QUALITY (popularity fallback), never availability."""
+        rank = int(rank)
+        if self.fleet_routing:
+            status, headers, payload = _json_response(
+                503,
+                {"detail": f"serve mesh degraded: vocab shard {rank} "
+                           "unavailable"},
+            )
+            headers["X-KMLS-Mesh-Unavailable"] = str(rank)
+            headers["Retry-After"] = str(
+                math.ceil(max(self.cfg.replica_probe_interval_s, 1.0))
+            )
+            self.metrics.record_degraded(f"mesh-shard-missing:{rank}")
+            if trace is not None:
+                trace.annotate("mesh_shard_missing", rank)
+                self._trace_finish(trace, "mesh-unavailable", headers)
+            return status, headers, payload
+        return self._degraded_response(
+            t0, songs, f"mesh-shard-missing:{rank}", trace=trace
+        )
+
     def degraded_reasons(self) -> list[str]:
         """Why /readyz says "degraded" (empty = fully healthy): reloads
         failing while the last-good bundle keeps serving, and/or replicas
@@ -777,6 +837,13 @@ class RecommendApp:
             ejected = ejected_fn()
             if ejected:
                 reasons.append(f"replicas ejected: {ejected}")
+        # pod-spanning serve mesh (ISSUE 16): a dark gang member means a
+        # vocab slab is unservable — ready-but-degraded BY RANK, and
+        # probe=True makes every /readyz scrape double as the re-form
+        # detector (the kubelet's readiness polling re-admits a restarted
+        # member even on an otherwise idle pod)
+        for rank in self._mesh_missing_shards(probe=True):
+            reasons.append(f"serve_mesh_shard_missing:{rank}")
         return reasons
 
     def _recommend_error_response(self, exc: Exception, trace=None) -> Response:
@@ -983,9 +1050,23 @@ class RecommendApp:
             return err
         # trace begins AFTER validation: malformed bodies never allocate
         trace = self._trace_begin(trace_header)
+        # serve mesh (ISSUE 16): with a gang member known-dark, answer
+        # the shard-loss policy BEFORE cache/batcher — a merged answer
+        # missing one slab's candidates would be silently wrong, and
+        # caching it would keep it wrong past the gang re-forming
+        missing = self._mesh_missing_shards(probe=True)
+        if missing:
+            return self._mesh_shard_response(
+                t0, songs, missing[0], trace=trace
+            )
         try:
             recs, source, cached = self.recommend_direct(songs, trace=trace)
         except Exception as exc:
+            if isinstance(exc, MeshShardUnavailable):
+                # a gang member died mid-flight (after the pre-check)
+                return self._mesh_shard_response(
+                    t0, songs, exc.rank, trace=trace
+                )
             reason = self._degrade_reason(exc)
             if reason is not None:
                 # deadline exhausted or every replica ejected: answer
@@ -1020,10 +1101,25 @@ class RecommendApp:
             return err, None, t0, None
         trace = self._trace_begin(trace_header)
         deadline = self._deadline_for(t0)
+        # serve mesh (ISSUE 16): same pre-check as _post_recommend —
+        # never cache/merge an answer a dark slab can't contribute to
+        missing = self._mesh_missing_shards(probe=True)
+        if missing:
+            return (
+                self._mesh_shard_response(t0, songs, missing[0], trace=trace),
+                None, t0, None,
+            )
         if self.batcher is None:
             try:
                 recs, source, cached = self.recommend_direct(songs, trace=trace)
             except Exception as exc:
+                if isinstance(exc, MeshShardUnavailable):
+                    return (
+                        self._mesh_shard_response(
+                            t0, songs, exc.rank, trace=trace
+                        ),
+                        None, t0, None,
+                    )
                 reason = self._degrade_reason(exc)
                 if reason is not None:
                     return (
@@ -1054,6 +1150,11 @@ class RecommendApp:
                 future._kmls_seeds = songs
                 return None, future, t0, trace
         except Exception as exc:  # Overloaded / NoHealthyReplicas land here
+            if isinstance(exc, MeshShardUnavailable):
+                return (
+                    self._mesh_shard_response(t0, songs, exc.rank, trace=trace),
+                    None, t0, None,
+                )
             reason = self._degrade_reason(exc)
             if reason is not None:
                 return (
@@ -1082,6 +1183,11 @@ class RecommendApp:
         try:
             recs, source = future.result()
         except Exception as exc:
+            if isinstance(exc, MeshShardUnavailable):
+                songs = getattr(future, "_kmls_seeds", None) or []
+                return self._mesh_shard_response(
+                    t0, songs, exc.rank, trace=trace
+                )
             reason = self._degrade_reason(exc)
             if reason is not None:
                 songs = getattr(future, "_kmls_seeds", None) or []

@@ -234,6 +234,19 @@ class RuleBundle:
     # replicated NamedSharding over `mesh` — the placement target for
     # staged seed batches (replicated layout uses `device` instead)
     seed_sharding: object = None
+    # ---- pod-spanning serve mesh (ISSUE 16) ----
+    # "mesh" layout: rule_ids/rule_confs hold ONLY this gang member's
+    # vocab slab (global rows [gang_rank·shard_size, +shard_size)) on the
+    # default local device; n_shards is the GANG size and shard_size the
+    # slab rows, so the per-shard dispatch counters and /metrics read
+    # identically to the single-process sharded layout. mesh_v is the
+    # padded GLOBAL vocab width every partial scores at; mesh_lo the
+    # slab's first global row as a committed device scalar (a traced
+    # argument of ops.serve.shard_partial_topk — one compiled program
+    # serves every rank).
+    gang_rank: int = 0
+    mesh_v: int = 0
+    mesh_lo: object = None
     # ---- second model family (hybrid rule∪embedding serving) ----
     # ALS item factors on this replica's device (f32 (V_emb, rank), rows
     # L2-normalized) with their OWN vocabulary — the embedding id space is
@@ -359,6 +372,30 @@ class RecommendEngine:
         # _staging_is_safe() (device_put must copy).
         self._staging: dict[tuple[int, int], np.ndarray] = {}
         self._staging_lock = threading.Lock()
+        # ---- pod-spanning serve mesh (ISSUE 16) ----
+        # armed when KMLS_SERVE_GANG_COORDINATOR + SIZE>1 name a gang this
+        # process belongs to; the worker serves THIS rank's partial top-k
+        # to peers, the coordinator fans a batch out and merges. Both are
+        # created lazily at the first mesh publication (under the reload
+        # lock) and survive hot swaps — the model token carried on every
+        # partial is what keeps generations honest across the gang.
+        from . import mesh as mesh_mod  # local import: keeps engine import light
+
+        self._mesh_mod = mesh_mod
+        self.gang = mesh_mod.gang_from_config(cfg)
+        self.mesh_worker = None
+        self.mesh_coordinator = None
+        if self.gang is not None:
+            # real-collectives wiring: on an accelerator gang this joins
+            # the jax.distributed coordinator (GSPMD over DCN — the
+            # on-chip run folds into the standing TPU-window item); on
+            # the CPU backend it logs and declines, and serving uses the
+            # multi-process simulation transport below instead.
+            from ..parallel.distributed import maybe_initialize_serve_gang
+
+            maybe_initialize_serve_gang(
+                self.gang.coordinator, self.gang.size, self.gang.rank
+            )
 
     # ---------- artifact loading / hot swap ----------
 
@@ -800,15 +837,36 @@ class RecommendEngine:
         # layout decision (parallel/layout.py, the one shared copy):
         # MEASURED rule-tensor bytes vs the per-device budget. A sharded
         # resolution builds ONE logical bundle spanning every serve
-        # device instead of a replica per device.
-        from ..parallel.layout import resolve_layout
+        # device instead of a replica per device; an armed serve gang
+        # (ISSUE 16) resolves to "mesh" — this process holds ONLY its
+        # vocab slab and the gang presents one logical replica.
+        from ..parallel.layout import resolve_serve_span
 
-        layout = resolve_layout(
+        layout = resolve_serve_span(
             self.cfg.model_layout,
             int(rule_ids.nbytes + rule_confs.nbytes),
             self.cfg.device_budget_bytes,
             len(devs),
+            gang_size=self.gang.size if self.gang is not None else 1,
         )
+        if layout == "mesh" and len(vocab) > 0:
+            if jax.process_count() > 1:
+                # real-collectives path: the gang joined one jax
+                # distributed world (maybe_initialize_serve_gang), so the
+                # PR 7 shard_map kernel over the GLOBAL device set IS the
+                # pod-spanning mesh — vocab axis on DCN via GSPMD. The
+                # simulation transport below is the CPU-testable twin.
+                return [
+                    self._build_sharded_bundle(
+                        vocab, index, known_mask, rule_ids, rule_confs,
+                        token, jax.devices(),
+                    )
+                ]
+            return [
+                self._build_mesh_bundle(
+                    vocab, index, known_mask, rule_ids, rule_confs, token
+                )
+            ]
         if layout == "sharded" and len(vocab) > 0:
             return [
                 self._build_sharded_bundle(
@@ -888,6 +946,117 @@ class RecommendEngine:
             (ids.nbytes + confs.nbytes) / n / (1 << 20),
         )
         return bundle
+
+    def _build_mesh_bundle(
+        self, vocab, index, known_mask, rule_ids, rule_confs, token
+    ) -> RuleBundle:
+        """ONE gang member's slice of the pod-spanning serve mesh: the
+        vocab axis is padded to a multiple of the gang size and THIS
+        process keeps only rows ``[rank·slab, (rank+1)·slab)`` — the
+        servable catalog scales with the gang, not with one host. The
+        dispatch math is the sharded kernel's two halves verbatim
+        (ops/serve.py ``shard_partial_topk`` / ``merge_partial_topk``,
+        the exact functions the shard_map kernel traces), so the gang's
+        merged answer is bit-identical to the single-process sharded —
+        and replicated — layouts by construction, pinned by
+        tests/test_mesh.py."""
+        gang = self.gang
+        size = gang.size
+        v, k = rule_ids.shape
+        v_pad = ((v + size - 1) // size) * size
+        slab = v_pad // size
+        lo = gang.rank * slab
+        hi = min(lo + slab, v)
+        ids = np.full((slab, k), -1, dtype=np.int32)
+        confs = np.zeros((slab, k), dtype=np.float32)
+        if hi > lo:
+            ids[: hi - lo] = rule_ids[lo:hi]
+            confs[: hi - lo] = rule_confs[lo:hi]
+        bundle = RuleBundle(
+            vocab=vocab, index=index,
+            rule_ids=jax.device_put(jnp.asarray(ids)),
+            rule_confs=jax.device_put(jnp.asarray(confs)),
+            known_mask=known_mask, model_token=token,
+            device=None, layout="mesh", n_shards=size, shard_size=slab,
+            gang_rank=gang.rank, mesh_v=v_pad,
+            mesh_lo=jax.device_put(jnp.asarray(lo, dtype=jnp.int32)),
+        )
+        self._ensure_mesh_runtime()
+        logger.info(
+            "mesh layout: %d rule rows (+%d pad) across a %d-member gang "
+            "— this rank (%d) holds rows [%d, %d) (~%.1f MiB)",
+            v, v_pad - v, size, gang.rank, lo, lo + slab,
+            (ids.nbytes + confs.nbytes) / (1 << 20),
+        )
+        return bundle
+
+    def _ensure_mesh_runtime(self) -> None:
+        """Start the gang's partial-protocol worker + coordinator once
+        (idempotent; called under the reload lock at mesh publication).
+        Both outlive hot swaps — the model token on every partial is the
+        generation fence, not the sockets."""
+        mesh_mod = self._mesh_mod
+        if self.mesh_worker is None:
+            self.mesh_worker = mesh_mod.MeshWorkerServer(
+                self._mesh_serve_partial, self._mesh_status,
+                port=self.cfg.serve_gang_port,
+            )
+            self.mesh_worker.start()
+            logger.info(
+                "serve-mesh worker listening on :%d (gang rank %d/%d)",
+                self.mesh_worker.port, self.gang.rank, self.gang.size,
+            )
+        if self.mesh_coordinator is None:
+            self.mesh_coordinator = mesh_mod.MeshCoordinator(self.gang)
+
+    def _mesh_serve_partial(self, seeds: np.ndarray):
+        """Worker-side handler: run THIS rank's partial top-k for a
+        peer's staged batch. Raising is the contract for 'shard not
+        servable here' — the transport maps it to MeshShardUnavailable
+        at the caller, which spills to the next ring peer."""
+        bundle = self.bundle
+        if bundle is None or bundle.layout != "mesh":
+            raise RuntimeError("no mesh bundle published on this rank")
+        shape = (int(seeds.shape[0]), int(seeds.shape[1]))
+        if shape not in bundle.warmed_shapes:
+            self.unwarmed_dispatches += 1
+            logger.warning(
+                "mesh partial for unwarmed shape %s — paying a compile "
+                "on the serving path", shape,
+            )
+        from ..ops.serve import shard_partial_topk
+
+        seeds_dev = jax.device_put(np.ascontiguousarray(seeds, np.int32))
+        part_ids, part_confs = shard_partial_topk(
+            bundle.rule_ids, bundle.rule_confs, seeds_dev, bundle.mesh_lo,
+            v=bundle.mesh_v, k_best=self.cfg.k_best_tracks,
+        )
+        return (
+            np.asarray(part_ids), np.asarray(part_confs),
+            bundle.model_token or "",
+        )
+
+    def _mesh_status(self) -> dict:
+        """The worker's 'ready' op payload — what a peer (or the
+        coordinator's half-open probe) learns about this rank."""
+        bundle = self.bundle
+        return {
+            "rank": self.gang.rank if self.gang is not None else 0,
+            "epoch": self.bundle_epoch,
+            "token": bundle.model_token if bundle is not None else None,
+            "layout": bundle.layout if bundle is not None else None,
+        }
+
+    def mesh_missing_shards(self, probe: bool = False) -> list:
+        """Sorted ranks of gang members the coordinator cannot currently
+        serve through — empty outside mesh layout.
+        ``probe=True`` re-auditions missing ranks (rate-limited inside
+        the coordinator) so /readyz and the fleet's half-open probe are
+        the re-form detectors without any background thread."""
+        coord = self.mesh_coordinator
+        if coord is None:
+            return []
+        return coord.missing_shards(probe=probe)
 
     def _serve_devices(self) -> list:
         """The local devices the replica set spans. ``serve_devices == 0``
@@ -977,11 +1146,18 @@ class RecommendEngine:
             return  # native host kernel, no embeddings: nothing compiles
         # sharded layout warms ITS kernel (per-shard lookup + cross-device
         # max-merge) over the same bucket grid — every sharded bucket is
-        # compiled before publication, same zero-compile contract
+        # compiled before publication, same zero-compile contract. Mesh
+        # layout warms the kernel's two factored halves instead: the
+        # local slab partial (served to peers AND dispatched locally) and
+        # the rank-stacked merge — every gang member compiles both for
+        # every bucket before its bundle publishes.
+        warm_mesh = warm_rules and bundle.layout == "mesh"
         kernel = (
             (bundle.shard_kernel or self._resolve_kernel())
-            if warm_rules else None
+            if warm_rules and not warm_mesh else None
         )
+        if warm_mesh:
+            from ..ops.serve import merge_partial_topk, shard_partial_topk
         for length in self._len_buckets():
             for batch in self._batch_buckets():
                 seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
@@ -992,7 +1168,26 @@ class RecommendEngine:
                     # sharded layout, replicate them over the mesh) so the
                     # warmed executable is the one its dispatches will hit
                     rule_seeds = jax.device_put(seeds, target)
-                if warm_rules:
+                if warm_mesh:
+                    kb = self.cfg.k_best_tracks
+                    part_ids, part_confs = shard_partial_topk(
+                        bundle.rule_ids, bundle.rule_confs, rule_seeds,
+                        bundle.mesh_lo, v=bundle.mesh_v, k_best=kb,
+                    )
+                    stack_ids = jnp.broadcast_to(
+                        part_ids, (bundle.n_shards,) + part_ids.shape
+                    )
+                    stack_confs = jnp.broadcast_to(
+                        part_confs, (bundle.n_shards,) + part_confs.shape
+                    )
+                    jax.block_until_ready(
+                        merge_partial_topk(
+                            stack_ids, stack_confs,
+                            v=bundle.mesh_v, k_best=kb,
+                        )
+                    )
+                    bundle.warmed_shapes.add((batch, length))
+                elif warm_rules:
                     jax.block_until_ready(
                         kernel(bundle.rule_ids, bundle.rule_confs, rule_seeds)
                     )
@@ -1150,7 +1345,16 @@ class RecommendEngine:
             ),
         )
         if bundle.host_rule_ids is None:
-            if bundle.shard_kernel is not None:
+            if bundle.layout == "mesh":
+                # the gang dispatch composes the kernel's two factored
+                # halves — watch both jit caches under one name (the
+                # snapshot sums, so any post-publish compile on either
+                # half reads as serving-path compile growth)
+                from ..ops.serve import merge_partial_topk, shard_partial_topk
+
+                cm.watch_compiles("serve_mesh", shard_partial_topk)
+                cm.watch_compiles("serve_mesh_merge", merge_partial_topk)
+            elif bundle.shard_kernel is not None:
                 cm.watch_compiles("serve_sharded", bundle.shard_kernel)
             else:
                 kernel = self._resolve_kernel()
@@ -1541,6 +1745,11 @@ class RecommendEngine:
             # degrade + nudge a reload, like the reference's late-load path
             threading.Thread(target=self.reload_if_required, daemon=True).start()
             return self.static_recommendation(seed_tracks), "fallback"
+        if bundle.layout == "mesh":
+            # a mesh answer needs the gang fan-out either way — route
+            # through the batched dispatch/finish pair (per-request
+            # semantics are identical; MeshShardUnavailable propagates)
+            return self._mesh_recommend_async(bundle, [seed_tracks], 0)()[0]
         known_ids = [
             bundle.index[s]
             for s in seed_tracks
@@ -1622,6 +1831,8 @@ class RecommendEngine:
                 ]
 
             return finish_fallback
+        if bundle.layout == "mesh":
+            return self._mesh_recommend_async(bundle, seed_sets, idx)
         if bundle.host_rule_ids is not None:
             # native host kernel: no compile, so no shape bucketing — the
             # seed array is exact-sized, built fresh (it must survive
@@ -1770,6 +1981,105 @@ class RecommendEngine:
                         r=int(bundle.emb_factors.shape[1]),
                         k_best=self.cfg.k_best_tracks,
                     )
+            out: list[tuple[list[str], str]] = []
+            for r, seeds in enumerate(seed_sets):
+                emb_row = None if emb_host is None else (
+                    emb_host[0][r], emb_host[1][r], emb_host[2][r]
+                )
+                out.append(self._compose_answer(
+                    bundle, seeds, bool(known_rows[r]),
+                    host_ids[r], host_confs[r], emb_row,
+                ))
+            return out
+
+        return finish
+
+    def _mesh_recommend_async(
+        self, bundle: RuleBundle, seed_sets: list[list[str]], idx: int
+    ):
+        """The pod-spanning dispatch/finish pair: fan the staged batch to
+        every gang peer FIRST (socket I/O overlaps the local device
+        work), dispatch this rank's slab partial, and at finish() stack
+        the rank-ordered partials and run the merge — the same two
+        functions the single-process shard_map kernel composes, so the
+        answer is bit-identical by construction. A dead gang member
+        surfaces as :class:`~.mesh.MeshShardUnavailable` out of finish():
+        the app maps it to the gang-degraded signal (503 +
+        ``X-KMLS-Mesh-Unavailable`` under fleet routing) and the routed
+        client spills the request to the next ring peer."""
+        from ..ops.serve import merge_partial_topk, shard_partial_topk
+
+        length = self._bucket_len(
+            max((len(s) for s in seed_sets), default=1)
+        )
+        n_rows = self._bucket_batch(max(len(seed_sets), 1))
+        shape = (n_rows, length)
+        # exact-built host staging (not the reusable buffers): the batch
+        # must survive into the peer fan-out — fetch_partials snapshots
+        # it before the pool threads serialize it to sockets
+        arr = np.full(shape, -1, dtype=np.int32)
+        known_rows = self._fill_seed_rows(bundle, seed_sets, arr, length)
+        if bundle.shard_size > 0:
+            hit = arr[arr >= 0]
+            if hit.size:
+                self._note_shard_dispatch(np.bincount(
+                    hit // bundle.shard_size, minlength=bundle.n_shards
+                ))
+        finish_remote = self.mesh_coordinator.fetch_partials(
+            arr, bundle.model_token or ""
+        )
+        if shape not in bundle.warmed_shapes:
+            self.unwarmed_dispatches += 1
+            logger.warning(
+                "unwarmed seed shape %s dispatched (compile on the "
+                "serving path); warmed buckets: batches %s x lengths %s",
+                shape, self._batch_buckets(), self._len_buckets(),
+            )
+        seeds_dev = jax.device_put(arr)
+        kb = self.cfg.k_best_tracks
+        cm = self.cost_model
+        t_kernel = time.perf_counter() if cm is not None else 0.0
+        part_ids, part_confs = shard_partial_topk(
+            bundle.rule_ids, bundle.rule_confs, seeds_dev, bundle.mesh_lo,
+            v=bundle.mesh_v, k_best=kb,
+        )
+        emb = self._dispatch_embed(bundle, seed_sets, n_rows, length)
+        self._note_dispatch(idx)
+
+        def finish() -> list[tuple[list[str], str]]:
+            # chaos hook on the completion path (see finish_native)
+            faults.fire("replica.kernel", replica=idx)
+            local_ids = np.asarray(part_ids)  # blocks on the device
+            local_confs = np.asarray(part_confs)
+            # blocks on the slowest peer; raises MeshShardUnavailable
+            # for the first rank the gang cannot serve through
+            parts = finish_remote()
+            stack_ids = np.empty(
+                (bundle.n_shards,) + local_ids.shape, dtype=np.int32
+            )
+            stack_confs = np.empty(
+                (bundle.n_shards,) + local_confs.shape, dtype=np.float32
+            )
+            stack_ids[bundle.gang_rank] = local_ids
+            stack_confs[bundle.gang_rank] = local_confs
+            for rank, (ids_r, confs_r) in parts.items():
+                stack_ids[rank] = ids_r
+                stack_confs[rank] = confs_r
+            merged_ids, merged_confs = merge_partial_topk(
+                stack_ids, stack_confs, v=bundle.mesh_v, k_best=kb
+            )
+            host_ids = np.asarray(merged_ids)
+            host_confs = np.asarray(merged_confs)
+            if cm is not None:
+                cm.observe_kernel(
+                    "serve_mesh", time.perf_counter() - t_kernel,
+                    b=n_rows, l=length, k_max=bundle.rule_ids.shape[1],
+                    v=len(bundle.vocab), k_best=kb,
+                    shards=bundle.n_shards,
+                )
+            emb_host = None
+            if emb is not None:
+                emb_host = (np.asarray(emb[0]), np.asarray(emb[1]), emb[2])
             out: list[tuple[list[str], str]] = []
             for r, seeds in enumerate(seed_sets):
                 emb_row = None if emb_host is None else (

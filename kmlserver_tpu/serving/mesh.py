@@ -1,0 +1,470 @@
+"""Pod-spanning serve mesh (ISSUE 16) — the gang transport.
+
+PR 7 sharded the rule tensors across one process's local devices; this
+module lets the SAME vocab axis span processes/pods, so the servable
+catalog scales with the gang instead of capping at one host. A gang of
+``KMLS_SERVE_GANG_SIZE`` members (kubernetes/serve-gang.yaml: one
+indexed StatefulSet, ordinal → rank) each holds only its own vocab slab
+— rows ``[rank·slab, (rank+1)·slab)`` of the padded rule tensors — yet
+presents ONE logical replica to the dispatcher and ONE ring member to
+the PR 15 ``FleetRouter``.
+
+Two transports, one math:
+
+- **Real collectives** (TPU pods over DCN): the gang joins one JAX
+  world via ``parallel.distributed.maybe_initialize_serve_gang`` (the
+  mining job's coordinator recipe, reused) and the PR 7 shard_map
+  kernel runs globally — pjit/GSPMD places the all_gather on DCN. This
+  sandbox has no multi-process GSPMD, so that path is wired but
+  exercised only in the standing TPU-window item.
+- **Simulation transport** (CPU-testable end to end, this module): each
+  "pod" is a real local process owning a slab. Every member runs a
+  :class:`MeshWorkerServer` (a tiny length-prefixed TCP protocol — raw
+  numpy bytes + a JSON header, no pickle) serving its per-slab top-k
+  partial, and a :class:`MeshCoordinator` that fans a request's seed
+  batch to its peers, stacks the (rank-ordered) partials, and merges.
+  Partial and merge are the EXACT functions the shard_map kernel
+  composes (``ops.serve.shard_partial_topk`` / ``merge_partial_topk``
+  — the all_gather + max-merge of PR 7, factored out), so gang answers
+  are bit-identical to the single-process sharded kernel by
+  construction (pinned in tests/test_mesh.py).
+
+Failure model: a dead gang member makes the whole gang degrade exactly
+like a dead replica — the engine raises :class:`MeshShardUnavailable`,
+the app answers 503 with ``X-KMLS-Mesh-Unavailable: <rank>`` when fleet
+routing is armed (the routed client treats it as a transport failure:
+circuit-breaker ejection of the WHOLE gang, spill to the next ring
+peer, half-open re-admission when the gang re-forms), or falls back to
+the degraded popularity answer standalone; ``/readyz`` names the
+missing shard (``serve_mesh_shard_missing:<rank>``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+logger = logging.getLogger("kmlserver_tpu.mesh")
+
+_LEN = struct.Struct("!I")
+_MAX_FRAME = 1 << 28  # 256 MiB: no sane seed batch or partial is larger
+
+
+class MeshShardUnavailable(RuntimeError):
+    """A gang member's slab partial could not be obtained — the mesh is
+    missing a shard, so a full-catalog answer is impossible. Carries the
+    rank so /readyz and the 503 signal can name it."""
+
+    def __init__(self, rank: int, reason: str):
+        super().__init__(f"serve mesh shard {rank} unavailable: {reason}")
+        self.rank = rank
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class GangConfig:
+    """One gang member's identity + addressing.
+
+    ``coordinator`` is rank 0's partial-fetch address (``host:port``).
+    Peer addressing derives from it: a hostname carrying the ``-0``
+    ordinal (the headless-Service pod DNS recipe —
+    ``serve-gang-0.serve-mesh:8477``) maps rank r to the ``-r`` name on
+    the SAME port; a bare host (the CPU simulation's ``127.0.0.1``)
+    maps rank r to port ``base_port + r`` on the same host."""
+
+    coordinator: str
+    size: int
+    rank: int
+
+    def peer_address(self, rank: int) -> tuple[str, int]:
+        host, _, port_s = self.coordinator.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError(
+                f"serve gang coordinator must be host:port, got "
+                f"{self.coordinator!r}"
+            )
+        port = int(port_s)
+        if "-0." in host:
+            return host.replace("-0.", f"-{rank}.", 1), port
+        if host.endswith("-0"):
+            return f"{host[:-2]}-{rank}", port
+        return host, port + rank
+
+    @property
+    def my_address(self) -> tuple[str, int]:
+        return self.peer_address(self.rank)
+
+
+def gang_from_config(cfg) -> GangConfig | None:
+    """→ this process's :class:`GangConfig`, or None when no gang is
+    armed. Same fail-fast contract as the mining bootstrap: a rank
+    outside the declared size is a boot-time config error, never a
+    hang (parallel/distributed.py:distributed_env)."""
+    size = int(getattr(cfg, "serve_gang_size", 1) or 1)
+    coordinator = getattr(cfg, "serve_gang_coordinator", "") or ""
+    if size <= 1 or not coordinator:
+        return None
+    rank = int(getattr(cfg, "serve_gang_rank", 0) or 0)
+    if rank >= size:
+        raise ValueError(
+            f"serve gang rank {rank} >= gang size {size}: set "
+            "KMLS_SERVE_GANG_SIZE to the StatefulSet's replica count"
+        )
+    return GangConfig(coordinator=coordinator, size=size, rank=rank)
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: !I header length + JSON header + raw payload bytes.
+# Arrays travel as C-order bytes with shape/dtype in the header — no
+# pickle anywhere (an artifact server must never eval peer bytes).
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, header: dict, payload: bytes = b""):
+    head = json.dumps(header, separators=(",", ":")).encode()
+    sock.sendall(_LEN.pack(len(head)) + head + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[dict, bytes]:
+    (head_len,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if head_len > _MAX_FRAME:
+        raise ConnectionError(f"oversized header ({head_len} bytes)")
+    header = json.loads(_recv_exact(sock, head_len))
+    n = int(header.get("payload_bytes", 0))
+    if not 0 <= n <= _MAX_FRAME:
+        raise ConnectionError(f"oversized payload ({n} bytes)")
+    return header, _recv_exact(sock, n) if n else b""
+
+
+class MeshWorkerServer:
+    """Every gang member's partial-protocol endpoint: serves this slab's
+    (B, k_best) top-k partials to whichever member coordinates a
+    request (the design is symmetric — any member can front the gang;
+    under the k8s recipe the ring lists the gang Service, so traffic
+    lands on whichever pod DNS round-robins to).
+
+    ``serve_partial(seeds) -> (ids, confs, token)`` and
+    ``status() -> dict`` come from the engine; this class owns only the
+    sockets. Threads are daemonic and connections persistent (one
+    framed request/response at a time per connection — the coordinator
+    serializes per-peer calls)."""
+
+    def __init__(self, serve_partial, status, host: str = "", port: int = 0):
+        self._serve_partial = serve_partial
+        self._status = status
+        # short bind-retry: a re-forming gang member reuses its rank's
+        # port, and the dead incarnation's sockets may still be mid-FIN
+        # (SO_REUSEADDR — create_server sets it — already covers the
+        # TIME_WAIT case; the retry covers the close race)
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self._sock = socket.create_server((host or "0.0.0.0", port))
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+        self.port = self._sock.getsockname()[1]
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="kmls-mesh-worker", daemon=True
+        )
+
+    def start(self) -> "MeshWorkerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            # shutdown BEFORE close: close alone only drops the fd — the
+            # accept thread blocked in the syscall keeps the kernel
+            # socket (and the port) alive; shutdown aborts the accept
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listening socket closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="kmls-mesh-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stopped.is_set():
+                try:
+                    header, payload = _recv_frame(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if self._stopped.is_set():
+                    # stop() landed while blocked in recv: drop the
+                    # request unanswered — the peer reads the close as
+                    # this shard going missing (the test/chaos stand-in
+                    # for a SIGKILLed pod, where every socket dies)
+                    return
+                try:
+                    self._handle(conn, header, payload)
+                except (BrokenPipeError, ConnectionError, OSError):
+                    return
+
+    def _handle(self, conn, header: dict, payload: bytes) -> None:
+        op = header.get("op")
+        if op == "ready":
+            _send_frame(conn, {"ok": True, **self._status()})
+            return
+        if op != "partial":
+            _send_frame(conn, {"ok": False, "error": f"unknown op {op!r}"})
+            return
+        try:
+            b, length = (int(x) for x in header["shape"])
+            seeds = np.frombuffer(payload, dtype=np.int32).reshape(b, length)
+            ids, confs, token = self._serve_partial(seeds)
+        except Exception as exc:  # surfaced to the coordinator, not eaten
+            logger.warning("mesh partial failed: %s", exc)
+            _send_frame(conn, {"ok": False, "error": str(exc)})
+            return
+        ids = np.ascontiguousarray(ids, dtype=np.int32)
+        confs = np.ascontiguousarray(confs, dtype=np.float32)
+        body = ids.tobytes() + confs.tobytes()
+        _send_frame(conn, {
+            "ok": True, "token": token, "shape": list(ids.shape),
+            "payload_bytes": len(body),
+        }, body)
+
+
+class MeshPeerClient:
+    """One persistent connection to one gang member's worker endpoint.
+    Any transport fault — refused connect, timeout, mid-frame close, a
+    peer-side error, a model-token mismatch — closes the socket and
+    raises :class:`MeshShardUnavailable` for that rank."""
+
+    def __init__(
+        self, rank: int, address: tuple[str, int],
+        connect_timeout_s: float = 2.0, request_timeout_s: float = 30.0,
+    ):
+        self.rank = rank
+        self.address = address
+        self.connect_timeout_s = connect_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _request(self, header: dict, payload: bytes) -> tuple[dict, bytes]:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.address, timeout=self.connect_timeout_s
+                    )
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                self._sock.settimeout(self.request_timeout_s)
+                _send_frame(self._sock, header, payload)
+                resp, body = _recv_frame(self._sock)
+            except (OSError, ConnectionError, ValueError) as exc:
+                self._close_locked()
+                raise MeshShardUnavailable(
+                    self.rank, f"{type(exc).__name__}: {exc}"
+                ) from exc
+        if not resp.get("ok"):
+            raise MeshShardUnavailable(
+                self.rank, str(resp.get("error", "peer error"))
+            )
+        return resp, body
+
+    def partial(
+        self, seeds: np.ndarray, token: str
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """→ this peer slab's (B, k_best) partial for ``seeds``. The
+        model token travels both ways: a peer serving a DIFFERENT
+        publication (mid-rollout generation skew) must read as a
+        missing shard — merging partials across epochs would be silent
+        corruption, spilling to a ring peer is a clean answer."""
+        seeds = np.ascontiguousarray(seeds, dtype=np.int32)
+        resp, body = self._request({
+            "op": "partial", "token": token,
+            "shape": list(seeds.shape), "payload_bytes": seeds.nbytes,
+        }, seeds.tobytes())
+        if resp.get("token") != token:
+            raise MeshShardUnavailable(
+                self.rank,
+                f"model token mismatch (peer {resp.get('token')!r})",
+            )
+        b, k = (int(x) for x in resp["shape"])
+        n = b * k * 4
+        if len(body) != 2 * n:
+            raise MeshShardUnavailable(
+                self.rank, f"short partial payload ({len(body)} bytes)"
+            )
+        ids = np.frombuffer(body[:n], dtype=np.int32).reshape(b, k)
+        confs = np.frombuffer(body[n:], dtype=np.float32).reshape(b, k)
+        return ids, confs
+
+    def ready(self) -> dict:
+        resp, _ = self._request({"op": "ready"}, b"")
+        return resp
+
+
+class MeshCoordinator:
+    """The request-side fan-out/merge state for one gang member:
+    persistent peer clients, a small fetch pool, and the missing-shard
+    health record that /readyz, the gauge, and the request short-circuit
+    read.
+
+    Recovery needs no background thread: a missing rank is re-probed
+    (cheap ``ready`` op) at most every ``probe_min_interval_s``, from
+    whatever touches the state first — a request arriving while the
+    gang is degraded, or a periodic /readyz. The FleetRouter's own
+    half-open probe request therefore finds a re-formed gang within one
+    probe interval."""
+
+    def __init__(
+        self, gang: GangConfig, *,
+        connect_timeout_s: float = 2.0, request_timeout_s: float = 30.0,
+        probe_min_interval_s: float = 1.0, clock=time.monotonic,
+    ):
+        self.gang = gang
+        self.request_timeout_s = request_timeout_s
+        self.clients = {
+            r: MeshPeerClient(
+                r, gang.peer_address(r),
+                connect_timeout_s=connect_timeout_s,
+                request_timeout_s=request_timeout_s,
+            )
+            for r in range(gang.size) if r != gang.rank
+        }
+        self._missing: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._probe_min_interval_s = probe_min_interval_s
+        self._next_probe_at = 0.0
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, gang.size - 1),
+            thread_name_prefix="kmls-mesh-fetch",
+        )
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        for client in self.clients.values():
+            client.close()
+
+    # -- health record ----------------------------------------------------
+
+    def _note_missing(self, rank: int, reason: str) -> None:
+        with self._lock:
+            fresh = rank not in self._missing
+            self._missing[rank] = reason
+        if fresh:
+            logger.warning("serve mesh shard %d missing: %s", rank, reason)
+
+    def _note_serving(self, rank: int) -> None:
+        with self._lock:
+            back = self._missing.pop(rank, None) is not None
+        if back:
+            logger.info("serve mesh shard %d re-formed", rank)
+
+    def missing_shards(self, probe: bool = False) -> list[int]:
+        """Currently-missing ranks (sorted). ``probe=True`` re-auditions
+        them first (rate-limited), so a re-formed gang recovers from
+        the readyz/request path without waiting for traffic to fail."""
+        with self._lock:
+            missing = sorted(self._missing)
+        if not (probe and missing):
+            return missing
+        now = self._clock()
+        with self._lock:
+            if now < self._next_probe_at:
+                return missing
+            self._next_probe_at = now + self._probe_min_interval_s
+        for rank in missing:
+            try:
+                self.clients[rank].ready()
+            except MeshShardUnavailable as exc:
+                self._note_missing(rank, exc.reason)
+            else:
+                self._note_serving(rank)
+        with self._lock:
+            return sorted(self._missing)
+
+    # -- the request fan-out ----------------------------------------------
+
+    def fetch_partials(self, seeds: np.ndarray, token: str):
+        """Submit every peer's partial fetch NOW (concurrent with the
+        local slab's device dispatch); the returned ``finish()`` blocks
+        and yields ``{rank: (ids, confs)}`` or raises
+        :class:`MeshShardUnavailable` for the first dead rank. The
+        seeds array is serialized up front — the engine's staging
+        buffer may be reused by the next batch before the pool thread
+        runs."""
+        payload = np.ascontiguousarray(seeds, dtype=np.int32).copy()
+        futures = {
+            rank: self._pool.submit(client.partial, payload, token)
+            for rank, client in self.clients.items()
+        }
+
+        def finish() -> dict[int, tuple[np.ndarray, np.ndarray]]:
+            out: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+            failed: MeshShardUnavailable | None = None
+            for rank, future in sorted(futures.items()):
+                try:
+                    out[rank] = future.result(
+                        timeout=self.request_timeout_s + 5.0
+                    )
+                    self._note_serving(rank)
+                except MeshShardUnavailable as exc:
+                    self._note_missing(rank, exc.reason)
+                    failed = failed or exc
+                except Exception as exc:  # pool/timeout faults
+                    wrapped = MeshShardUnavailable(
+                        rank, f"{type(exc).__name__}: {exc}"
+                    )
+                    self._note_missing(rank, wrapped.reason)
+                    failed = failed or wrapped
+            if failed is not None:
+                raise failed
+            return out
+
+        return finish

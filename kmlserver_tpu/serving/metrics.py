@@ -81,6 +81,12 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_device_dispatch_total": "counter:serving",
     "kmls_shard_dispatch_total": "counter:serving",
     "kmls_model_shards": "gauge:serving",
+    # pod-spanning serve mesh (ISSUE 16): gang shard health by state
+    # (serving/missing) — rendered only on gang members, so the series
+    # existing at all says "this pod is a mesh member", and
+    # {state="missing"} > 0 is the alert that a vocab slab is dark
+    # (the same condition /readyz names as serve_mesh_shard_missing:<r>)
+    "kmls_serve_mesh_shards": "gauge:serving",
     # --- serving: fault tolerance / overload ---
     "kmls_degraded_total": "counter:serving",
     "kmls_degraded_by_reason": "counter:serving",
@@ -421,7 +427,7 @@ class ServingMetrics:
         self, reload_counter: int, finished_loading: bool,
         cache=None, dispatch_counts=None, robustness=None,
         shard_counts=None, cost=None, slo=None, artifact_ages=None,
-        artifact_stale=None,
+        artifact_stale=None, mesh_shards=None,
     ) -> str:
         """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
         ``dispatch_counts`` (the engine's per-replica dispatch counters),
@@ -508,6 +514,17 @@ class ServingMetrics:
             lines += [
                 f'kmls_shard_dispatch_total{{shard="{i}"}} {count}'
                 for i, count in enumerate(shard_counts)
+            ]
+        if mesh_shards:
+            # pod-spanning serve mesh (ISSUE 16): shard health by state —
+            # {state="serving"} + {state="missing"} always sums to the
+            # gang size, so either series alone places this pod's gang
+            # health; rendered only when the app passes a gang snapshot
+            # (non-mesh deployments keep the exact old exposition)
+            lines.append("# TYPE kmls_serve_mesh_shards gauge")
+            lines += [
+                f'kmls_serve_mesh_shards{{state="{state}"}} {count}'
+                for state, count in sorted(mesh_shards.items())
             ]
         # fault-tolerance exposition: degraded answers by reason + the
         # circuit breaker's eject/readmit/redispatch counters — always

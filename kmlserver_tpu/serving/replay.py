@@ -874,7 +874,7 @@ def replay_fleet_http(
     lat_uncached: list[float] = []
     stats = {
         "http_5xx": 0, "owner_stamped": 0, "rerouted": 0, "errors": 0,
-        "win_total": 0, "win_hits": 0,
+        "win_total": 0, "win_hits": 0, "mesh_unavailable": 0,
     }
     answered_by = {p: 0 for p in peers}
 
@@ -919,17 +919,39 @@ def replay_fleet_http(
                 stats["errors"] += 1
                 _leave()
 
-        def _account(peer: str, item, status: int, head_lower: bytes) -> None:
+        def _account(peer: str, item, status: int, head_lower: bytes) -> bool:
+            """Account one response → True when it was the gang-degraded
+            refusal (the caller must NOT mark_success for a burst that
+            carried one: transport-level success with every answer a
+            mesh refusal would re-admit the gang and wipe the shard
+            blame while the member is still dark)."""
             t_arr, idx, _attempts = item
+            if status == 503 and b"x-kmls-mesh-unavailable:" in head_lower:
+                # gang-degraded (ISSUE 16): the peer is a pod-gang
+                # missing a member and REFUSED rather than serve a
+                # partial catalog. That is a PEER failure, not a served
+                # 5xx — blame the named shard on the gang's breaker
+                # entry and spill the request through the router, the
+                # exact path a dead-replica transport failure takes
+                shard = None
+                for line in head_lower.split(b"\r\n"):
+                    if line.startswith(b"x-kmls-mesh-unavailable:"):
+                        val = line.split(b":", 1)[1].strip()
+                        if val.isdigit():
+                            shard = int(val)
+                stats["mesh_unavailable"] += 1
+                router.mark_failure(peer, shard=shard)
+                _redispatch(item, peer)
+                return True
             if status >= 500:
                 stats["http_5xx"] += 1
                 stats["errors"] += 1
                 _leave()
-                return
+                return False
             if status != 200:
                 stats["errors"] += 1
                 _leave()
-                return
+                return False
             dt_ms = (time.perf_counter() - t_arr) * 1e3
             lat_ms.append(dt_ms)
             hit = b"x-kmls-cache: hit" in head_lower
@@ -941,6 +963,7 @@ def replay_fleet_http(
                 stats["win_hits"] += int(hit)
             answered_by[peer] += 1
             _leave()
+            return False
 
         async def connect(peer: str):
             return await _open_http_conn(*addr[peer])
@@ -976,6 +999,7 @@ def replay_fleet_http(
                             _redispatch(it, peer)
                         continue
                 done = 0
+                burst_mesh_degraded = False
                 try:
                     writer.write(b"".join(reqs[i] for _, i, _a in burst))
                     for it in burst:
@@ -983,8 +1007,17 @@ def replay_fleet_http(
                         status, clen, head_lower = _parse_http_head(head)
                         await reader.readexactly(clen)
                         done += 1
-                        _account(peer, it, status, head_lower)
-                    router.mark_success(peer)
+                        burst_mesh_degraded |= _account(
+                            peer, it, status, head_lower
+                        )
+                    if not burst_mesh_degraded:
+                        # gang-degraded refusals in the burst leave the
+                        # breaker's failure marks standing: the gang
+                        # answered at the transport level but is still
+                        # missing a shard — re-admission must wait for a
+                        # burst it actually SERVES (the half-open probe
+                        # after the member re-forms)
+                        router.mark_success(peer)
                 except Exception:
                     # answered prefix already accounted; the unanswered
                     # tail spills through the router (a mid-replay kill
@@ -1079,6 +1112,8 @@ def replay_fleet_http(
         "readmissions": router.readmissions,
         "spills": router.spills,
         "owner_stamped": stats["owner_stamped"],
+        "mesh_unavailable": stats["mesh_unavailable"],
+        "failed_shards": router.failed_shards(),
     }
     return report, fleet
 

@@ -456,6 +456,18 @@ class TestTpuSuiteWiring:
             "misrouted_total": 7925, "identity_ok": True,
             "platform": "cpu",
         },
+        "meshserve": {
+            "gang_size": 2, "identical": True, "unwarmed_dispatches": 0,
+            "catalog_bytes": 1843200, "host_budget_bytes": 921600,
+            "max_catalog_bytes": 1843200, "sharded_p50_ms": 2.1,
+            "sharded_p99_ms": 4.4, "mesh_p50_ms": 3.6, "mesh_p99_ms": 7.9,
+            "replay_qps": 500.0, "replay_requests": 4000,
+            "achieved_qps": 501.0, "replay_p99_ms": 11.2,
+            "http_5xx": 0, "errors": 0, "mesh_unavailable": 9,
+            "ejections": 1, "failed_shards": {"gang": 1},
+            "answered_by": {"gang": 2012, "solo": 1988},
+            "platform": "cpu",
+        },
         "quality": {
             "recall_rules": 0.27, "recall_embed": 0.41,
             "recall_blend": 0.41, "recall_blend_best": 0.43,
@@ -554,6 +566,15 @@ class TestTpuSuiteWiring:
         assert final["fleet_http_5xx"] == 0
         assert final["fleet_identity_ok"] is True
         assert final["fleet_platform"] == "cpu"
+        # ... and the pod-spanning serve-mesh bracket (ISSUE 16)
+        assert final["meshserve_identical"] is True
+        assert final["meshserve_gang"] == 2
+        assert final["meshserve_unwarmed"] == 0
+        assert final["meshserve_max_catalog_bytes"] == 1843200
+        assert final["meshserve_http_5xx"] == 0
+        assert final["meshserve_errors"] == 0
+        assert final["meshserve_mesh_unavailable"] == 9
+        assert final["meshserve_platform"] == "cpu"
         # ... and so does the quality-loop bracket (ISSUE 14)
         assert final["quality_recall_blend"] == 0.43
         assert final["quality_weight_roundtrip"] is True
@@ -1024,6 +1045,7 @@ class TestBenchStateResume:
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
             "loadshape_cpu", "mine_resume_cpu", "als_hybrid_cpu",
             "confserve_cpu", "scale_sparse_cpu", "quality_cpu",
+            "meshserve_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()

@@ -16,7 +16,7 @@ def _load(name):
 
 def test_all_manifests_parse():
     paths = glob.glob(os.path.join(REPO, "kubernetes", "*.yaml"))
-    assert len(paths) == 7
+    assert len(paths) == 8
     for p in paths + [os.path.join(REPO, "argocd_manifest.yaml")]:
         with open(p) as fh:
             # multi-doc manifests (job-multihost.yaml / statefulset.yaml:
@@ -200,6 +200,70 @@ def test_statefulset_fleet_identity_contract():
     )
     # no bootstrap ordering: readiness is artifacts-on-PVC, not peers
     assert sts["spec"]["podManagementPolicy"] == "Parallel"
+
+
+def test_serve_gang_identity_and_bootstrap_contract():
+    """The pod-spanning serve mesh's gang recipe (ISSUE 16) must be
+    internally consistent the same way job-multihost.yaml is: ordinal
+    ranks from the downward API, gang size = the replica count, and a
+    coordinator address that names rank 0 through the headless Service
+    on the very port every member binds — ONE env value from which
+    serving/mesh.py derives every peer by ordinal substitution."""
+    with open(os.path.join(REPO, "kubernetes", "serve-gang.yaml")) as fh:
+        docs = list(yaml.safe_load_all(fh))
+    svc = next(d for d in docs if d["kind"] == "Service")
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+
+    # gang bootstrap DNS: headless AND published before readiness — a
+    # member cannot turn ready until the gang forms, so bootstrap
+    # records must exist for not-ready pods (the job-multihost recipe)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["publishNotReadyAddresses"] is True
+    assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+    assert svc["spec"]["selector"] == sts["spec"]["selector"]["matchLabels"]
+
+    spec = sts["spec"]["template"]["spec"]
+    container = spec["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+
+    # rank from the StatefulSet pod index (downward API), never literal
+    rank_ref = env["KMLS_SERVE_GANG_RANK"]["valueFrom"]["fieldRef"][
+        "fieldPath"]
+    assert "apps.kubernetes.io/pod-index" in rank_ref
+    # gang size must equal the replica count: each ordinal holds one
+    # vocab slab, so these drifting apart strands part of the catalog
+    assert int(env["KMLS_SERVE_GANG_SIZE"]["value"]) == sts["spec"][
+        "replicas"]
+
+    # coordinator: rank 0 of THIS StatefulSet through THIS Service, on
+    # the SAME port every member binds (ordinal substitution derives
+    # peer addresses from it, so host shape and port must both line up)
+    coordinator = env["KMLS_SERVE_GANG_COORDINATOR"]["value"]
+    host, port = coordinator.rsplit(":", 1)
+    assert host == (
+        f"{sts['metadata']['name']}-0.{svc['metadata']['name']}"
+    )
+    assert int(port) == int(env["KMLS_SERVE_GANG_PORT"]["value"])
+    # the mesh port must be exposed by the Service and the container
+    mesh_port = next(
+        p for p in svc["spec"]["ports"] if p["name"] == "mesh"
+    )
+    assert mesh_port["port"] == int(port)
+    assert int(port) in {
+        p["containerPort"] for p in container["ports"]
+    }
+    assert spec["subdomain"] == svc["metadata"]["name"]
+
+    # every rank must come up together: each pod serves only its slab,
+    # so ordered rollout would hold the gang partial for the whole walk
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    # same serving contracts as the other serving manifests
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert (
+        spec["volumes"][0]["persistentVolumeClaim"]["claimName"]
+        == "fast-api-claim"
+    )
 
 
 def test_hpa_scales_on_exported_utilization_signal():

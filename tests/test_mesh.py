@@ -1,0 +1,477 @@
+"""Pod-spanning serve mesh tests (ISSUE 16): gang addressing, the wire
+protocol, gang-as-one-ring-peer failure semantics, and the end-to-end
+identity pin — a real 2-member gang (each member a RecommendEngine
+holding only its vocab slab, exchanging partials over localhost sockets)
+must answer bit-identically to a single-process engine serving the full
+catalog, survive a member death as a clean MeshShardUnavailable, and
+re-admit the member when it re-forms."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.freshness.ring import FleetRouter
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.parallel.layout import resolve_serve_span
+from kmlserver_tpu.serving.engine import RecommendEngine
+from kmlserver_tpu.serving.mesh import (
+    GangConfig,
+    MeshCoordinator,
+    MeshPeerClient,
+    MeshShardUnavailable,
+    MeshWorkerServer,
+    gang_from_config,
+)
+
+from .oracle import random_baskets
+from .test_pipeline import table_with_metadata
+
+
+class TestGangAddressing:
+    """GangConfig derives every peer's mesh address from the ONE
+    coordinator value — the k8s pod-DNS recipe and the CPU simulation's
+    port-offset recipe must both round-trip."""
+
+    def test_pod_dns_ordinal_substitution(self):
+        gang = GangConfig("fast-api-gang-0.fast-api-gang:8477", 3, 1)
+        assert gang.peer_address(0) == ("fast-api-gang-0.fast-api-gang", 8477)
+        assert gang.peer_address(2) == ("fast-api-gang-2.fast-api-gang", 8477)
+        assert gang.my_address == ("fast-api-gang-1.fast-api-gang", 8477)
+
+    def test_bare_ordinal_host(self):
+        gang = GangConfig("gang-0:9000", 2, 0)
+        assert gang.peer_address(1) == ("gang-1", 9000)
+
+    def test_bare_host_offsets_ports(self):
+        # the CPU simulation transport: one host, rank r on base+r
+        gang = GangConfig("127.0.0.1:9000", 3, 2)
+        assert gang.peer_address(0) == ("127.0.0.1", 9000)
+        assert gang.peer_address(2) == ("127.0.0.1", 9002)
+
+    def test_malformed_coordinator_rejected(self):
+        with pytest.raises(ValueError):
+            GangConfig("no-port-here", 2, 0).peer_address(1)
+
+    def test_gang_from_config_off_by_default(self):
+        assert gang_from_config(ServingConfig()) is None
+        # size without a coordinator (or vice versa) stays off
+        assert gang_from_config(
+            ServingConfig(serve_gang_size=2)
+        ) is None
+        assert gang_from_config(
+            ServingConfig(serve_gang_coordinator="127.0.0.1:9000")
+        ) is None
+
+    def test_gang_from_config_fails_fast_on_bad_rank(self):
+        cfg = ServingConfig(
+            serve_gang_coordinator="127.0.0.1:9000",
+            serve_gang_size=2, serve_gang_rank=2,
+        )
+        with pytest.raises(ValueError, match="rank 2 >= gang size 2"):
+            gang_from_config(cfg)
+
+    def test_resolve_serve_span_gang_is_decisive(self):
+        # an armed gang always resolves "mesh" — each member holds only
+        # its slab, whatever the single-process knob says
+        for layout in ("replicated", "sharded", "auto"):
+            assert resolve_serve_span(layout, 10, 5, 4, gang_size=2) == "mesh"
+        # gang off: delegates to the single-process decision
+        assert resolve_serve_span("replicated", 10, 5, 4) == "replicated"
+        assert resolve_serve_span("auto", 10, 5, 4) == "sharded"
+
+
+def _start_worker(serve_partial, token="tok"):
+    worker = MeshWorkerServer(
+        serve_partial,
+        lambda: {"rank": 1, "token": token},
+        host="127.0.0.1", port=0,
+    ).start()
+    return worker
+
+
+def _echo_partial(token="tok"):
+    """serve_partial double: ids = seeds clipped to >=0, confs = row
+    index — deterministic, shape-preserving, easy to assert on."""
+
+    def serve(seeds):
+        ids = np.maximum(seeds, 0).astype(np.int32)
+        confs = np.broadcast_to(
+            np.arange(seeds.shape[0], dtype=np.float32)[:, None],
+            seeds.shape,
+        ).astype(np.float32)
+        return ids, confs, token
+
+    return serve
+
+
+class TestWireProtocol:
+    def test_partial_round_trip(self):
+        worker = _start_worker(_echo_partial())
+        try:
+            client = MeshPeerClient(1, ("127.0.0.1", worker.port))
+            seeds = np.array([[3, -1, 7], [2, 2, -1]], dtype=np.int32)
+            ids, confs = client.partial(seeds, "tok")
+            np.testing.assert_array_equal(ids, [[3, 0, 7], [2, 2, 0]])
+            np.testing.assert_array_equal(confs, [[0, 0, 0], [1, 1, 1]])
+            assert client.ready()["rank"] == 1
+            client.close()
+        finally:
+            worker.stop()
+
+    def test_token_mismatch_reads_as_missing_shard(self):
+        # mid-rollout generation skew: a peer serving another publication
+        # must NOT contribute partials — merging across epochs would be
+        # silent corruption; the rank reads as missing instead
+        worker = _start_worker(_echo_partial(token="other"))
+        try:
+            client = MeshPeerClient(1, ("127.0.0.1", worker.port))
+            with pytest.raises(MeshShardUnavailable) as exc:
+                client.partial(np.zeros((1, 2), dtype=np.int32), "tok")
+            assert exc.value.rank == 1
+            assert "token" in exc.value.reason
+            client.close()
+        finally:
+            worker.stop()
+
+    def test_dead_peer_raises_missing_shard(self):
+        # grab a port nothing listens on
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+        client = MeshPeerClient(
+            0, ("127.0.0.1", port), connect_timeout_s=0.2
+        )
+        with pytest.raises(MeshShardUnavailable) as exc:
+            client.partial(np.zeros((1, 1), dtype=np.int32), "tok")
+        assert exc.value.rank == 0
+
+    def test_stop_then_rebind_same_port(self):
+        """The re-form leg's socket contract: stop() must actually free
+        the port (shutdown aborts the blocked accept) so a restarted
+        member can bind its rank's address again."""
+        worker = _start_worker(_echo_partial())
+        port = worker.port
+        client = MeshPeerClient(
+            1, ("127.0.0.1", port), connect_timeout_s=0.5
+        )
+        seeds = np.zeros((1, 2), dtype=np.int32)
+        client.partial(seeds, "tok")  # connection established + served
+        worker.stop()
+        with pytest.raises(MeshShardUnavailable):
+            client.partial(seeds, "tok")
+        reborn = MeshWorkerServer(
+            _echo_partial(), lambda: {}, host="127.0.0.1", port=port
+        ).start()
+        try:
+            ids, _ = client.partial(seeds, "tok")
+            np.testing.assert_array_equal(ids, [[0, 0]])
+        finally:
+            reborn.stop()
+            client.close()
+
+    def test_coordinator_probe_rate_limit_and_recovery(self):
+        """missing_shards(probe=True) re-auditions a dark rank at most
+        once per interval, and flips it back once the worker re-forms."""
+        worker = _start_worker(_echo_partial())
+        port = worker.port
+        clock = [0.0]
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{port}", 2, 1),
+            connect_timeout_s=0.3, probe_min_interval_s=1.0,
+            clock=lambda: clock[0],
+        )
+        try:
+            assert coord.missing_shards() == []
+            worker.stop()
+            finish = coord.fetch_partials(
+                np.zeros((1, 1), dtype=np.int32), "tok"
+            )
+            with pytest.raises(MeshShardUnavailable):
+                finish()
+            assert coord.missing_shards() == [0]
+            # probe while still dead: consumes this interval's window
+            clock[0] = 0.5
+            assert coord.missing_shards(probe=True) == [0]
+            # re-form the worker on the same port; the record only
+            # clears through a probe, and probes are rate-limited
+            reborn = MeshWorkerServer(
+                _echo_partial(), lambda: {"ok": True},
+                host="127.0.0.1", port=port,
+            ).start()
+            try:
+                clock[0] = 0.9  # still inside the interval: no probe
+                assert coord.missing_shards(probe=True) == [0]
+                clock[0] = 2.0
+                assert coord.missing_shards(probe=True) == []
+            finally:
+                reborn.stop()
+        finally:
+            coord.close()
+            worker.stop()
+
+
+class TestGangAsRingPeer:
+    """ISSUE 16 satellite: to the PR 15 FleetRouter a pod-gang is ONE
+    ring member — shard loss degrades exactly like replica loss."""
+
+    def _gang_owned_key(self, router):
+        for i in range(200):
+            key = f"key-{i}"
+            if router.ring.ranked(key)[0] == "gang":
+                return key
+        raise AssertionError("no gang-owned key in 200 tries")
+
+    def test_shard_loss_ejects_whole_gang_and_spills(self):
+        clock = [0.0]
+        router = FleetRouter(
+            ["gang", "solo-a", "solo-b"],
+            eject_threshold=2, probe_interval_s=1.0,
+            clock=lambda: clock[0],
+        )
+        key = self._gang_owned_key(router)
+        ranked = router.ring.ranked(key)
+        assert router.route(key) == "gang"
+        # two gang-degraded answers (503 + X-KMLS-Mesh-Unavailable: 1):
+        # the breaker is shard-blind — the WHOLE gang ejects
+        router.mark_failure("gang", shard=1)
+        router.mark_failure("gang", shard=1)
+        assert router.ejected_peers() == ["gang"]
+        assert router.ejections == 1
+        # but the blame record names the missing member for the operator
+        assert router.failed_shards() == {"gang": 1}
+        # spill lands on exactly ranked[1] — the bounded-remap property
+        assert router.route(key) == ranked[1]
+
+    def test_gang_reform_readmits_and_clears_blame(self):
+        clock = [0.0]
+        router = FleetRouter(
+            ["gang", "solo-a", "solo-b"],
+            eject_threshold=1, probe_interval_s=1.0,
+            clock=lambda: clock[0],
+        )
+        key = self._gang_owned_key(router)
+        router.mark_failure("gang", shard=0)
+        assert router.ejected_peers() == ["gang"]
+        # half-open: one probe per interval auditions the gang
+        clock[0] = 1.5
+        assert router.route(key) == "gang"
+        router.mark_success("gang")
+        assert router.ejected_peers() == []
+        assert router.readmissions == 1
+        assert router.failed_shards() == {}
+        assert router.route(key) == "gang"
+
+    def test_plain_failure_carries_no_shard_blame(self):
+        router = FleetRouter(["gang", "solo-a"], eject_threshold=3)
+        router.mark_failure("gang")  # transport fault, no shard named
+        assert router.failed_shards() == {}
+
+
+class TestRoutedReplayMeshPolicy:
+    """The routed client's half of the gang-degraded contract: a 503
+    carrying X-KMLS-Mesh-Unavailable is a PEER failure (spill +
+    shard blame), never a served 5xx."""
+
+    def test_gang_degraded_503_spills_not_5xx(self):
+        import json as json_mod
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from kmlserver_tpu.serving.replay import replay_fleet_http
+
+        class _GangDegraded(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = b'{"detail": "shard 1 unavailable"}'
+                self.send_response(503)
+                self.send_header("X-KMLS-Mesh-Unavailable", "1")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep test output quiet
+                pass
+
+        class _Healthy(_GangDegraded):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = json_mod.dumps({"songs": ["t"]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        gang_srv = ThreadingHTTPServer(("127.0.0.1", 0), _GangDegraded)
+        solo_srv = ThreadingHTTPServer(("127.0.0.1", 0), _Healthy)
+        for srv in (gang_srv, solo_srv):
+            t = __import__("threading").Thread(
+                target=srv.serve_forever, daemon=True
+            )
+            t.start()
+        try:
+            payloads = [[f"s{i}"] for i in range(30)]
+            report, fleet = replay_fleet_http(
+                {
+                    "gang": f"http://127.0.0.1:{gang_srv.server_port}",
+                    "solo": f"http://127.0.0.1:{solo_srv.server_port}",
+                },
+                payloads, qps=2000.0, eject_threshold=1,
+                redispatch_max=4, probe_interval_s=30.0,
+            )
+        finally:
+            gang_srv.shutdown()
+            solo_srv.shutdown()
+        # every gang-degraded answer spilled and was served elsewhere
+        assert report.n_errors == 0
+        assert fleet["http_5xx"] == 0
+        assert fleet["mesh_unavailable"] >= 1
+        assert fleet["ejections"] >= 1
+        # the blame record names the dark member for the report
+        assert fleet["failed_shards"] == {"gang": 1}
+        assert fleet["answered_by"]["solo"] == len(payloads)
+        assert fleet["answered_by"]["gang"] == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real 2-member gang vs a single-process reference engine
+# ---------------------------------------------------------------------------
+
+
+def _gang_ports():
+    """Two consecutive free localhost ports (base for rank 0, base+1 for
+    rank 1 — the bare-host addressing recipe)."""
+    for _ in range(50):
+        with socket.socket() as s0:
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+        if base + 1 > 65535:
+            continue
+        try:
+            with socket.socket() as s1:
+                s1.bind(("127.0.0.1", base + 1))
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no consecutive free port pair found")
+
+
+@pytest.fixture(scope="module")
+def mesh_pvc(tmp_path_factory):
+    """One real mining run shared by the mesh end-to-end tests."""
+    rng = np.random.default_rng(7)
+    tmp_path = tmp_path_factory.mktemp("mesh-pvc")
+    ds_dir = tmp_path / "datasets"
+    ds_dir.mkdir()
+    from kmlserver_tpu.data.csv import write_tracks_csv
+
+    baskets = random_baskets(rng, n_playlists=60, n_tracks=18, mean_len=5)
+    write_tracks_csv(
+        str(ds_dir / "2023_spotify_ds1.csv"), table_with_metadata(baskets)
+    )
+    mining_cfg = MiningConfig(
+        base_dir=str(tmp_path), datasets_dir=str(ds_dir), min_support=0.08,
+        k_max_consequents=32, top_tracks_save_percentile=0.5,
+    )
+    run_mining_job(mining_cfg)
+    return tmp_path, baskets
+
+
+def _shutdown(engine):
+    if engine.mesh_worker is not None:
+        engine.mesh_worker.stop()
+    if engine.mesh_coordinator is not None:
+        engine.mesh_coordinator.close()
+
+
+@pytest.fixture
+def gang_pair(mesh_pvc):
+    """(reference_engine, [rank0, rank1]) — the gang over localhost."""
+    tmp_path, _ = mesh_pvc
+    base = _gang_ports()
+    reference = RecommendEngine(ServingConfig(
+        base_dir=str(tmp_path), pickle_dir="pickles/", k_best_tracks=5,
+    ))
+    assert reference.load()
+    members = []
+    for rank in range(2):
+        engine = RecommendEngine(ServingConfig(
+            base_dir=str(tmp_path), pickle_dir="pickles/", k_best_tracks=5,
+            serve_gang_coordinator=f"127.0.0.1:{base}",
+            serve_gang_size=2, serve_gang_rank=rank,
+            serve_gang_port=base + rank,
+        ))
+        assert engine.load()
+        members.append(engine)
+    yield reference, members
+    for engine in members:
+        _shutdown(engine)
+
+
+def _seed_sets(baskets):
+    return [
+        baskets[0][:3],
+        baskets[1][:2],
+        baskets[2][:4] + ["definitely-not-a-track"],
+        ["definitely-not-a-track"],
+        baskets[3][:1],
+    ]
+
+
+class TestMeshEndToEnd:
+    def test_gang_layout_published(self, gang_pair):
+        _, members = gang_pair
+        for rank, engine in enumerate(members):
+            bundle = engine.replicas[0]
+            assert bundle.layout == "mesh"
+            assert bundle.n_shards == 2
+            assert bundle.gang_rank == rank
+            # the slab really is a slice: half the padded rows, not all
+            assert bundle.rule_ids.shape[0] == bundle.shard_size
+            assert bundle.shard_size * 2 == bundle.mesh_v
+
+    def test_identity_and_zero_compiles(self, mesh_pvc, gang_pair):
+        """The tentpole pin: EVERY gang member answers every request
+        bit-identically to the single-process full-catalog engine, with
+        zero unwarmed dispatches (no compiles post-publish)."""
+        _, baskets = mesh_pvc
+        reference, members = gang_pair
+        seed_sets = _seed_sets(baskets)
+        expected_many = reference.recommend_many(seed_sets)
+        for engine in members:
+            assert engine.recommend_many(seed_sets) == expected_many
+            for seeds in seed_sets:
+                assert engine.recommend(seeds) == reference.recommend(seeds)
+        assert all(e.unwarmed_dispatches == 0 for e in members)
+        assert all(e.mesh_missing_shards() == [] for e in members)
+
+    def test_member_death_and_reform(self, mesh_pvc, gang_pair):
+        """Shard loss: killing rank 1's worker surfaces as
+        MeshShardUnavailable(rank=1) at rank 0 (named in
+        mesh_missing_shards — what /readyz and the 503 report), and a
+        re-formed worker is re-admitted by the rate-limited probe with
+        answers identical again."""
+        _, baskets = mesh_pvc
+        reference, members = gang_pair
+        seeds = baskets[0][:3]
+        assert members[0].recommend(seeds) == reference.recommend(seeds)
+
+        # SIGKILL stand-in: every socket of rank 1's worker dies
+        members[1].mesh_worker.stop()
+        with pytest.raises(MeshShardUnavailable) as exc:
+            members[0].recommend(seeds)
+        assert exc.value.rank == 1
+        assert members[0].mesh_missing_shards() == [1]
+
+        # re-form on the same port (the StatefulSet ordinal's address)
+        members[1].mesh_worker = None
+        members[1]._ensure_mesh_runtime()
+        time.sleep(1.1)  # past the coordinator's probe rate limit
+        assert members[0].mesh_missing_shards(probe=True) == []
+        assert members[0].recommend(seeds) == reference.recommend(seeds)
