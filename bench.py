@@ -349,6 +349,7 @@ _COMPACT_PRIORITY = (
     "loadshape_p99_ms", "loadshape_errors", "loadshape_http_5xx",
     "loadshape_shed", "loadshape_degraded", "loadshape_offered_qps",
     "loadshape_achieved_qps", "loadshape_p50_ms", "loadshape_burst_factor",
+    "loadshape_onset_p99_ms", "loadshape_steady_p99_ms",
     "loadshape_flash_p99_ms", "loadshape_flash_http_5xx",
     "loadshape_flip_http_5xx", "loadshape_flip_errors",
     "loadshape_flip_epoch_moved", "loadshape_flip_singleflight",
@@ -380,6 +381,20 @@ _COMPACT_PRIORITY = (
     # detail is sidecar-only, the compact line sits at its budget
     "freshness_speedup", "freshness_http_5xx", "freshness_errors",
     "freshness_publish_to_applied_ms", "freshness_fleet_multiplier",
+    # judged predictive-serving claims (ISSUE 17): the paired A/B legs'
+    # p99 + onset-window p99 for ramp/sine (predictive must be no worse
+    # on both and on shed/degrade at equal capacity), zero 5xx across
+    # every leg, and the predictive legs' observation evidence — ranked
+    # with the other CPU-measured judged brackets below the TPU serving
+    # evidence; steady-window, constant-control and per-leg detail is
+    # sidecar-only
+    "loadshape_pred_ramp_react_p99_ms", "loadshape_pred_ramp_pred_p99_ms",
+    "loadshape_pred_ramp_react_onset_p99_ms",
+    "loadshape_pred_ramp_pred_onset_p99_ms",
+    "loadshape_pred_sine_react_p99_ms", "loadshape_pred_sine_pred_p99_ms",
+    "loadshape_pred_ramp_react_shed", "loadshape_pred_ramp_pred_shed",
+    "loadshape_pred_http_5xx", "loadshape_pred_errors",
+    "loadshape_pred_ramp_obs",
     # judged fleet cache-routing claims (ISSUE 15): routed vs
     # independent fleet hit ratio on 3 REAL server processes, the
     # multiplier achieved vs the PR 10 simulated prediction (≥ 0.9 of
@@ -2380,6 +2395,18 @@ with tempfile.TemporaryDirectory(prefix="kmls_loadshape_") as base:
             "achieved_qps": round(rep.achieved_qps, 1),
             "p50_ms": round(rep.p50_ms, 3),
             "p99_ms": round(rep.p99_ms, 3),
+            # arrival-windowed split (ISSUE 17): the first-40%-of-
+            # schedule tail vs the last-40% tail — on shaped traffic the
+            # onset window is where reactive adaptation is still
+            # catching up, and a pooled p99 averages that away
+            "onset_p99_ms": (
+                round(rep.onset_p99_ms, 3)
+                if rep.onset_p99_ms is not None else None
+            ),
+            "steady_p99_ms": (
+                round(rep.steady_p99_ms, 3)
+                if rep.steady_p99_ms is not None else None
+            ),
             "errors": rep.n_errors,
             "http_5xx": http_5xx[0] - t5xx0,
             "shed": app.batcher.shed_total - shed0,
@@ -2461,6 +2488,170 @@ with tempfile.TemporaryDirectory(prefix="kmls_loadshape_") as base:
         "epochflip": flip_res,
         "cache_hit_ratio": app.cache.hit_ratio() if app.cache else None,
         "utilization_after": round(app.batcher.utilization(), 4),
+        "platform": dev.platform,
+    }))
+"""
+
+# the predictive-serving phase (ISSUE 17): the same shaped-traffic rig as
+# the loadshape bracket, run as paired A/B legs at EQUAL capacity — one
+# server with the forecaster off (pure reactive, the PR 8 ladder), one
+# with KMLS_FORECAST=1 — over the two shapes prediction exists for (ramp,
+# sine) plus constant as the control where the forecaster must change
+# nothing. Each leg reports pooled p99, the onset/steady arrival-window
+# split (onset is where reactive adaptation lags and prediction can
+# lead), and the shed/degrade counts; the predictive legs also report the
+# forecaster's own counters so a "win" with zero observations reads as
+# the measurement artifact it would be.
+_LOADSHAPE_PRED_BENCH = r"""
+import dataclasses, json, os, sys, tempfile, threading, time
+import jax
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.batcher import (
+    DeadlineExceeded, NoHealthyReplicas, Overloaded, OverloadDegraded,
+)
+from kmlserver_tpu.serving import forecast as forecast_mod
+from kmlserver_tpu.serving.replay import (
+    replay_pooled, sample_seed_sets, shaped_arrivals,
+)
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_LOADSHAPE_QPS", "1000"))
+n_req = int(os.environ.get("KMLS_BENCH_LOADSHAPE_REQUESTS", "8000"))
+with tempfile.TemporaryDirectory(prefix="kmls_loadshape_pred_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    run_mining_job(MiningConfig(base_dir=base, datasets_dir=ds_dir,
+                                min_support=0.05))
+    # a tight shed budget puts the admission ladder IN PLAY at these
+    # shapes: with the 250ms default neither leg ever sheds and the
+    # judged shed/degrade comparison is a vacuous 0-0 tie. 30ms is
+    # still ~10x the steady-state p99, so a leg sheds only when its
+    # batch window lags the arrival rate — exactly the lag the
+    # forecaster exists to remove. Applied to BOTH legs: equal capacity.
+    base_cfg = dataclasses.replace(
+        ServingConfig.from_env(), base_dir=base,
+        batch_max_size=64, request_deadline_ms=2000.0,
+        shed_queue_budget_ms=30.0,
+    )
+    assert base_cfg.shed_queue_budget_ms > 0, "admission control must be on"
+
+    def run_leg(shape, predictive, payloads, arrivals):
+        # equal capacity by construction: the ONLY config difference
+        # between the paired legs is the forecaster knob
+        cfg = dataclasses.replace(base_cfg, forecast_enabled=predictive)
+        app = RecommendApp(cfg)
+        assert app.engine.load(), "mined artifacts must load"
+        would_5xx = [0]
+        lock = threading.Lock()
+
+        def make_send():
+            def send(seeds):
+                try:
+                    recs, source, cached = app.recommend_direct(seeds)
+                except Overloaded:
+                    return "shed", None
+                except (OverloadDegraded, DeadlineExceeded,
+                        NoHealthyReplicas):
+                    return "degraded", None
+                except Exception:
+                    with lock:
+                        would_5xx[0] += 1  # handle() would 500 this
+                    raise
+                return "ok", cached
+            return send
+
+        # identical warm discipline both modes: every distinct payload
+        # once, then a paced half-rate pass for the jit/batcher paths
+        warm = make_send()
+        seen = set()
+        for p in payloads:
+            key = tuple(p)
+            if key not in seen:
+                seen.add(key)
+                warm(p)
+        replay_pooled(
+            make_send, payloads[: min(3000, n_req)], qps=qps / 2,
+            n_workers=16,
+        )
+        shed0 = app.batcher.shed_total
+        obs0 = forecast_mod.OBSERVATIONS_TOTAL
+        rep = replay_pooled(
+            make_send, payloads, qps=qps, n_workers=16, max_queue=16384,
+            arrivals=arrivals,
+        )
+        out = {
+            "p50_ms": round(rep.p50_ms, 3),
+            "p99_ms": round(rep.p99_ms, 3),
+            "onset_p99_ms": (
+                round(rep.onset_p99_ms, 3)
+                if rep.onset_p99_ms is not None else None
+            ),
+            "steady_p99_ms": (
+                round(rep.steady_p99_ms, 3)
+                if rep.steady_p99_ms is not None else None
+            ),
+            "errors": rep.n_errors,
+            "http_5xx": would_5xx[0],
+            "shed": app.batcher.shed_total - shed0,
+            "degraded": rep.by_source.get("degraded", 0),
+            "ok": rep.by_source.get("ok", 0),
+            "achieved_qps": round(rep.achieved_qps, 1),
+        }
+        if predictive:
+            f = app.forecaster
+            assert f is not None, "KMLS_FORECAST leg must hold a forecaster"
+            out["forecast_observations"] = f.observations
+            out["prewarm_total"] = getattr(app.batcher, "prewarm_total", 0)
+        else:
+            # the zero-cost proof under REAL traffic: a disabled-mode
+            # leg must never reach the forecaster (is-None gate)
+            delta = forecast_mod.OBSERVATIONS_TOTAL - obs0
+            assert delta == 0, f"disabled leg observed {delta} requests"
+            out["forecast_disabled_obs_delta"] = delta
+        mode = "pred" if predictive else "react"
+        print(f"loadshape_pred/{shape}/{mode}: {out}", file=sys.stderr,
+              flush=True)
+        return out
+
+    # one probe load for the catalog vocab; the measured legs each load
+    # their own fresh app
+    from kmlserver_tpu.serving.engine import RecommendEngine
+
+    probe = RecommendEngine(base_cfg)
+    assert probe.load(), "mined artifacts must load"
+    vocab = list(probe.bundle.vocab)
+    del probe
+
+    shapes = {}
+    rng_seeds = {"ramp": 41, "sine": 43, "constant": 47}
+    for shape in ("ramp", "sine", "constant"):
+        # fixed per-shape rng: the paired legs replay the SAME payloads
+        # on the SAME arrival schedule — the knob is the only variable
+        payloads = sample_seed_sets(
+            vocab, n_req, rng_seed=rng_seeds[shape], zipf_s=1.1,
+        )
+        # the ramp climbs to 3x base — past the point where a
+        # stale-wide batch window starts costing queue wait, so the
+        # tightened shed budget has something to judge
+        kw = {"ramp_stop_factor": 3.0} if shape == "ramp" else {}
+        arrivals = shaped_arrivals(n_req, qps, shape, **kw)
+        shapes[shape] = {
+            "reactive": run_leg(shape, False, payloads, arrivals),
+            "predictive": run_leg(shape, True, payloads, arrivals),
+        }
+    print(json.dumps({
+        "qps": qps,
+        "requests": n_req,
+        "shapes": shapes,
         "platform": dev.platform,
     }))
 """
@@ -4321,6 +4512,15 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         _record_loadshape(result, bank="loadshape_cpu", budget_s=200)
         em.checkpoint()
 
+    # predictive-serving A/B bracket (ISSUE 17): CPU-measured by
+    # construction — forecaster on vs off at equal capacity over
+    # ramp/sine/constant
+    if "loadshape_pred_ramp_pred_p99_ms" not in result:
+        _record_loadshape_pred(
+            result, bank="loadshape_pred_cpu", budget_s=240
+        )
+        em.checkpoint()
+
     # mining-interruption bracket: CPU-measured by construction as well
     if "mine_resume_s" not in result:
         _record_mine_resume(result, bank="mine_resume_cpu", budget_s=150)
@@ -4431,6 +4631,13 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # crowd / epoch-boundary hot-key flip through the admission
         # ladder — p99 < 10 ms and zero 5xx through the bursts
         _record_loadshape(result)
+        em.checkpoint()
+
+    if _remaining() > 240:
+        # predictive-serving A/B bracket (ISSUE 17): forecaster on vs
+        # off at equal capacity — predictive no worse on p99 AND
+        # shed/degrade for ramp + sine, constant the unchanged control
+        _record_loadshape_pred(result)
         em.checkpoint()
 
     if _remaining() > 120:
@@ -4765,6 +4972,8 @@ def _record_loadshape(
         "loadshape_achieved_qps": b["achieved_qps"],
         "loadshape_p50_ms": b["p50_ms"],
         "loadshape_p99_ms": b["p99_ms"],
+        "loadshape_onset_p99_ms": b.get("onset_p99_ms"),
+        "loadshape_steady_p99_ms": b.get("steady_p99_ms"),
         "loadshape_errors": b["errors"],
         "loadshape_http_5xx": b["http_5xx"],
         "loadshape_shed": b["shed"],
@@ -4781,6 +4990,76 @@ def _record_loadshape(
         "loadshape_cache_hit_ratio": res.get("cache_hit_ratio"),
         "loadshape_platform": res["platform"],
     }
+    for key, val in flat.items():
+        if val is not None:
+            result[key] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_loadshape_pred(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The predictive-serving bracket (ISSUE 17): paired A/B legs at
+    equal capacity — forecaster off vs KMLS_FORECAST=1 — over ramp and
+    sine (where prediction can lead the cliff) plus constant (the
+    control, where it must change nothing). The judged claims: the
+    predictive leg no worse than reactive on BOTH pooled p99 and
+    shed+degrade count for ramp and sine, zero 5xx on every leg, and the
+    predictive legs' forecaster observation counts > 0 (a win with no
+    observations would be a measurement artifact). CPU-platform by
+    construction, self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "loadshape_pred", _LOADSHAPE_PRED_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    shapes = res.get("shapes")
+    if not shapes:
+        return
+    total_5xx = sum(
+        leg["http_5xx"] for pair in shapes.values() for leg in pair.values()
+    )
+    total_errors = sum(
+        leg["errors"] for pair in shapes.values() for leg in pair.values()
+    )
+    for s in ("ramp", "sine"):
+        if s not in shapes:
+            continue
+        react, pred = shapes[s]["reactive"], shapes[s]["predictive"]
+        log(
+            f"loadshape_pred/{s}: p99 react {react['p99_ms']:.2f}ms → pred "
+            f"{pred['p99_ms']:.2f}ms (onset {react.get('onset_p99_ms')} → "
+            f"{pred.get('onset_p99_ms')}); shed+degraded "
+            f"{react['shed'] + react['degraded']} → "
+            f"{pred['shed'] + pred['degraded']}; "
+            f"{pred.get('forecast_observations', 0)} observations"
+        )
+    flat = {"loadshape_pred_http_5xx": total_5xx,
+            "loadshape_pred_errors": total_errors,
+            "loadshape_pred_qps": res["qps"],
+            "loadshape_pred_platform": res["platform"]}
+    for s, pair in shapes.items():
+        for mode, tag in (("reactive", "react"), ("predictive", "pred")):
+            leg = pair[mode]
+            prefix = f"loadshape_pred_{s}_{tag}"
+            flat[f"{prefix}_p99_ms"] = leg["p99_ms"]
+            flat[f"{prefix}_onset_p99_ms"] = leg.get("onset_p99_ms")
+            flat[f"{prefix}_steady_p99_ms"] = leg.get("steady_p99_ms")
+            flat[f"{prefix}_shed"] = leg["shed"]
+            flat[f"{prefix}_degraded"] = leg["degraded"]
+            if tag == "react":
+                # the zero-cost proof under real traffic: the disabled
+                # leg's forecaster observation delta, asserted 0 in-phase
+                flat[f"{prefix}_obs_delta"] = leg.get(
+                    "forecast_disabled_obs_delta"
+                )
+        flat[f"loadshape_pred_{s}_obs"] = pair["predictive"].get(
+            "forecast_observations"
+        )
     for key, val in flat.items():
         if val is not None:
             result[key] = round(val, 3) if isinstance(val, float) else val

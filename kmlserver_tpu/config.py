@@ -241,6 +241,20 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_SLO_DEGRADE_BUDGET": "serving",
     "KMLS_SLO_FAST_WINDOW_S": "serving",
     "KMLS_SLO_SLOW_WINDOW_S": "serving",
+    # --- serving: predictive serving (ISSUE 17) ---
+    # online traffic forecaster (serving/forecast.py): arrival-rate +
+    # request-mix EWMAs with trend, feeding three actuators — batch-
+    # window pre-widening/shape pre-touch, a bounded HPA-lead term in
+    # kmls_utilization, and owner-targeted post-delta cache pre-fetch.
+    # 0 (default) leaves the hook None — proven zero-cost, observation-
+    # counter style like KMLS_COSTMODEL.
+    "KMLS_FORECAST": "serving",
+    "KMLS_FORECAST_HORIZON_S": "serving",
+    "KMLS_FORECAST_WINDOW_S": "serving",
+    "KMLS_FORECAST_ALPHA": "serving",
+    "KMLS_FORECAST_UTIL_CAP": "serving",
+    "KMLS_FORECAST_RAMP_RATIO": "serving",
+    "KMLS_FORECAST_PREFETCH_TOP_N": "serving",
     # --- mining: semantics / device dispatch ---
     "KMLS_MAX_ITEMSET_LEN": "mining",
     "KMLS_K_MAX_CONSEQUENTS": "mining",
@@ -955,6 +969,42 @@ class ServingConfig:
     slo_fast_window_s: float = 300.0
     slo_slow_window_s: float = 3600.0
 
+    # --- predictive serving (ISSUE 17, serving/forecast.py) ---
+    # Online arrival-rate + request-mix forecaster feeding the three
+    # predictive actuators (batch-window pre-widening + shape pre-touch,
+    # the bounded HPA-lead term in kmls_utilization, owner-targeted
+    # post-delta cache pre-fetch). Off (default) = the app holds no
+    # forecaster at all: every call site is one is-None check, and the
+    # module observation counter proves zero work (test-pinned, the
+    # KMLS_COSTMODEL pattern). A wrong forecast can only over-provision
+    # — the admission ladder never reads it, so shedding can never start
+    # earlier than reactive.
+    forecast_enabled: bool = False
+    # How far ahead the rate prediction looks: predicted = level +
+    # trend·horizon. Matches the scale-out lead the HPA can actually
+    # use (its scaleUp stabilization window is 15 s; the batcher's
+    # actuators work at sub-second scale from the same prediction).
+    forecast_horizon_s: float = 2.0
+    # Width of the arrival-count windows the level/trend EWMAs smooth
+    # over; silent windows fold in as zeros so the forecast decays in
+    # real time after a burst.
+    forecast_window_s: float = 0.5
+    # Smoothing factor for the rate level (the trend term uses 0.3,
+    # fixed — one knob tunes responsiveness, the pair stays stable).
+    forecast_alpha: float = 0.35
+    # Ceiling on the forecast CONTRIBUTION to kmls_utilization: the
+    # lead term is clamped to [reactive, this cap], so prediction alone
+    # can drive the HPA to the cap but only measured overload reports
+    # past it.
+    forecast_util_cap: float = 1.0
+    # Growth ratio (predicted/current rate) that arms the pre-widen/
+    # pre-touch actuators; below it the batcher behaves exactly
+    # reactively.
+    forecast_ramp_ratio: float = 1.2
+    # How many predicted-hot seed sets the post-delta pre-fetch
+    # re-materializes (owner-owned, invalidation-cold sets only).
+    forecast_prefetch_top_n: int = 8
+
     # --- second model family: hybrid rule∪embedding serving ---
     # How the two model families combine when an embedding artifact is
     # published: "rules" ignores embeddings entirely (the legacy path),
@@ -1067,5 +1117,16 @@ class ServingConfig:
             slo_fast_window_s=_getenv_float("KMLS_SLO_FAST_WINDOW_S", 300.0),
             slo_slow_window_s=_getenv_float(
                 "KMLS_SLO_SLOW_WINDOW_S", 3600.0
+            ),
+            forecast_enabled=_getenv_bool("KMLS_FORECAST", False),
+            forecast_horizon_s=_getenv_float("KMLS_FORECAST_HORIZON_S", 2.0),
+            forecast_window_s=_getenv_float("KMLS_FORECAST_WINDOW_S", 0.5),
+            forecast_alpha=_getenv_float("KMLS_FORECAST_ALPHA", 0.35),
+            forecast_util_cap=_getenv_float("KMLS_FORECAST_UTIL_CAP", 1.0),
+            forecast_ramp_ratio=_getenv_float(
+                "KMLS_FORECAST_RAMP_RATIO", 1.2
+            ),
+            forecast_prefetch_top_n=_getenv_int(
+                "KMLS_FORECAST_PREFETCH_TOP_N", 8
             ),
         )

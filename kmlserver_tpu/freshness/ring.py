@@ -76,6 +76,13 @@ class RendezvousRing:
     def owner_index(self, key: str) -> int:
         return self.peers.index(self.owner(key))
 
+    def owns(self, key: str, peer: str) -> bool:
+        """True when ``peer`` is the rendezvous owner of ``key`` — the
+        gate the predictive cache pre-fetch (ISSUE 17) applies so a
+        predicted-hot seed set re-materializes on its owner replica
+        ONLY, never as a fleet-wide broadcast."""
+        return self.owner(key) == peer
+
     def ranked(self, key: str) -> list[str]:
         """Every peer in descending rendezvous weight for ``key`` — THE
         spill order. ``ranked(key)[0]`` is :meth:`owner`; removing the

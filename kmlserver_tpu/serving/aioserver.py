@@ -376,6 +376,7 @@ async def run_async(app: RecommendApp, port: int, ready=None) -> int:
             redispatch_max=cfg.redispatch_max_retries,
             metrics=app.metrics,
             lag_monitor=app.loop_lag,
+            forecaster=getattr(app, "forecaster", None),
         )
     if app.loop_lag is not None:
         # arm the drift tick on THIS loop: timer-due minus timer-ran is

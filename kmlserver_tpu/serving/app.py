@@ -108,6 +108,10 @@ class RecommendApp:
     slo = None
     _profile_thread = None
     _profile_lock = threading.Lock()
+    # predictive serving (ISSUE 17): default-off — a hand-assembled app
+    # without __init__ behaves exactly reactively
+    forecaster = None
+    forecast_prefetch_total = 0
 
     def __init__(
         self, cfg: ServingConfig, engine: RecommendEngine | None = None,
@@ -232,6 +236,24 @@ class RecommendApp:
                 peers.append(me)
             self.ring = RendezvousRing(peers)
             self._ring_self = me
+        # predictive serving (ISSUE 17): with KMLS_FORECAST=0 (default)
+        # the hook stays None and every touchpoint — batcher submit,
+        # utilization, post-delta pre-fetch — is one is-None check; the
+        # forecast module's observation counter proves the zero cost,
+        # compile-counter style (KMLS_COSTMODEL's pattern).
+        self.forecaster = None
+        self.forecast_prefetch_total = 0
+        if getattr(cfg, "forecast_enabled", False):
+            from .forecast import TrafficForecaster
+
+            self.forecaster = TrafficForecaster(
+                horizon_s=cfg.forecast_horizon_s,
+                window_s=cfg.forecast_window_s,
+                alpha=cfg.forecast_alpha,
+                util_cap=cfg.forecast_util_cap,
+                ramp_ratio=cfg.forecast_ramp_ratio,
+                hot_top_n=cfg.forecast_prefetch_top_n,
+            )
         # defer_batcher: the asyncio transport installs its loop-native
         # AsyncMicroBatcher instead — don't spawn the threaded pipeline
         if cfg.batch_window_ms > 0 and not defer_batcher:
@@ -253,6 +275,7 @@ class RecommendApp:
                 redispatch_max=cfg.redispatch_max_retries,
                 metrics=self.metrics,
                 lag_monitor=self.loop_lag,
+                forecaster=self.forecaster,
             )
         # template/static roots honor APP_PATH_FROM_ROOT like the reference
         # (rest_api/app/main.py:44-48 resolves its template/static dirs from
@@ -464,13 +487,35 @@ class RecommendApp:
         )
         # the autoscaling signal (ISSUE 8): kmls_utilization is what
         # kubernetes/hpa.yaml scales the fleet on — max of pipeline
-        # occupancy and admission queue pressure, 1.0 = at capacity.
+        # occupancy and admission queue pressure, 1.0 = at capacity,
+        # plus (forecaster armed) the bounded predictive lead term.
         # Always present (0.0 without a batcher) so the HPA's metric
         # query never comes back empty on an idle pod.
-        util_fn = getattr(self.batcher, "utilization", None)
-        state["utilization"] = (
-            round(util_fn(), 4) if callable(util_fn) else 0.0
-        )
+        parts_fn = getattr(self.batcher, "utilization_parts", None)
+        if callable(parts_fn):
+            reactive, led = parts_fn()
+        else:
+            util_fn = getattr(self.batcher, "utilization", None)
+            reactive = led = util_fn() if callable(util_fn) else 0.0
+        state["utilization"] = round(led, 4)
+        if self.forecaster is not None:
+            # predictive serving (ISSUE 17): the forecast's ADDED lead
+            # over the reactive signal (0 at steady state — dashboards
+            # see how much of kmls_utilization is prediction), the
+            # rate/prediction/ratio snapshot, the zero-cost proof
+            # counter, and the two actuator counters
+            snap = self.forecaster.snapshot()
+            state["utilization_forecast"] = round(max(0.0, led - reactive), 4)
+            state["forecast_rate"] = round(snap["rate"], 3)
+            state["forecast_predicted_rate"] = round(
+                snap["predicted_rate"], 3
+            )
+            state["forecast_ratio"] = round(snap["ratio"], 4)
+            state["forecast_observations_total"] = snap["observations"]
+            state["forecast_prefetch_total"] = self.forecast_prefetch_total
+            state["forecast_prewarm_total"] = getattr(
+                self.batcher, "prewarm_total", 0
+            )
         # overload-degrade admissions (the ladder rung before any 429)
         state["admission_degrade_total"] = getattr(
             self.batcher, "degrade_total", 0
@@ -912,7 +957,9 @@ class RecommendApp:
     def _on_delta_applied(self, touched: set, wholesale: bool) -> None:
         """Engine callback after a delta bundle swapped in: selectively
         invalidate the touched seed keys (wholesale applies bumped the
-        epoch, which already invalidates every key for free)."""
+        epoch, which already invalidates every key for free), then —
+        forecaster armed — re-materialize the predicted-hot sets the
+        invalidation just cooled (actuator c)."""
         if self.cache is None or wholesale:
             return
         dropped = self.cache.invalidate_seeds(set(touched))
@@ -920,6 +967,65 @@ class RecommendApp:
             "delta applied: %d touched names, %d cache entries invalidated "
             "selectively", len(touched), dropped,
         )
+        if self.forecaster is not None:
+            names = set(touched)
+            loop = getattr(self.batcher, "_loop", None)
+            if loop is not None:
+                # loop-native batcher: submit() is loop-confined, and this
+                # callback runs on the engine's reload/delta thread — hop
+                try:
+                    loop.call_soon_threadsafe(self._forecast_prefetch, names)
+                except RuntimeError:
+                    pass  # loop already closed: a missed pre-fetch is fine
+            else:
+                self._forecast_prefetch(names)
+
+    def _forecast_prefetch(self, touched: set) -> int:
+        """Targeted cache pre-fetch (ISSUE 17, actuator c): for each
+        predicted-hot seed set that (a) the delta just cooled (its seeds
+        intersect ``touched``), (b) THIS replica owns on the rendezvous
+        ring (owner only, never broadcast — no ring means every key is
+        local), and (c) is not still cached, lead a normal singleflight
+        batcher submission so the entry is warm before the next real
+        request misses on it. Competing with live traffic is forbidden:
+        the first admission-ladder rejection (Overloaded/degrade/
+        no-replicas — or a loop-confinement error from a mis-threaded
+        call) abandons the whole pass. → pre-fetch leads started."""
+        f = self.forecaster
+        if (
+            f is None or self.cache is None or self.batcher is None
+            or not hasattr(self.batcher, "submit")
+        ):
+            return 0
+        from ..freshness.ring import seeds_key
+
+        started = 0
+        for seeds in f.hot_seed_sets(
+            getattr(self.cfg, "forecast_prefetch_top_n", 8)
+        ):
+            if not any(s in touched for s in seeds):
+                continue  # the delta didn't cool this set — still cached
+            if self.ring is not None and not self.ring.owns(
+                seeds_key(seeds), self._ring_self
+            ):
+                continue  # another replica's key: its owner pre-fetches it
+            key = self._cache_key(seeds)
+            if self.cache.contains(key):
+                continue
+            try:
+                future, joined = self.cache.join_or_lead(
+                    key, lambda s=seeds: self.batcher.submit(s)
+                )
+            except Exception:
+                break  # overloaded or unhealthy: never compete with traffic
+            if not joined:
+                cache = self.cache
+                future.add_done_callback(
+                    lambda fut, k=key: cache.finish(k, fut)
+                )
+                started += 1
+        self.forecast_prefetch_total += started
+        return started
 
     def _cache_key(self, songs: list[str]) -> tuple:
         if self.cache is not None:

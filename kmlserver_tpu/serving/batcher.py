@@ -330,10 +330,17 @@ class MicroBatcher:
         redispatch_max: int = 2,
         metrics=None,
         lag_monitor=None,
+        forecaster=None,
     ):
         self.engine = engine
         self.max_size = max_size
         self.window_s = window_ms / 1e3
+        # predictive serving (ISSUE 17): a serving.forecast
+        # .TrafficForecaster, or None (the default — every forecast
+        # touchpoint below is one is-None check, the zero-cost contract)
+        self.forecaster = forecaster
+        self.prewarm_total = 0
+        self._prewarm_armed = True  # one pre-touch per ramp episode
         self.adaptive = adaptive
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
         self.shed_budget_s = shed_queue_budget_ms / 1e3
@@ -561,15 +568,35 @@ class MicroBatcher:
         0.5–0.7 range scales the fleet out BEFORE any request degrades.
         Taking the max makes the signal rise with whichever saturates
         first: a device-bound fleet fills its pipelines, a queue-bound
-        one grows its projected wait."""
+        one grows its projected wait.
+
+        With a forecaster attached (ISSUE 17, actuator b) the reactive
+        max gains a bounded predictive lead: the reactive value scaled
+        by the forecast growth ratio, clamped to [reactive, util_cap] —
+        the HPA sees a ramp ``horizon_s`` early, the signal never drops
+        below what is measured, and prediction alone never reports past
+        the cap. The admission ladder does not read this value, so a
+        wrong forecast can only over-provision, never shed."""
+        reactive, led = self.utilization_parts()
+        return led
+
+    def utilization_parts(self) -> tuple[float, float]:
+        """→ ``(reactive, forecast_led)``: the reactive occupancy/
+        pressure max and the bounded forecast-led value actually
+        exported as ``kmls_utilization`` (identical with no forecaster —
+        the difference is the ``kmls_utilization_forecast`` gauge)."""
         n = self._n_replicas()
         with self._n_lock:
             inflight = self._total_inflight_locked()
             capacity = max(1, self._n_effective_locked(n))
         occupancy = inflight / (self.max_inflight * capacity)
-        return max(
+        reactive = max(
             occupancy, self._admission.pressure(self.projected_queue_wait_s())
         )
+        f = self.forecaster
+        if f is None:
+            return reactive, reactive
+        return reactive, f.utilization_lead(reactive)
 
     def _arrival_gap_s(self) -> float | None:
         """Mean inter-arrival gap over the sliding window, or None before
@@ -594,6 +621,14 @@ class MicroBatcher:
         now = time.perf_counter()
         with self._rate_lock:
             self._arrivals.append(now)
+        f = self.forecaster
+        if f is not None:
+            # predictive serving (ISSUE 17): every arrival feeds the
+            # rate/mix model BEFORE the shed decision — demand the
+            # ladder turns away is still demand the forecast must see.
+            # The forecaster keeps its own clock; its window math never
+            # mixes with these perf_counter timestamps.
+            f.observe(seeds)
         if self.eject_threshold > 0 and self._ejected:
             # unlocked pre-check on _ejected: the healthy common case must
             # not pay a contended _n_lock acquisition per request (same
@@ -669,10 +704,21 @@ class MicroBatcher:
         or (adaptive) the time the observed arrival rate needs to fill the
         rest of the batch — so a nearly-full batch stops waiting for one
         straggler; always capped so the batch leader's queue wait stays
-        inside the shed budget."""
+        inside the shed budget.
+
+        With a ramp forecast (ISSUE 17, actuator a) the window is sized
+        from the PREDICTED arrival gap instead of the trailing measured
+        one when the prediction is tighter: the trailing window-mean gap
+        lags a ramp by construction, so without the forecast the batcher
+        holds early-ramp batches open for stragglers that are in fact
+        about to arrive in bulk — sizing to the incoming rate keeps
+        batches full-and-moving through the onset instead of discovering
+        the rate through queue growth. The forecast can only SHRINK the
+        estimated gap (min), so the shed-budget cap and the window floor
+        bind exactly as reactively."""
         window = self.window_s
         if self.adaptive:
-            gap = self._arrival_gap_s()
+            gap = self._forecast_gap_s(self._arrival_gap_s())
             if gap is not None:
                 need = (self.max_size - len(batch)) * gap
                 window = min(self.window_s, max(self.window_min_s, need))
@@ -680,6 +726,50 @@ class MicroBatcher:
             leader_wait = now - batch[0].t_enqueue
             window = min(window, max(0.0, self.shed_budget_s - leader_wait))
         return window
+
+    def _forecast_gap_s(self, gap: float | None) -> float | None:
+        """Fold the forecast into the arrival-gap estimate (shared by
+        both twins — no batcher state touched): under a predicted ramp,
+        the tighter of the measured and predicted gaps; otherwise the
+        measured gap unchanged. Also drives the once-per-episode shape
+        pre-touch, since this runs per batch collection — not per
+        request — on both twins."""
+        f = self.forecaster
+        if f is None:
+            return gap
+        ramping = f.ramp_predicted()
+        self._note_ramp(ramping)
+        if not ramping:
+            return gap
+        predicted = f.expected_gap_s()
+        if predicted == float("inf"):
+            return gap
+        return predicted if gap is None else min(gap, predicted)
+
+    def _note_ramp(self, ramping: bool) -> None:
+        """Once per ramp EPISODE (the signal clearing re-arms it), kick
+        the engine's largest-shape pre-touch on a daemon thread — off
+        both the collection loop and the event loop, because the touch
+        blocks on a device dispatch."""
+        if not ramping:
+            self._prewarm_armed = True
+            return
+        if not self._prewarm_armed:
+            return
+        self._prewarm_armed = False
+        touch = getattr(self.engine, "prewarm_touch", None)
+        if touch is None:
+            return
+
+        def _touch() -> None:
+            try:
+                self.prewarm_total += touch()
+            except Exception:
+                logger.exception("predictive pre-touch failed (ignored)")
+
+        threading.Thread(
+            target=_touch, daemon=True, name="kmls-prewarm"
+        ).start()
 
     def _collect_loop(self) -> None:
         while True:
@@ -983,12 +1073,18 @@ class AsyncMicroBatcher:
         redispatch_max: int = 2,
         metrics=None,
         lag_monitor=None,
+        forecaster=None,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
         self.engine = engine
         self.max_size = max_size
         self.max_inflight = max(1, max_inflight)  # per replica
+        # predictive serving (ISSUE 17), mirroring MicroBatcher: None =
+        # every touchpoint is one is-None check (the zero-cost contract)
+        self.forecaster = forecaster
+        self.prewarm_total = 0
+        self._prewarm_armed = True
         self.window_s = window_ms / 1e3
         self.adaptive = adaptive
         self.window_min_s = min(window_min_ms / 1e3, self.window_s)
@@ -1029,6 +1125,11 @@ class AsyncMicroBatcher:
         self._arrivals: "collections.deque[float]" = collections.deque(maxlen=64)
         self._device_s_ewma: float | None = None
         self._flush_handle = None
+        # the loop this batcher is confined to, recorded on first submit:
+        # off-loop callers that must reach submit() — the app's post-delta
+        # predictive pre-fetch (ISSUE 17) — hop here via
+        # call_soon_threadsafe instead of calling in from their thread
+        self._loop = None
         # finish() blocks (device transfer, or the GIL-releasing native
         # call) — it must run off-loop; pool depth = aggregate pipeline
         # depth. The replica count isn't known until the engine's first
@@ -1119,12 +1220,22 @@ class AsyncMicroBatcher:
         )
 
     def utilization(self) -> float:
-        """Mirrors MicroBatcher.utilization (loop-confined, no locks)."""
+        """Mirrors MicroBatcher.utilization (loop-confined, no locks),
+        forecast lead term included — see the threaded twin's contract."""
+        reactive, led = self.utilization_parts()
+        return led
+
+    def utilization_parts(self) -> tuple[float, float]:
+        """Mirrors MicroBatcher.utilization_parts."""
         capacity = max(1, self._n_effective(self._n_replicas()))
         occupancy = self._total_inflight() / (self.max_inflight * capacity)
-        return max(
+        reactive = max(
             occupancy, self._admission.pressure(self.projected_queue_wait_s())
         )
+        f = self.forecaster
+        if f is None:
+            return reactive, reactive
+        return reactive, f.utilization_lead(reactive)
 
     def _arrival_gap_s(self) -> float | None:
         n = len(self._arrivals)
@@ -1132,10 +1243,17 @@ class AsyncMicroBatcher:
             return None
         return (self._arrivals[-1] - self._arrivals[0]) / (n - 1)
 
+    # the forecast fold and per-episode pre-touch are state-light and
+    # lock-free, so the twins SHARE one implementation instead of
+    # mirroring it (the pre-touch daemon thread is equally legal from
+    # the event loop — it never blocks the caller)
+    _forecast_gap_s = MicroBatcher._forecast_gap_s
+    _note_ramp = MicroBatcher._note_ramp
+
     def _busy_window_s(self, now: float) -> float:
         window = self.window_s
         if self.adaptive:
-            gap = self._arrival_gap_s()
+            gap = self._forecast_gap_s(self._arrival_gap_s())
             if gap is not None:
                 need = (self.max_size - len(self._pending)) * gap
                 window = min(self.window_s, max(self.window_min_s, need))
@@ -1152,8 +1270,15 @@ class AsyncMicroBatcher:
         import asyncio
 
         loop = asyncio.get_running_loop()
+        if self._loop is None:
+            self._loop = loop
         now = time.perf_counter()
         self._arrivals.append(now)
+        f = self.forecaster
+        if f is not None:
+            # mirrors the threaded twin: demand is observed before the
+            # shed decision, on the forecaster's own clock
+            f.observe(seeds)
         if self.eject_threshold > 0 and self._ejected:
             n = self._n_replicas()
             if self._n_healthy(n) == 0 and not self._probe_due(n, now):

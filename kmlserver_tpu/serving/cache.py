@@ -145,6 +145,14 @@ class RecommendCache:
             self.hits += 1
             return value
 
+    def contains(self, key: tuple) -> bool:
+        """Presence peek WITHOUT hit/miss accounting or LRU recency —
+        for the predictive pre-fetch (ISSUE 17), which must skip
+        still-cached keys without polluting the hit-ratio the bench and
+        the affinity measurement judge real traffic by."""
+        with self._lock:
+            return key in self._lru
+
     def put(self, key: tuple, value: tuple[list[str], str]) -> None:
         with self._lock:
             self._lru[key] = value

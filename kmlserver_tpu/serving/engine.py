@@ -1210,6 +1210,62 @@ class RecommendEngine:
                     )
                     bundle.emb_warmed_shapes.add((batch, length))
 
+    def prewarm_touch(self) -> int:
+        """Predictive shape pre-touch (ISSUE 17, actuator a): re-dispatch
+        the LARGEST warmed (batch, length) bucket once per device replica
+        on the live bundle, so the big-batch executables and every
+        replica's dispatch path are hot before a predicted ramp sends
+        real traffic through them. Publish-time warmup already compiled
+        every bucket — this touch pays one dispatch per replica, never a
+        compile (the shape is in ``warmed_shapes``). Best-effort and off
+        the request path: the batcher runs it on a daemon thread once
+        per ramp episode; failures are logged and ignored (a missed
+        touch just means the ramp is served as reactively as before).
+        Mesh bundles are skipped (their partial-fetch warmup is gang-
+        coordinated at publish; a solo re-touch would not exercise the
+        peer path). → shapes touched."""
+        replicas = self.replicas
+        if not replicas:
+            return 0
+        batch = self._batch_buckets()[-1]
+        length = self._len_buckets()[-1]
+        touched = 0
+        for bundle in replicas:
+            warm_rules = (
+                bundle.host_rule_ids is None and bundle.layout != "mesh"
+            )
+            warm_emb = bundle.emb_factors is not None
+            if not warm_rules and not warm_emb:
+                continue
+            try:
+                seeds = jnp.full((batch, length), -1, dtype=jnp.int32)
+                if warm_rules:
+                    target = bundle.seed_sharding or bundle.device
+                    rule_seeds = (
+                        jax.device_put(seeds, target)
+                        if target is not None else seeds
+                    )
+                    kernel = bundle.shard_kernel or self._resolve_kernel()
+                    jax.block_until_ready(
+                        kernel(bundle.rule_ids, bundle.rule_confs, rule_seeds)
+                    )
+                    touched += 1
+                if warm_emb:
+                    emb_seeds = (
+                        jax.device_put(seeds, bundle.device)
+                        if bundle.device is not None else seeds
+                    )
+                    jax.block_until_ready(
+                        embed_topk(
+                            bundle.emb_factors, emb_seeds,
+                            k_best=self.cfg.k_best_tracks,
+                        )
+                    )
+                    touched += 1
+            except Exception:
+                logger.exception("predictive pre-touch failed (ignored)")
+        return touched
+
     def _read_measured_blend_weight(self) -> float | None:
         """The quality loop's published blend optimum (ISSUE 14), or
         None — measured mode off, no report on the PVC, or a report

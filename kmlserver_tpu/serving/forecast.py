@@ -1,0 +1,289 @@
+"""Online traffic forecasting — learn the ramp before the queue does.
+
+Every overload mechanism in the serving stack is reactive: the admission
+ladder (PR 8) escalates only after measured queue waits rise,
+``kmls_utilization`` tells the HPA about a burst only once it has
+landed, and the fleet cache warms a hot seed only after its first miss.
+Ramp and flash-crowd shapes therefore pay a p99/shed penalty in exactly
+the onset window the bench's ``loadshape`` bracket measures — the
+queue has to GROW before anything widens, scales, or warms.
+
+:class:`TrafficForecaster` closes that gap with the cheapest model that
+can see a ramp coming: Holt double-exponential smoothing (level +
+trend) over fixed arrival-count windows, plus a decayed per-seed-set
+frequency table for the request MIX. Fed one ``observe()`` per admitted
+request from the batcher's submit path, it answers three questions:
+
+- ``predicted_rate()`` — arrivals/s a short horizon
+  (``KMLS_FORECAST_HORIZON_S``) ahead: level + trend·horizon, floored
+  at zero. Predictions roll the window clock forward, so the forecast
+  DECAYS in real time after a burst ends instead of freezing at the
+  burst's last slope.
+- ``growth_ratio()`` — predicted over current rate, the dimensionless
+  "is a ramp coming" signal the actuators key on (1.0 = steady state).
+- ``hot_seed_sets()`` — the top-N seed sets by decayed frequency, the
+  pre-fetch candidates for the owner-targeted cache re-materialization
+  after a delta apply.
+
+The three actuators and their safety contract (ISSUE 17): (a) the
+batcher sizes its adaptive collection window from the PREDICTED arrival
+gap when a ramp is forecast, and pre-touches the engine's largest shape
+bucket once per ramp episode; (b) ``batcher.utilization()`` gains
+:meth:`utilization_lead` — the reactive value scaled by the growth
+ratio, clamped to ``[reactive, util_cap]`` so the forecast can raise
+the HPA signal but NEVER lower it and never exceed the cap; (c) the app
+re-materializes predicted-hot, ring-owned seed sets through the normal
+singleflight path after a selective invalidation. A wrong forecast can
+only over-provision (earlier scale-out, a wasted pre-touch, a wasted
+pre-fetch) — the admission ladder's shed/degrade decisions never read
+the forecast, so shedding can never start EARLIER than reactive.
+
+Zero-cost proof (the PR 11 cost-model pattern): with ``KMLS_FORECAST=0``
+the app leaves the forecaster hook ``None`` and every call site is one
+is-None check, so the module-level ``OBSERVATIONS_TOTAL`` counter below
+must stay 0 under any traffic — tests pin it the way the cost model's
+observation counter is pinned.
+
+The clock is injectable (``clock=time.monotonic``, the FleetRouter
+precedent) so tests drive ramp/sine schedules deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# the zero-cost proof counter: incremented by every observe() in the
+# process. The forecaster is only ever reached through a
+# `forecaster is not None` check, so with KMLS_FORECAST=0 this must
+# never move — a moved counter means a call site dodged the gate.
+OBSERVATIONS_TOTAL = 0
+
+# per-window decay applied to the request-mix frequency table: ~0.9 per
+# window keeps a seed set "hot" for a few dozen windows after its last
+# appearance — long enough to survive a delta apply, short enough that
+# yesterday's flash crowd doesn't get pre-fetched today
+_MIX_DECAY = 0.9
+_MIX_FLOOR = 0.05
+
+
+class TrafficForecaster:
+    """Per-window arrival-rate + request-mix EWMAs with a trend term.
+
+    Holt's linear (double-exponential) smoothing over windows of
+    ``window_s`` seconds: when a window closes, its arrival count
+    becomes a rate sample ``y``; ``level`` tracks the smoothed rate and
+    ``trend`` its slope (arrivals/s per second). Windows with no
+    arrivals still close — silence folds in as zero-rate samples when
+    the next observation or prediction rolls the clock, so the model
+    decays toward reality instead of freezing.
+
+    Thread-safe: ``observe()`` runs on request threads under the
+    threaded batcher and on the event loop under the async one; all
+    state mutates under one short lock (the roll is O(1) amortized, the
+    mix decay O(table) once per window).
+    """
+
+    def __init__(
+        self,
+        *,
+        horizon_s: float = 2.0,
+        window_s: float = 0.5,
+        alpha: float = 0.35,
+        trend_alpha: float = 0.3,
+        util_cap: float = 1.0,
+        ramp_ratio: float = 1.2,
+        hot_top_n: int = 8,
+        mix_capacity: int = 512,
+        clock=time.monotonic,
+    ):
+        self.horizon_s = max(0.0, float(horizon_s))
+        self.window_s = max(1e-3, float(window_s))
+        self.alpha = min(1.0, max(0.0, float(alpha)))
+        self.trend_alpha = min(1.0, max(0.0, float(trend_alpha)))
+        self.util_cap = max(0.0, float(util_cap))
+        self.ramp_ratio = max(1.0, float(ramp_ratio))
+        self.hot_top_n = max(1, int(hot_top_n))
+        self.mix_capacity = max(1, int(mix_capacity))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = 0.0   # smoothed arrivals/s
+        self._trend = 0.0   # arrivals/s per second
+        self._windows = 0   # closed windows folded into the model
+        self._win_start: float | None = None
+        self._win_count = 0
+        # canonical seed key -> [decayed weight, seed list]; bounded by
+        # mix_capacity (lowest weights evicted on overflow)
+        self._mix: dict[str, list] = {}
+        self.observations = 0
+
+    # ---------- feeding ----------
+
+    def observe(self, seeds: list[str] | None = None) -> None:
+        """Record one admitted request (and optionally its seed set).
+        Called from the batcher's submit path behind the is-None gate —
+        this is the ONLY entry point that counts toward the zero-cost
+        proof counter."""
+        global OBSERVATIONS_TOTAL
+        OBSERVATIONS_TOTAL += 1
+        now = self._clock()
+        with self._lock:
+            self.observations += 1
+            if self._win_start is None:
+                self._win_start = now
+            else:
+                self._roll_locked(now)
+            self._win_count += 1
+            if seeds:
+                key = "\x1f".join(sorted(seeds))
+                entry = self._mix.get(key)
+                if entry is None:
+                    if len(self._mix) >= self.mix_capacity:
+                        coldest = min(
+                            self._mix, key=lambda k: self._mix[k][0]
+                        )
+                        del self._mix[coldest]
+                    self._mix[key] = [1.0, list(seeds)]
+                else:
+                    entry[0] += 1.0
+
+    # ---------- model ----------
+
+    def _roll_locked(self, now: float) -> None:
+        """Fold every window that has fully elapsed into level/trend.
+        The first closed window carries the counted arrivals; any
+        further elapsed windows were silent and fold in as zero-rate
+        samples, which is what makes the forecast decay after a burst."""
+        if self._win_start is None:
+            return
+        elapsed = int((now - self._win_start) / self.window_s)
+        if elapsed <= 0:
+            return
+        for i in range(elapsed):
+            rate = (self._win_count if i == 0 else 0) / self.window_s
+            if self._windows == 0:
+                self._level = rate
+                self._trend = 0.0
+            else:
+                prev = self._level
+                self._level = self.alpha * rate + (1.0 - self.alpha) * (
+                    self._level + self._trend * self.window_s
+                )
+                # rates are non-negative: without this floor a string of
+                # silent windows drives the level negative and the
+                # -alpha·level term then flips the trend positive — a
+                # damped oscillation around zero that makes a DEAD burst
+                # forecast a comeback
+                if self._level < 0.0:
+                    self._level = 0.0
+                self._trend = (
+                    self.trend_alpha * (self._level - prev) / self.window_s
+                    + (1.0 - self.trend_alpha) * self._trend
+                )
+            self._windows += 1
+            if self._mix:
+                dead = []
+                for key, entry in self._mix.items():
+                    entry[0] *= _MIX_DECAY
+                    if entry[0] < _MIX_FLOOR:
+                        dead.append(key)
+                for key in dead:
+                    del self._mix[key]
+        self._win_start += elapsed * self.window_s
+        self._win_count = 0
+
+    # ---------- predictions ----------
+
+    def current_rate(self, now: float | None = None) -> float:
+        """The smoothed CURRENT arrival rate (arrivals/s), after rolling
+        the window clock to ``now``."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._roll_locked(now)
+            return max(0.0, self._level)
+
+    def predicted_rate(self, now: float | None = None) -> float:
+        """Arrivals/s forecast ``horizon_s`` ahead: level +
+        trend·horizon, floored at zero (a decaying burst can predict
+        below current, never below nothing)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._roll_locked(now)
+            return max(0.0, self._level + self._trend * self.horizon_s)
+
+    def growth_ratio(self, now: float | None = None) -> float:
+        """predicted_rate / current_rate — 1.0 at steady state (or with
+        no signal yet), >1 when a ramp is forecast, <1 when decay is."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._roll_locked(now)
+            if self._level <= 1e-9 or self._windows < 2:
+                return 1.0
+            predicted = max(
+                0.0, self._level + self._trend * self.horizon_s
+            )
+            return predicted / self._level
+
+    def ramp_predicted(self, now: float | None = None) -> bool:
+        """True when the forecast growth ratio clears ``ramp_ratio`` —
+        the arm signal for the pre-warm/pre-widen actuators."""
+        return self.growth_ratio(now) >= self.ramp_ratio
+
+    def expected_gap_s(self, now: float | None = None) -> float:
+        """Mean inter-arrival gap implied by the horizon forecast — what
+        the batcher sizes its collection window from when a ramp is
+        predicted (the trailing measured gap lags the ramp by
+        construction)."""
+        rate = self.predicted_rate(now)
+        return (1.0 / rate) if rate > 1e-9 else float("inf")
+
+    def utilization_lead(
+        self, reactive: float, now: float | None = None
+    ) -> float:
+        """The bounded HPA-lead term (actuator b): the reactive
+        utilization scaled by the forecast growth ratio, clamped to
+        ``[reactive, max(reactive, util_cap)]``. Monotone contract: the
+        returned value is NEVER below ``reactive`` (the forecast can
+        only add lead, never mask measured load) and the forecast
+        contribution alone never exceeds ``util_cap`` (only measured
+        overload may report past the cap)."""
+        ratio = self.growth_ratio(now)
+        if ratio <= 1.0:
+            return reactive
+        return max(reactive, min(self.util_cap, reactive * ratio))
+
+    def hot_seed_sets(self, top_n: int | None = None) -> list[list[str]]:
+        """The predicted-hot seed sets, hottest first — the candidate
+        list for the owner-targeted post-delta cache pre-fetch
+        (actuator c)."""
+        n = self.hot_top_n if top_n is None else max(0, int(top_n))
+        with self._lock:
+            ranked = sorted(
+                self._mix.values(), key=lambda e: e[0], reverse=True
+            )
+            return [list(entry[1]) for entry in ranked[:n]]
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """One consistent read of the exposition values (rate,
+        prediction, ratio, observation count) for /metrics rendering."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._roll_locked(now)
+            level = max(0.0, self._level)
+            predicted = max(
+                0.0, self._level + self._trend * self.horizon_s
+            )
+            if self._level <= 1e-9 or self._windows < 2:
+                ratio = 1.0
+            else:
+                ratio = predicted / self._level
+            return {
+                "rate": level,
+                "predicted_rate": predicted,
+                "ratio": ratio,
+                "observations": self.observations,
+            }

@@ -158,6 +158,21 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_device_budget_bytes": "gauge:serving",
     "kmls_device_headroom_bytes": "gauge:serving",
     "kmls_publish_watermark_bytes": "gauge:serving",
+    # --- serving: predictive serving (ISSUE 17, serving/forecast.py) ---
+    # online traffic forecaster: smoothed current arrival rate, the
+    # horizon prediction, their ratio (the ramp signal), the zero-cost
+    # proof counter (0 with KMLS_FORECAST=0 — test-pinned, costmodel
+    # style), the actuator counters (owner-targeted cache pre-fetches
+    # led, shape-bucket pre-touches dispatched), and the bounded
+    # forecast term actually folded into kmls_utilization — rendered
+    # through the robustness dict only while the forecaster is armed
+    "kmls_forecast_rate": "gauge:serving",
+    "kmls_forecast_predicted_rate": "gauge:serving",
+    "kmls_forecast_ratio": "gauge:serving",
+    "kmls_forecast_observations_total": "counter:serving",
+    "kmls_forecast_prefetch_total": "counter:serving",
+    "kmls_forecast_prewarm_total": "counter:serving",
+    "kmls_utilization_forecast": "gauge:serving",
     # --- serving: SLO burn rates (ISSUE 12, observability/slo.py) ---
     # multi-window budget-consumption rates (slo ∈ latency_p99/
     # availability/quality, window ∈ fast/slow); observability only —
@@ -200,7 +215,11 @@ METRIC_REGISTRY: dict[str, str] = {
 # The autoscaling signal (ISSUE 8): the gauge kubernetes/hpa.yaml scales
 # the API fleet on, derived by the batcher from its queue/device latency
 # attribution (max of pipeline occupancy and admission queue pressure;
-# 1.0 = at capacity, shedding begins above it). The app exposes it
+# 1.0 = at capacity, shedding begins above it). With KMLS_FORECAST=1 a
+# bounded predictive lead term joins the max (ISSUE 17): the reactive
+# value scaled by the forecast growth ratio, clamped so it can raise
+# the signal ahead of a ramp but never lower it and never exceed
+# KMLS_FORECAST_UTIL_CAP on prediction alone. The app exposes it
 # through the robustness-state dict (serving/app.py _robustness_state,
 # key "utilization" → rendered with the kmls_ prefix below);
 # tests/test_deploy.py pins the HPA manifest to THIS name so the metric

@@ -93,6 +93,14 @@ class ReplayReport:
     # per-replica device dispatch counters (in-process target only): the
     # evidence the data-parallel dispatcher spread work across devices
     per_device_dispatch: list[int] | None = None
+    # onset/steady split (ISSUE 17): p99 over requests that ARRIVED in
+    # the schedule's first 40% vs its last 40%. On ramp/sine shapes the
+    # onset window is where every reactive mechanism is still measuring
+    # its way up the rate curve — exactly where predictive serving can
+    # help and where a pooled p99 averages the difference away. Pooled
+    # drivers that don't keep arrival-indexed latencies leave these None.
+    onset_p99_ms: float | None = None
+    steady_p99_ms: float | None = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -191,6 +199,29 @@ def attach_attribution(report: "ReplayReport", metrics) -> "ReplayReport":
     report.device_p99_ms = dv99 * 1e3
     report.e2e_p999_ms = e2e999 * 1e3
     return report
+
+
+def onset_steady_p99(
+    points: list[tuple[float, float]],
+    span_s: float,
+    *,
+    onset_frac: float = 0.4,
+    steady_frac: float = 0.6,
+) -> tuple[float | None, float | None]:
+    """Split ``(relative_arrival_s, latency_ms)`` completion points by
+    ARRIVAL time into the schedule's onset window (first ``onset_frac``
+    of ``span_s``) and steady window (last ``1 - steady_frac``) and
+    return each window's p99 (None for an empty window). Splitting by
+    arrival — not completion — keeps a request that arrived at the cliff
+    but finished late attributed to the cliff."""
+    if not points or span_s <= 0:
+        return None, None
+    onset = sorted(d for t, d in points if t <= onset_frac * span_s)
+    steady = sorted(d for t, d in points if t >= steady_frac * span_s)
+    return (
+        _percentile(onset, 0.99) if onset else None,
+        _percentile(steady, 0.99) if steady else None,
+    )
 
 
 def _percentile(sorted_ms: list[float], q: float) -> float:
@@ -504,6 +535,9 @@ def replay_pooled(
 
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max_queue)
     lat_ms: list[float] = []
+    # (relative arrival s, latency ms) per completion — the onset/steady
+    # split's input (ISSUE 17); arrival_abs is start-anchored below
+    lat_points: list[tuple[float, float]] = []
     lat_cached: list[float] = []
     lat_uncached: list[float] = []
     by_source: dict[str, int] = {}
@@ -540,6 +574,9 @@ def replay_pooled(
                     dt_ms = (time.perf_counter() - arrival_abs) * 1e3
                     with lock:
                         lat_ms.append(dt_ms)
+                        # `start` is bound before any item is enqueued,
+                        # so the dereference here can never race it
+                        lat_points.append((arrival_abs - start, dt_ms))
                         if cached is not None:
                             (lat_cached if cached else lat_uncached).append(
                                 dt_ms
@@ -578,7 +615,11 @@ def replay_pooled(
         sources = dict(by_source)
         n_errors = errors
         split = _cache_split_fields(lat_cached, lat_uncached, len(lat_ms))
+        points = list(lat_points)
     n_ok = len(lat_sorted)
+    onset_p99, steady_p99 = onset_steady_p99(
+        points, float(arrival[-1]) if len(arrival) else 0.0
+    )
     return ReplayReport(
         target_qps=qps,
         offered_qps=(n_ok + n_errors) / duration if duration > 0 else 0.0,
@@ -590,6 +631,8 @@ def replay_pooled(
         p95_ms=_percentile(lat_sorted, 0.95),
         p99_ms=_percentile(lat_sorted, 0.99),
         by_source=sources,
+        onset_p99_ms=onset_p99,
+        steady_p99_ms=steady_p99,
         **split,
     )
 

@@ -380,6 +380,71 @@ class TestTpuSuiteWiring:
             "full_s": 1.445, "interrupted_s": 1.298, "resume_s": 0.129,
             "saved_pct": 91.068, "identical": True, "platform": "cpu",
         },
+        # NB: listed BEFORE "loadshape" — the fakes match phase names by
+        # startswith() in insertion order, and "loadshape_pred" shares
+        # the shorter prefix
+        "loadshape_pred": {
+            "qps": 1000.0, "requests": 4000, "platform": "cpu",
+            "shapes": {
+                "ramp": {
+                    "reactive": {
+                        "p50_ms": 1.1, "p99_ms": 9.4,
+                        "onset_p99_ms": 14.2, "steady_p99_ms": 6.1,
+                        "errors": 0, "http_5xx": 0, "shed": 12,
+                        "degraded": 30, "ok": 3958,
+                        "achieved_qps": 998.0,
+                        "forecast_disabled_obs_delta": 0,
+                    },
+                    "predictive": {
+                        "p50_ms": 1.0, "p99_ms": 7.1,
+                        "onset_p99_ms": 8.9, "steady_p99_ms": 6.0,
+                        "errors": 0, "http_5xx": 0, "shed": 4,
+                        "degraded": 11, "ok": 3985,
+                        "achieved_qps": 999.0,
+                        "forecast_observations": 4000,
+                        "prewarm_total": 1,
+                    },
+                },
+                "sine": {
+                    "reactive": {
+                        "p50_ms": 1.0, "p99_ms": 8.2,
+                        "onset_p99_ms": 8.0, "steady_p99_ms": 8.3,
+                        "errors": 0, "http_5xx": 0, "shed": 6,
+                        "degraded": 14, "ok": 3980,
+                        "achieved_qps": 997.0,
+                        "forecast_disabled_obs_delta": 0,
+                    },
+                    "predictive": {
+                        "p50_ms": 1.0, "p99_ms": 6.9,
+                        "onset_p99_ms": 6.8, "steady_p99_ms": 7.0,
+                        "errors": 0, "http_5xx": 0, "shed": 2,
+                        "degraded": 5, "ok": 3993,
+                        "achieved_qps": 998.0,
+                        "forecast_observations": 4000,
+                        "prewarm_total": 2,
+                    },
+                },
+                "constant": {
+                    "reactive": {
+                        "p50_ms": 0.9, "p99_ms": 4.1,
+                        "onset_p99_ms": 4.0, "steady_p99_ms": 4.2,
+                        "errors": 0, "http_5xx": 0, "shed": 0,
+                        "degraded": 0, "ok": 4000,
+                        "achieved_qps": 1000.0,
+                        "forecast_disabled_obs_delta": 0,
+                    },
+                    "predictive": {
+                        "p50_ms": 0.9, "p99_ms": 4.2,
+                        "onset_p99_ms": 4.1, "steady_p99_ms": 4.2,
+                        "errors": 0, "http_5xx": 0, "shed": 0,
+                        "degraded": 0, "ok": 4000,
+                        "achieved_qps": 1000.0,
+                        "forecast_observations": 4000,
+                        "prewarm_total": 0,
+                    },
+                },
+            },
+        },
         "loadshape": {
             "qps": 1000.0, "burst_factor": 10.0, "zipf_s": 1.1,
             "requests": 8000,
@@ -1043,7 +1108,8 @@ class TestBenchStateResume:
             "mining_tpu", "serving_tpu", "replay_tpu", "popcount_tpu",
             "config4_tpu", "scale_tpu", "sweep_tpu", "popcount_tune_tpu",
             "replay_cpu_supp", "replay10k_cpu", "chaos_cpu",
-            "loadshape_cpu", "mine_resume_cpu", "als_hybrid_cpu",
+            "loadshape_cpu", "loadshape_pred_cpu", "mine_resume_cpu",
+            "als_hybrid_cpu",
             "confserve_cpu", "scale_sparse_cpu", "quality_cpu",
             "meshserve_cpu",
         }
@@ -1342,6 +1408,79 @@ class TestCompactLine:
         assert parsed["loadshape_p99_ms"] == 4.745
         assert parsed["loadshape_http_5xx"] == 0
         assert parsed["loadshape_flip_epoch_moved"] == 1
+
+    def test_record_loadshape_pred_emits_bounded_artifact(self, monkeypatch):
+        """The ISSUE-17 predictive A/B bracket's judged keys (ramp/sine
+        paired p99 + onset split, zero 5xx, observation evidence) must
+        land in the compact line without regressing the ≤1,800 budget."""
+
+        def leg(p99, onset, shed=0, degraded=0, predictive=False):
+            out = {
+                "p50_ms": 0.7, "p99_ms": p99, "onset_p99_ms": onset,
+                "steady_p99_ms": p99, "errors": 0, "http_5xx": 0,
+                "shed": shed, "degraded": degraded, "ok": 8000,
+                "achieved_qps": 1000.0,
+            }
+            if predictive:
+                out["forecast_observations"] = 8000
+                out["prewarm_total"] = 2
+            else:
+                out["forecast_disabled_obs_delta"] = 0
+            return out
+
+        canned = {
+            "qps": 1000.0, "requests": 8000, "platform": "cpu",
+            "shapes": {
+                "ramp": {
+                    "reactive": leg(9.4, 14.2, shed=12, degraded=30),
+                    "predictive": leg(7.1, 8.9, shed=4, degraded=11,
+                                      predictive=True),
+                },
+                "sine": {
+                    "reactive": leg(6.2, 7.0, degraded=8),
+                    "predictive": leg(5.8, 6.1, degraded=5,
+                                      predictive=True),
+                },
+                "constant": {
+                    "reactive": leg(4.7, 4.8),
+                    "predictive": leg(4.8, 4.9, predictive=True),
+                },
+            },
+        }
+        monkeypatch.setattr(
+            bench, "_run_phase", lambda *a, **k: dict(canned)
+        )
+        result = {}
+        bench._record_loadshape_pred(result)
+        assert result["loadshape_pred_ramp_react_p99_ms"] == 9.4
+        assert result["loadshape_pred_ramp_pred_p99_ms"] == 7.1
+        assert result["loadshape_pred_ramp_pred_onset_p99_ms"] == 8.9
+        assert result["loadshape_pred_sine_pred_p99_ms"] == 5.8
+        assert result["loadshape_pred_http_5xx"] == 0
+        assert result["loadshape_pred_errors"] == 0
+        # the zero-cost proof rides the sidecar: the disabled legs'
+        # forecaster observation deltas, asserted 0 inside the phase
+        assert result["loadshape_pred_ramp_react_obs_delta"] == 0
+        assert result["loadshape_pred_constant_react_obs_delta"] == 0
+        assert result["loadshape_pred_ramp_obs"] == 8000
+        assert result["loadshape_pred_ramp_pred_shed"] == 4
+        for key in ("loadshape_pred_ramp_react_p99_ms",
+                    "loadshape_pred_ramp_pred_p99_ms",
+                    "loadshape_pred_ramp_react_onset_p99_ms",
+                    "loadshape_pred_ramp_pred_onset_p99_ms",
+                    "loadshape_pred_sine_react_p99_ms",
+                    "loadshape_pred_sine_pred_p99_ms",
+                    "loadshape_pred_http_5xx", "loadshape_pred_errors",
+                    "loadshape_pred_ramp_obs"):
+            assert key in bench._COMPACT_PRIORITY, key
+        full = {"metric": "m", "value": 1.0, "unit": "s",
+                "vs_baseline": 20.0, "platform": "cpu",
+                **result, **self._bloated()}
+        line = bench._compact_line(full)
+        assert len(line) <= bench.COMPACT_LINE_LIMIT
+        parsed = json.loads(line)
+        assert parsed["loadshape_pred_ramp_pred_p99_ms"] == 7.1
+        assert parsed["loadshape_pred_http_5xx"] == 0
 
     def test_record_traceoverhead_emits_bounded_artifact(self, monkeypatch):
         """The ISSUE-9 tracing-overhead bracket's judged keys (sampled

@@ -319,6 +319,37 @@ def test_utilization_signal_rendered_at_metrics():
     assert f"\n{UTILIZATION_SERIES} 0" in text
 
 
+def test_hpa_manifest_documents_forecast_bound():
+    """Predictive serving (ISSUE 17): the HPA manifest's doc block must
+    describe the bounded forecast term the exported gauge can carry —
+    the clamp knob and the added-lead gauge are named in the manifest so
+    an operator reading hpa.yaml learns the signal's full contract."""
+    with open(os.path.join(REPO, "kubernetes", "hpa.yaml")) as fh:
+        raw = fh.read()
+    assert "KMLS_FORECAST_UTIL_CAP" in raw
+    assert "kmls_utilization_forecast" in raw
+
+
+def test_utilization_forecast_rendered_when_forecaster_armed():
+    """The forecast side of the HPA loop: with KMLS_FORECAST on, the
+    same /metrics page renders the added-lead gauge (0 idle — prediction
+    adds nothing at steady state) and the observation counter, so the
+    adapter/dashboard contract holds from request one."""
+    import tempfile
+
+    from kmlserver_tpu.config import ServingConfig
+    from kmlserver_tpu.serving.app import RecommendApp
+    from kmlserver_tpu.serving.metrics import UTILIZATION_SERIES
+
+    with tempfile.TemporaryDirectory() as base:
+        app = RecommendApp(ServingConfig(base_dir=base, forecast_enabled=True))
+        text = app.handle("GET", "/metrics", None)[2].decode()
+    assert f"# TYPE {UTILIZATION_SERIES} gauge" in text
+    assert "# TYPE kmls_utilization_forecast gauge" in text
+    assert "\nkmls_utilization_forecast 0" in text
+    assert "\nkmls_forecast_observations_total 0" in text
+
+
 def test_service_nodeport():
     svc = _load("service.yaml")
     port = svc["spec"]["ports"][0]

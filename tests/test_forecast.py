@@ -1,0 +1,394 @@
+"""Predictive serving (ISSUE 17): the traffic forecaster and its three
+actuators.
+
+The model tests drive :class:`TrafficForecaster` with the SAME shaped
+arrival schedules the bench replays (``replay.shaped_arrivals``) under a
+fake injected clock, so convergence claims are about the exact traffic
+the feature exists for. The contract tests pin the safety floor: the
+utilization lead is clamped to ``[reactive, util_cap]``, the batch-window
+fold can only shrink the gap estimate, the pre-warm fires once per ramp
+episode, and — the zero-cost proof — with ``KMLS_FORECAST=0`` real
+traffic never moves the module observation counter (the PR 11 cost-model
+pattern)."""
+
+import dataclasses
+import json
+import time
+from collections import Counter
+
+import pytest
+
+from kmlserver_tpu.config import ServingConfig
+from kmlserver_tpu.serving import forecast as forecast_mod
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.batcher import MicroBatcher
+from kmlserver_tpu.serving.forecast import TrafficForecaster
+from kmlserver_tpu.serving.replay import sample_seed_sets, shaped_arrivals
+
+from .test_batching import _rule_seeds
+from .test_serving import mined_pvc  # noqa: F401  (fixture re-export)
+
+
+class FakeClock:
+    """Deterministic injectable clock (the FleetRouter test pattern)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _feed(fc, clock, arrivals, payloads=None):
+    for i, t in enumerate(arrivals):
+        clock.t = float(t)
+        fc.observe(payloads[i] if payloads is not None else None)
+
+
+def _post(app, songs):
+    return app.handle(
+        "POST", "/api/recommend/", json.dumps({"songs": songs}).encode()
+    )
+
+
+# ---------------------------------------------------------------------------
+# model convergence on the bench's own traffic shapes
+# ---------------------------------------------------------------------------
+
+
+class TestForecastModel:
+    def test_ramp_schedule_predicts_growth_early(self):
+        """On the autoscaler's approach ramp (0.1×→2× qps) the forecast
+        must call the ramp while it is still building — predicted rate
+        above current, growth ratio clearing the default arm threshold —
+        and track the rate itself to the right order of magnitude."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock)
+        arrivals = shaped_arrivals(4000, 200.0, "ramp")
+        quarter = len(arrivals) // 4
+        _feed(fc, clock, arrivals[:quarter])
+        # mid-ramp: trend dominates a still-small level
+        assert fc.predicted_rate() > fc.current_rate() > 0.0
+        assert fc.growth_ratio() > 1.2
+        assert fc.ramp_predicted()
+        _feed(fc, clock, arrivals[quarter:])
+        # end of ramp: instantaneous rate ≈ 2×200 = 400/s; the smoothed
+        # level must be in that neighborhood, not stuck at the onset rate
+        end_rate = fc.current_rate()
+        assert 200.0 < end_rate < 600.0
+        # still climbing at the end → forecast stays at/above current
+        assert fc.predicted_rate() >= end_rate * 0.9
+
+    def test_sine_schedule_tracks_both_directions(self):
+        """Diurnal swing: the ratio must call growth on the upswing and
+        decay (<1) on the downswing — a trend-free EWMA can do neither."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock)
+        arrivals = shaped_arrivals(6000, 200.0, "sine")
+        ratios = []
+        step = len(arrivals) // 20
+        for i in range(0, len(arrivals), step):
+            _feed(fc, clock, arrivals[i:i + step])
+            ratios.append(fc.growth_ratio())
+            rate = fc.current_rate()
+            assert 0.0 <= rate < 3.0 * 200.0
+        assert max(ratios) > 1.05   # upswing seen
+        assert min(ratios) < 0.95   # downswing seen
+
+    def test_forecast_decays_after_burst_ends(self):
+        """Horizon decay: a burst that STOPPED must leave the forecast
+        within a few silent windows — silence folds in as zero-rate
+        samples when the clock rolls, so the prediction dies in real
+        time instead of freezing at the burst's last slope."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock)
+        # 2 s of steady 500/s
+        _feed(fc, clock, [i / 500.0 for i in range(1000)])
+        peak = fc.current_rate()
+        assert peak > 100.0
+        # 10 silent windows (5 s): no observe() calls, only the clock
+        clock.t += 10 * fc.window_s
+        after_10 = fc.predicted_rate()
+        assert after_10 < 0.2 * peak
+        clock.t += 10 * fc.window_s
+        after_20 = fc.predicted_rate()
+        assert after_20 <= after_10
+        # the floor: a decaying forecast never predicts below zero
+        assert after_20 >= 0.0
+
+    def test_hot_seed_sets_track_zipf_head(self):
+        """The request-mix table under the bench's Zipf 1.1 draw: the
+        pre-fetch candidates (decayed frequency) must be the actual head
+        of the distribution, and the returned lists must be copies of
+        the observed seed sets."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock)
+        vocab = [f"track_{i}" for i in range(40)]
+        payloads = sample_seed_sets(
+            vocab, 3000, rng_seed=7, unknown_fraction=0.0,
+            zipf_s=1.1, zipf_pool=64,
+        )
+        arrivals = [i / 500.0 for i in range(len(payloads))]
+        _feed(fc, clock, arrivals, payloads)
+        counts = Counter(
+            "\x1f".join(sorted(p)) for p in payloads
+        )
+        actual_top = [k for k, _ in counts.most_common(10)]
+        hot = fc.hot_seed_sets(4)
+        assert 1 <= len(hot) <= 4
+        hot_keys = ["\x1f".join(sorted(s)) for s in hot]
+        # the hottest prediction is in the true head, and every
+        # candidate is at least top-10 material
+        assert hot_keys[0] in actual_top[:3]
+        assert all(k in actual_top for k in hot_keys)
+
+    def test_mix_table_bounded_by_capacity(self):
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock, mix_capacity=16)
+        for i in range(200):
+            clock.t = i * 1e-3
+            fc.observe([f"s{i}"])
+        assert len(fc._mix) <= 16
+
+    def test_quiet_start_reports_steady_state(self):
+        """Before any evidence the forecaster must claim steady state —
+        ratio 1.0, no ramp — so an idle pod's actuators stay cold."""
+        fc = TrafficForecaster(clock=FakeClock())
+        assert fc.growth_ratio() == 1.0
+        assert not fc.ramp_predicted()
+        assert fc.expected_gap_s() == float("inf")
+        assert fc.hot_seed_sets() == []
+
+
+# ---------------------------------------------------------------------------
+# actuator contracts: bounded lead, shrink-only gap, one-shot pre-warm
+# ---------------------------------------------------------------------------
+
+
+class _StubForecaster:
+    def __init__(self, ramping=False, gap=float("inf")):
+        self.ramping = ramping
+        self.gap = gap
+
+    def ramp_predicted(self, now=None):
+        return self.ramping
+
+    def expected_gap_s(self, now=None):
+        return self.gap
+
+
+class _GapHost:
+    """The minimal state surface MicroBatcher._forecast_gap_s /
+    _note_ramp touch — the helpers are deliberately batcher-state-free
+    (shared by both twins), so the contract is testable without a
+    batcher."""
+
+    def __init__(self, forecaster, engine=None):
+        self.forecaster = forecaster
+        self.engine = engine if engine is not None else object()
+        self.prewarm_total = 0
+        self._prewarm_armed = True
+
+    _note_ramp = MicroBatcher._note_ramp
+
+
+class TestActuatorContracts:
+    def test_utilization_lead_never_below_reactive(self):
+        """The HPA safety floor: whatever the forecast says, the exported
+        signal is ≥ the measured reactive value — a forecast can add
+        lead, never mask load."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock)
+        # force a strong predicted ramp
+        _feed(fc, clock, shaped_arrivals(1500, 200.0, "ramp")[:400])
+        assert fc.growth_ratio() > 1.0
+        for reactive in (0.0, 0.1, 0.5, 0.9, 1.0, 1.3):
+            led = fc.utilization_lead(reactive)
+            assert led >= reactive
+
+    def test_utilization_lead_capped_by_util_cap(self):
+        """Prediction alone never reports past the cap; only measured
+        overload (reactive > cap) may — and then it passes through
+        untouched."""
+        clock = FakeClock()
+        fc = TrafficForecaster(clock=clock, util_cap=1.0)
+        _feed(fc, clock, shaped_arrivals(1500, 200.0, "ramp")[:400])
+        assert fc.growth_ratio() > 1.2
+        assert fc.utilization_lead(0.9) <= 1.0
+        # measured overload passes through even above the cap
+        assert fc.utilization_lead(1.3) == 1.3
+
+    def test_utilization_lead_identity_at_steady_state(self):
+        fc = TrafficForecaster(clock=FakeClock())
+        for reactive in (0.0, 0.4, 1.0):
+            assert fc.utilization_lead(reactive) == reactive
+
+    def test_forecast_gap_can_only_shrink(self):
+        """Actuator (a)'s floor: the fold returns min(measured,
+        predicted) under a ramp — the collection window can tighten
+        toward its floor early, never widen past the reactive sizing."""
+        # no forecaster: passthrough (including None)
+        host = _GapHost(None)
+        assert MicroBatcher._forecast_gap_s(host, 0.01) == 0.01
+        assert MicroBatcher._forecast_gap_s(host, None) is None
+        # ramping, predicted gap WIDER than measured → measured wins
+        host = _GapHost(_StubForecaster(ramping=True, gap=0.05))
+        assert MicroBatcher._forecast_gap_s(host, 0.01) == 0.01
+        # ramping, predicted gap tighter → predicted wins
+        host = _GapHost(_StubForecaster(ramping=True, gap=0.002))
+        assert MicroBatcher._forecast_gap_s(host, 0.01) == 0.002
+        # ramping with no measured gap yet → predicted alone
+        assert MicroBatcher._forecast_gap_s(host, None) == 0.002
+        # not ramping → measured untouched even with a tight prediction
+        host = _GapHost(_StubForecaster(ramping=False, gap=0.002))
+        assert MicroBatcher._forecast_gap_s(host, 0.01) == 0.01
+
+    def test_prewarm_fires_once_per_ramp_episode(self):
+        """The pre-touch is one-shot per episode: armed → fires on the
+        first ramp call → stays quiet until the signal clears → re-arms."""
+        calls = []
+
+        class _Engine:
+            def prewarm_touch(self):
+                calls.append(1)
+                return 3
+
+        host = _GapHost(_StubForecaster(ramping=True, gap=0.01), _Engine())
+        MicroBatcher._note_ramp(host, True)
+        MicroBatcher._note_ramp(host, True)  # same episode: no second fire
+        deadline = time.monotonic() + 5.0
+        while len(calls) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(calls) == 1
+        # wait for the daemon thread to fold the touch count in
+        while host.prewarm_total < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert host.prewarm_total == 3
+        MicroBatcher._note_ramp(host, False)  # signal clears: re-arm
+        MicroBatcher._note_ramp(host, True)   # new episode: second fire
+        while len(calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end wiring + the zero-cost proof
+# ---------------------------------------------------------------------------
+
+
+class TestForecastWiring:
+    def test_disabled_mode_never_observes(self, mined_pvc):
+        """The ISSUE 17 zero-cost acceptance (the PR 11 pattern): with
+        KMLS_FORECAST=0 (default) the app holds no forecaster, real
+        traffic never moves the module observation counter, and no
+        forecast series renders."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(dataclasses.replace(cfg, cache_enabled=False))
+        assert app.engine.load()
+        assert app.forecaster is None
+        before = forecast_mod.OBSERVATIONS_TOTAL
+        for s in _rule_seeds(cfg)[:6]:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        assert forecast_mod.OBSERVATIONS_TOTAL == before
+        text = app.handle("GET", "/metrics", None)[2].decode()
+        assert "kmls_forecast_" not in text
+        assert "kmls_utilization_forecast" not in text
+
+    def test_enabled_mode_observes_and_renders(self, mined_pvc):
+        """With KMLS_FORECAST=1 every served request feeds the model and
+        the forecast series render with live values — the exported
+        utilization still floors at the reactive signal."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(
+                cfg, cache_enabled=False, forecast_enabled=True
+            )
+        )
+        assert app.engine.load()
+        assert app.forecaster is not None
+        before = forecast_mod.OBSERVATIONS_TOTAL
+        seeds = _rule_seeds(cfg)[:6]
+        for s in seeds:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        assert forecast_mod.OBSERVATIONS_TOTAL == before + len(seeds)
+        assert app.forecaster.observations == len(seeds)
+        reactive, led = app.batcher.utilization_parts()
+        assert led >= reactive
+        text = app.handle("GET", "/metrics", None)[2].decode()
+        assert "# TYPE kmls_forecast_observations_total counter" in text
+        assert f"\nkmls_forecast_observations_total {len(seeds)}" in text
+        assert "# TYPE kmls_utilization_forecast gauge" in text
+
+    # the two pre-fetch pins ride the CI chaos job too: they are the
+    # delta-apply cold-window recovery claims (owner-only, singleflight,
+    # nothing started for cached or foreign keys)
+    @pytest.mark.chaos
+    def test_prefetch_warms_only_cooled_owned_uncached_keys(self, mined_pvc):
+        """Actuator (c)'s three filters: a pre-fetch pass leads a
+        singleflight fill ONLY for predicted-hot sets the delta just
+        cooled; sets outside the touched names, and keys already cached,
+        start nothing."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(cfg, forecast_enabled=True)
+        )
+        assert app.engine.load()
+        seeds = _rule_seeds(cfg)[:3]
+        for s in seeds:
+            status, _, _ = _post(app, [s])
+            assert status == 200
+        hot = seeds[0]
+        key = app._cache_key([hot])
+        assert app.cache.contains(key)
+        # a delta that touched nothing hot: no pre-fetch
+        assert app._forecast_prefetch({"__untouched_name__"}) == 0
+        # the key is still cached: cooled-set filter aside, no re-fill
+        assert app._forecast_prefetch({hot}) == 0
+        # now actually cool it (what _on_delta_applied does first);
+        # invalidation bumps the seed's generation, so the re-fill lands
+        # under the NEW key — exactly what the next real request would ask
+        assert app.cache.invalidate_seeds({hot}) >= 1
+        assert not app.cache.contains(key)
+        fresh_key = app._cache_key([hot])
+        assert fresh_key != key
+        started = app._forecast_prefetch({hot})
+        assert started == 1
+        assert app.forecast_prefetch_total == 1
+        deadline = time.monotonic() + 10.0
+        while not app.cache.contains(fresh_key) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert app.cache.contains(fresh_key)  # re-materialized, singleflight
+
+    @pytest.mark.chaos
+    def test_prefetch_respects_ring_ownership(self, mined_pvc):
+        """Owner-only, never broadcast: with a ring that assigns every
+        key elsewhere, a pre-fetch pass starts nothing — the owning
+        replica re-materializes its own keys."""
+        from kmlserver_tpu.freshness.ring import RendezvousRing
+
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(
+            dataclasses.replace(cfg, forecast_enabled=True)
+        )
+        assert app.engine.load()
+        hot = _rule_seeds(cfg)[:1][0]
+        status, _, _ = _post(app, [hot])
+        assert status == 200
+        app.cache.invalidate_seeds({hot})
+        app.ring = RendezvousRing(["some-other-replica"])
+        app._ring_self = "this-replica"
+        assert app._forecast_prefetch({hot}) == 0
+        assert app.forecast_prefetch_total == 0
+
+    def test_config_knobs_flow_from_env(self, monkeypatch):
+        monkeypatch.setenv("KMLS_FORECAST", "1")
+        monkeypatch.setenv("KMLS_FORECAST_HORIZON_S", "3.5")
+        monkeypatch.setenv("KMLS_FORECAST_RAMP_RATIO", "1.4")
+        monkeypatch.setenv("KMLS_FORECAST_PREFETCH_TOP_N", "5")
+        cfg = ServingConfig.from_env()
+        assert cfg.forecast_enabled is True
+        assert cfg.forecast_horizon_s == 3.5
+        assert cfg.forecast_ramp_ratio == 1.4
+        assert cfg.forecast_prefetch_top_n == 5

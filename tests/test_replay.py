@@ -13,6 +13,7 @@ from kmlserver_tpu.serving.replay import (
     REPLAY_SHAPES,
     ReplayReport,
     flash_crowd_payloads,
+    onset_steady_p99,
     replay,
     replay_pooled,
     sample_seed_sets,
@@ -101,6 +102,34 @@ def test_replay_counts_failures_as_errors():
     report = replay(send, [["ok"], ["boom"], ["ok"]], qps=500.0)
     assert report.n_errors == 1
     assert report.by_source == {"rules": 2}
+
+
+class TestOnsetSteadySplit:
+    """ISSUE 17: the ramp-onset vs steady-window p99 split that judges
+    the predictive claim in the window where prediction can matter."""
+
+    def test_split_separates_onset_from_steady_tail(self):
+        # a ramp that hurts early: high latencies in the first 40% of
+        # the span, low ones in the last 60% — the split must see them
+        points = [(t, 50.0) for t in (0.0, 1.0, 2.0, 3.0, 4.0)]
+        points += [(t, 2.0) for t in (6.0, 7.0, 8.0, 9.0, 10.0)]
+        onset, steady = onset_steady_p99(points, 10.0)
+        assert onset == pytest.approx(50.0)
+        assert steady == pytest.approx(2.0)
+
+    def test_boundary_points_land_in_both_windows(self):
+        # default fractions overlap nothing, but a point AT a boundary
+        # belongs to its window inclusively
+        points = [(4.0, 9.0), (6.0, 3.0)]
+        onset, steady = onset_steady_p99(points, 10.0)
+        assert onset == pytest.approx(9.0)
+        assert steady == pytest.approx(3.0)
+
+    def test_degenerate_inputs_report_none_not_garbage(self):
+        assert onset_steady_p99([], 10.0) == (None, None)
+        assert onset_steady_p99([(0.0, 1.0)], 0.0) == (None, None)
+        # every point inside the dead zone between the windows
+        assert onset_steady_p99([(5.0, 1.0)], 10.0) == (None, None)
 
 
 class TestTrafficShapes:
