@@ -214,6 +214,21 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_SERVE_GANG_SIZE": "serving",
     "KMLS_SERVE_GANG_RANK": "serving",
     "KMLS_SERVE_GANG_PORT": "serving",
+    # --- serving: gray-failure spine (ISSUE 18) ---
+    # hedged dispatch master switch (0 = off, the proven-zero-cost
+    # default: no hedge state allocated, module hedge counters pinned 0)
+    "KMLS_HEDGE": "serving",
+    # slow-outlier ladder: eject a peer whose EWMA latency exceeds
+    # RATIO × the healthy-peer median (0 disables the ladder; slowness
+    # then never ejects, only hedging absorbs it)
+    "KMLS_PEER_SLOW_RATIO": "serving",
+    # hedge trigger floor in ms — the adaptive per-peer delay (tracked
+    # latency ~p95) never fires earlier than this
+    "KMLS_HEDGE_DELAY_MS": "serving",
+    # amplification bound: hedges may add at most this fraction of extra
+    # dispatches (token bucket earning FRAC per primary dispatch);
+    # exhausted budget falls back to plain waiting
+    "KMLS_HEDGE_MAX_FRAC": "serving",
     # --- serving: observability (ISSUE 9) ---
     # span tracing: baseline sample rate for OK traces (0 = tracing off —
     # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
@@ -399,6 +414,10 @@ KNOB_REGISTRY: dict[str, str] = {
     # shrinks both)
     "KMLS_BENCH_MESHSERVE_QPS": "tool",
     "KMLS_BENCH_MESHSERVE_REQUESTS": "tool",
+    # gray-failure phase (ISSUE 18): rate / volume for the slowpeer
+    # bracket's hedged-vs-control legs (CI smoke shrinks both)
+    "KMLS_BENCH_SLOWPEER_QPS": "tool",
+    "KMLS_BENCH_SLOWPEER_REQUESTS": "tool",
     # quality-loop phase (ISSUE 14): membership-row volume of the eval/
     # compaction bracket's synthetic workload (CI smoke shrinks it)
     "KMLS_BENCH_QUALITY_ROWS": "tool",
@@ -419,6 +438,8 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_FAULT_RANK_DEAD": "fault",
     "KMLS_FAULT_EMBED_CORRUPT": "fault",
     "KMLS_FAULT_DELTA_CORRUPT": "fault",
+    "KMLS_FAULT_MESH_PEER_DELAY_MS": "fault",
+    "KMLS_FAULT_FLEET_PEER_DELAY_MS": "fault",
 }
 
 # Columns dropped from the raw CSV before any processing
@@ -931,6 +952,27 @@ class ServingConfig:
     serve_gang_rank: int = 0
     serve_gang_port: int = 8477
 
+    # --- gray-failure spine (ISSUE 18) ---
+    # Hedged dispatch master switch. False (default) is the proven-
+    # zero-cost path: no hedge bookkeeping allocated, the module hedge
+    # counters stay pinned at 0, and the PR 8 admission ladder has
+    # structurally no hedge input (hedges are client/coordinator-side —
+    # they never enter the admission queue as a new class of work).
+    hedge_enabled: bool = False
+    # Slow-outlier ladder: eject a peer whose EWMA latency exceeds
+    # ratio × the healthy-peer median (FleetRouter.mark_latency /
+    # MeshCoordinator rank tracking). 0 disables the ladder.
+    peer_slow_ratio: float = 0.0
+    # Hedge trigger floor (ms): the adaptive per-peer delay — tracked
+    # latency ~p95 — never fires earlier than this, so a cold router
+    # can't hedge on noise.
+    hedge_delay_ms: float = 30.0
+    # Amplification bound: a token bucket earns this fraction per
+    # primary dispatch and each hedge spends one token — extra
+    # dispatches are structurally ≤ this fraction of total. An empty
+    # bucket means plain waiting, never an unbounded retry storm.
+    hedge_max_frac: float = 0.05
+
     # --- observability (ISSUE 9): span tracing + runtime health ---
     # Baseline retention probability for OK traces once tracing is on.
     # 0 (default) disables tracing entirely: no trace context, no id
@@ -1102,6 +1144,10 @@ class ServingConfig:
             serve_gang_size=_getenv_int("KMLS_SERVE_GANG_SIZE", 1),
             serve_gang_rank=_getenv_gang_rank(),
             serve_gang_port=_getenv_int("KMLS_SERVE_GANG_PORT", 8477),
+            hedge_enabled=_getenv_bool("KMLS_HEDGE", False),
+            peer_slow_ratio=_getenv_float("KMLS_PEER_SLOW_RATIO", 0.0),
+            hedge_delay_ms=_getenv_float("KMLS_HEDGE_DELAY_MS", 30.0),
+            hedge_max_frac=_getenv_float("KMLS_HEDGE_MAX_FRAC", 0.05),
             trace_sample=_getenv_float("KMLS_TRACE_SAMPLE", 0.0),
             trace_buffer=_getenv_int("KMLS_TRACE_BUFFER", 512),
             trace_slow_n=_getenv_int("KMLS_TRACE_SLOW_N", 32),

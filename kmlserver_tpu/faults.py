@@ -44,6 +44,17 @@ Sites currently wired:
   (continuous freshness, freshness/delta.py): a fail fault rejects the
   bundle exactly like a torn/wrong-base delta — the base generation
   keeps serving (kmls_delta_rejected_total counts it), never a 5xx.
+- ``"mesh.peer"`` (keyed by gang rank) — fired inside the mesh worker's
+  partial-serve handler (:meth:`RecommendEngine._mesh_serve_partial`),
+  i.e. on a REMOTE rank's answer path: a delay fault turns that gang
+  member into a gray failure — alive, fenced, correct, just slow — so
+  the coordinator's hedge/straggler-degrade machinery (ISSUE 18) is
+  what keeps the merge's tail bounded.
+- ``"fleet.peer"`` (keyed by the peer's sorted-fleet index) — fired at
+  the top of the app's recommend path when this replica is a fleet
+  member: a delay fault stalls every answer this peer serves, the
+  fleet-side gray failure that the router's slow-outlier ladder and
+  client hedging must absorb without a single 5xx.
 
 Arming, two ways:
 
@@ -67,7 +78,12 @@ Arming, two ways:
   - ``KMLS_FAULT_EMBED_CORRUPT=N`` — fail the next N embedding-artifact
     loads (rules-only degradation, not a failed reload);
   - ``KMLS_FAULT_DELTA_CORRUPT=N`` — reject the next N delta-bundle
-    applies (base keeps serving, delta_rejected counted).
+    applies (base keeps serving, delta_rejected counted);
+  - ``KMLS_FAULT_MESH_PEER_DELAY_MS=rank:ms[:N]`` — gang rank ``rank``
+    stalls ``ms`` per partial it serves (default every partial);
+  - ``KMLS_FAULT_FLEET_PEER_DELAY_MS=idx:ms[:N]`` — fleet peer ``idx``
+    (sorted-peer position) stalls ``ms`` per request it answers
+    (default every request).
 
 File corruption is a separate concern (faults happen to BYTES, not call
 sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
@@ -145,28 +161,41 @@ def fired_counts() -> dict[tuple[str, int | None], int]:
         return {k: f.fired for k, f in _faults.items()}
 
 
-def fire(site: str, replica: int | None = None) -> None:
-    """Trigger point, called from serving code. No-op unless a fault is
-    armed for ``(site, replica)`` or ``(site, None)``. Delay faults
-    sleep; fail faults raise :class:`FaultInjected`."""
+def take(site: str, replica: int | None = None) -> float:
+    """Consume one armed fault for ``(site, replica)`` or ``(site,
+    None)`` → its delay in seconds (0.0 when nothing is armed). Fail
+    faults raise :class:`FaultInjected` exactly like :func:`fire`.
+    Loop-native callers (serving/aioserver.py) use this to put the
+    stall on a timer: a blocking sleep on the event loop would stall
+    EVERY in-flight request, turning a per-request gray failure into a
+    whole-replica outage."""
     if not _armed and _env_loaded:
-        return
+        return 0.0
     _ensure_env()
     if not _armed:
-        return
+        return 0.0
     with _lock:
         fault = _faults.get((site, replica)) or _faults.get((site, None))
         if fault is None or fault.remaining == 0:
-            return
+            return 0.0
         if fault.remaining > 0:
             fault.remaining -= 1
         fault.fired += 1
         delay = fault.delay_s
     if delay > 0:
-        time.sleep(delay)
-        return
+        return delay
     raise FaultInjected(f"injected fault at {site}"
                         + (f" (replica {replica})" if replica is not None else ""))
+
+
+def fire(site: str, replica: int | None = None) -> None:
+    """Trigger point, called from serving code. No-op unless a fault is
+    armed for ``(site, replica)`` or ``(site, None)``. Delay faults
+    sleep (on the calling thread — see :func:`take` for the loop-native
+    form); fail faults raise :class:`FaultInjected`."""
+    delay = take(site, replica)
+    if delay > 0:
+        time.sleep(delay)
 
 
 def load_env(force: bool = False) -> None:
@@ -215,6 +244,22 @@ def load_env(force: bool = False) -> None:
     raw = os.getenv("KMLS_FAULT_DELTA_CORRUPT")
     if raw:
         inject("delta.apply", times=int(raw))
+    raw = os.getenv("KMLS_FAULT_MESH_PEER_DELAY_MS")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "mesh.peer", replica=int(parts[0]),
+            delay_s=float(parts[1]) / 1e3,
+            times=int(parts[2]) if len(parts) > 2 else -1,
+        )
+    raw = os.getenv("KMLS_FAULT_FLEET_PEER_DELAY_MS")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "fleet.peer", replica=int(parts[0]),
+            delay_s=float(parts[1]) / 1e3,
+            times=int(parts[2]) if len(parts) > 2 else -1,
+        )
 
 
 def _ensure_env() -> None:

@@ -49,7 +49,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 
 def _weight(peer: str, key: str) -> int:
@@ -102,9 +102,19 @@ def seeds_key(seeds: list[str]) -> str:
     return "\x1f".join(sorted(seeds))
 
 
+# EWMA smoothing for per-peer latency; ~0.2 weights the last ~10 samples
+_EWMA_ALPHA = 0.2
+# samples a peer must contribute before its EWMA participates in the
+# slow-outlier ladder (or in the healthy-median it is compared against)
+_MIN_LATENCY_SAMPLES = 8
+# bounded window backing the hedge-delay quantile
+_LATENCY_WINDOW = 64
+
+
 class _PeerHealth:
     __slots__ = (
-        "consecutive_failures", "ejected", "next_probe_at", "failed_shard"
+        "consecutive_failures", "ejected", "next_probe_at", "failed_shard",
+        "ewma_s", "samples", "recent", "slow",
     )
 
     def __init__(self) -> None:
@@ -117,6 +127,16 @@ class _PeerHealth:
         # ejection/spill/probe mechanics are identical either way (a
         # gang missing one shard is as unservable as a dead replica).
         self.failed_shard = None
+        # latency-aware health (ISSUE 18): EWMA of observed round-trip
+        # seconds, sample count gating ladder participation, a bounded
+        # recent window for the hedge-delay quantile, and whether the
+        # current ejection was for SLOWNESS (re-admitted by a fast probe
+        # sample, not by mark_success — a gray-failed peer still answers
+        # successfully, just late).
+        self.ewma_s = 0.0
+        self.samples = 0
+        self.recent: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.slow = False
 
 
 class FleetRouter:
@@ -139,6 +159,18 @@ class FleetRouter:
       owner (routing somewhere beats routing nowhere — the serving side
       degrades, never fails).
 
+    **Gray failures** (ISSUE 18): a slow-but-alive peer never trips the
+    error breaker — every answer is a 200, just late. ``mark_latency``
+    feeds per-peer EWMA latency into a SLOW-outlier ladder that shares
+    the ejection machinery above: when ``slow_ratio > 0`` and a peer's
+    EWMA exceeds ``slow_ratio ×`` the healthy-peer median, it is ejected
+    exactly like a failing peer (same spill, same half-open probe
+    cadence) — slowness and sickness converge on one peer-state
+    machine. Re-admission differs in ONE way: a slow-ejected peer is
+    re-admitted by a probe whose own latency sample is back under the
+    bar, not by ``mark_success`` (a gray-failed peer still succeeds,
+    just late — success is no evidence of recovery).
+
     Thread-safe (a pacing thread routes while worker threads mark);
     ``clock`` is injectable for deterministic tests.
     """
@@ -149,11 +181,15 @@ class FleetRouter:
         *,
         eject_threshold: int = 3,
         probe_interval_s: float = 1.0,
+        slow_ratio: float = 0.0,
         clock=time.monotonic,
     ):
         self.ring = RendezvousRing(peers)
         self.eject_threshold = max(1, eject_threshold)
         self.probe_interval_s = probe_interval_s
+        # 0 disables the slow ladder: mark_latency still tracks (the
+        # hedge delay quantile wants samples either way) but never ejects
+        self.slow_ratio = max(0.0, slow_ratio)
         self._clock = clock
         self._health = {p: _PeerHealth() for p in self.ring.peers}
         self._lock = threading.Lock()
@@ -161,6 +197,7 @@ class FleetRouter:
         self.readmissions = 0
         self.probes = 0
         self.spills = 0
+        self.slow_ejections = 0
 
     @property
     def peers(self) -> list[str]:
@@ -213,13 +250,111 @@ class FleetRouter:
                 return
             health.consecutive_failures = 0
             health.failed_shard = None
-            if health.ejected:
+            # a SLOW-ejected peer is not re-admitted by success — a gray
+            # failure answers successfully, just late; only a fast probe
+            # latency sample (mark_latency) clears it
+            if health.ejected and not health.slow:
                 health.ejected = False
                 self.readmissions += 1
+
+    def _healthy_median_locked(self, exclude: str) -> float | None:
+        """Median EWMA over healthy peers with enough samples, excluding
+        the peer under judgment (a slow outlier must not drag the bar it
+        is measured against). Caller holds the lock."""
+        ewmas = sorted(
+            h.ewma_s
+            for p, h in self._health.items()
+            if p != exclude
+            and not h.ejected
+            and h.samples >= _MIN_LATENCY_SAMPLES
+        )
+        if not ewmas:
+            return None
+        mid = len(ewmas) // 2
+        if len(ewmas) % 2:
+            return ewmas[mid]
+        return 0.5 * (ewmas[mid - 1] + ewmas[mid])
+
+    def mark_latency(self, peer: str, seconds: float) -> None:
+        """Feed one observed round-trip into ``peer``'s latency health.
+
+        Always tracks (EWMA + bounded recent window — the hedge-delay
+        quantile wants samples even with the ladder off). With
+        ``slow_ratio > 0`` it also runs the slow-outlier ladder:
+
+        - EWMA above ``slow_ratio × healthy-median`` (after at least
+          ``_MIN_LATENCY_SAMPLES`` observations, with at least one other
+          sampled healthy peer to define the median) ejects the peer —
+          same machinery, counted in both ``ejections`` and
+          ``slow_ejections``;
+        - while slow-ejected, each half-open probe's OWN sample is the
+          audition: back under the bar re-admits (EWMA reset to that
+          sample so the stale slow history doesn't instantly re-eject),
+          still slow re-arms the probe timer.
+        """
+        with self._lock:
+            health = self._health.get(peer)
+            if health is None:
+                return
+            s = max(0.0, float(seconds))
+            health.recent.append(s)
+            health.samples += 1
+            if health.samples == 1:
+                health.ewma_s = s
+            else:
+                health.ewma_s += _EWMA_ALPHA * (s - health.ewma_s)
+            if self.slow_ratio <= 0.0:
+                return
+            if health.slow and health.ejected:
+                median = self._healthy_median_locked(exclude=peer)
+                if median is not None and s <= self.slow_ratio * median:
+                    health.slow = False
+                    health.ejected = False
+                    health.ewma_s = s
+                    self.readmissions += 1
+                else:
+                    health.next_probe_at = (
+                        self._clock() + self.probe_interval_s
+                    )
+                return
+            if health.ejected or health.samples < _MIN_LATENCY_SAMPLES:
+                return
+            median = self._healthy_median_locked(exclude=peer)
+            if median is not None and health.ewma_s > self.slow_ratio * median:
+                health.slow = True
+                health.ejected = True
+                health.next_probe_at = self._clock() + self.probe_interval_s
+                self.ejections += 1
+                self.slow_ejections += 1
+
+    def hedge_delay_s(self, peer: str, floor_s: float = 0.0) -> float:
+        """Adaptive hedge trigger for ``peer``: ~p95 of its recent
+        latency window, floored at ``floor_s`` (KMLS_HEDGE_DELAY_MS).
+        Until the window has enough samples the floor stands alone — a
+        cold router must not hedge aggressively on noise."""
+        with self._lock:
+            health = self._health.get(peer)
+            if health is None or len(health.recent) < _MIN_LATENCY_SAMPLES:
+                return floor_s
+            ordered = sorted(health.recent)
+            q = ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+            return max(floor_s, q)
+
+    def peer_latency_s(self, peer: str) -> float:
+        """Current EWMA latency estimate for ``peer`` (0.0 unsampled)."""
+        with self._lock:
+            health = self._health.get(peer)
+            return health.ewma_s if health is not None else 0.0
 
     def ejected_peers(self) -> list[str]:
         with self._lock:
             return [p for p, h in self._health.items() if h.ejected]
+
+    def slow_peers(self) -> list[str]:
+        """Peers currently ejected for SLOWNESS (gray failure) — disjoint
+        from error-ejected peers in ejected_peers() only by cause."""
+        with self._lock:
+            return [p for p, h in self._health.items() if h.slow]
 
     def failed_shards(self) -> dict[str, int]:
         """peer → last blamed gang rank, for peers whose most recent

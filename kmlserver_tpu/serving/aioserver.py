@@ -34,6 +34,7 @@ import asyncio
 import logging
 import socket
 
+from .. import faults
 from .app import RecommendApp
 
 logger = logging.getLogger("kmlserver_tpu.serving")
@@ -179,6 +180,7 @@ class _Conn(asyncio.Protocol):
             content_length = 0
             close_after = False
             trace_header: str | None = None
+            budget_header: str | None = None
             for line in header_block.split(b"\r\n"):
                 key, _, value = line.partition(b":")
                 lowered = key.strip().lower()
@@ -195,6 +197,11 @@ class _Conn(asyncio.Protocol):
                     # the recorder validates the charset before any byte
                     # of it can reach JSON output
                     trace_header = value.strip().decode("latin1")
+                elif lowered == b"x-kmls-deadline-budget":
+                    # deadline propagation (ISSUE 18): remaining budget
+                    # (ms) forwarded by an upstream hop; the app parses
+                    # and ignores malformed values
+                    budget_header = value.strip().decode("latin1")
             if content_length > _MAX_BODY:
                 self._bad_request("body too large")
                 return
@@ -203,7 +210,9 @@ class _Conn(asyncio.Protocol):
                 return  # body still arriving
             body = self.buf[end + 4: total] or None
             self.buf = self.buf[total:]
-            self._dispatch(method, path, body, close_after, trace_header)
+            self._dispatch(
+                method, path, body, close_after, trace_header, budget_header
+            )
 
     def _bad_request(self, detail: str) -> None:
         seq = self._next_seq
@@ -220,7 +229,7 @@ class _Conn(asyncio.Protocol):
 
     def _dispatch(
         self, method: str, path: str, body: bytes | None, close_after: bool,
-        trace_header: str | None = None,
+        trace_header: str | None = None, budget_header: str | None = None,
     ) -> None:
         state = self.state
         app = state.app
@@ -228,49 +237,100 @@ class _Conn(asyncio.Protocol):
         seq = self._next_seq
         self._next_seq += 1
         route = path.split("?", 1)[0]
+        if method == "POST" and route in _RECOMMEND_PATHS:
+            # gray-failure chaos site (ISSUE 18), loop-native form: an
+            # armed per-replica stall delays THIS request on the loop
+            # timer — pipelined neighbours and other connections keep
+            # flowing, which is what a slow-but-alive replica looks
+            # like from outside. fire()'s blocking sleep would stall
+            # the whole loop and turn a per-request stall into a full
+            # replica outage.
+            try:
+                delay = faults.take("fleet.peer", replica=app._fleet_index)
+            except Exception:
+                logger.exception("unhandled error for %s %s", method, path)
+                app.metrics.record_error()
+                self._stage(seq, (
+                    500, {"Content-Type": "application/json"},
+                    b'{"detail": "Internal Server Error"}',
+                ), close_after)
+                state.leave()
+                return
+            if delay > 0:
+                self.loop.call_later(
+                    delay, self._recommend, seq, path, body, close_after,
+                    trace_header, budget_header,
+                )
+                return
+            self._recommend(
+                seq, path, body, close_after, trace_header, budget_header
+            )
+            return
         try:
-            if method == "POST" and route in _RECOMMEND_PATHS:
-                if app.batcher is None:
-                    # batching disabled: the blocking engine call must
-                    # still stay off the loop
-                    task = state.engine_pool.submit(
-                        app.handle, method, path, body, self.peer_host,
-                        trace_header,
-                    )
-                    task.add_done_callback(
-                        lambda f: self.loop.call_soon_threadsafe(
-                            self._finish_handled, seq, f, close_after
-                        )
-                    )
-                    return
-                response, future, t0, trace = app.submit_recommend(
-                    body, trace_header
-                )
-                if response is None:
-                    if isinstance(future, asyncio.Future):
-                        # loop-native batcher: resolved ON the loop, the
-                        # callback is already loop-scheduled
-                        future.add_done_callback(
-                            lambda f: self._finish_recommend(
-                                seq, f, t0, close_after, trace
-                            )
-                        )
-                    else:
-                        # threaded batcher: its completion thread fires
-                        # the callback → hop back onto the loop
-                        future.add_done_callback(
-                            lambda f: self.loop.call_soon_threadsafe(
-                                self._finish_recommend, seq, f, t0,
-                                close_after, trace,
-                            )
-                        )
-                    return
-            else:
-                response = app.handle(
-                    method, path, body, client_host=self.peer_host
-                )
+            response = app.handle(
+                method, path, body, client_host=self.peer_host
+            )
         except Exception:
             logger.exception("unhandled error for %s %s", method, path)
+            app.metrics.record_error()
+            response = (
+                500, {"Content-Type": "application/json"},
+                b'{"detail": "Internal Server Error"}',
+            )
+        self._stage(seq, response, close_after)
+        state.leave()
+
+    def _recommend(
+        self, seq: int, path: str, body: bytes | None, close_after: bool,
+        trace_header: str | None = None, budget_header: str | None = None,
+    ) -> None:
+        """The recommend-POST tail of :meth:`_dispatch`, split out so an
+        armed fault stall can re-enter it from a loop timer with its
+        response slot (``seq``) already reserved — pipelined responses
+        still leave in request order through ``_stage``."""
+        state = self.state
+        app = state.app
+        if self.closed:  # connection dropped during a fault stall
+            state.leave()
+            return
+        try:
+            if app.batcher is None:
+                # batching disabled: the blocking engine call must
+                # still stay off the loop
+                task = state.engine_pool.submit(
+                    app.handle, "POST", path, body, self.peer_host,
+                    trace_header, budget_header,
+                )
+                task.add_done_callback(
+                    lambda f: self.loop.call_soon_threadsafe(
+                        self._finish_handled, seq, f, close_after
+                    )
+                )
+                return
+            response, future, t0, trace = app.submit_recommend(
+                body, trace_header, budget_header
+            )
+            if response is None:
+                if isinstance(future, asyncio.Future):
+                    # loop-native batcher: resolved ON the loop, the
+                    # callback is already loop-scheduled
+                    future.add_done_callback(
+                        lambda f: self._finish_recommend(
+                            seq, f, t0, close_after, trace
+                        )
+                    )
+                else:
+                    # threaded batcher: its completion thread fires
+                    # the callback → hop back onto the loop
+                    future.add_done_callback(
+                        lambda f: self.loop.call_soon_threadsafe(
+                            self._finish_recommend, seq, f, t0,
+                            close_after, trace,
+                        )
+                    )
+                return
+        except Exception:
+            logger.exception("unhandled error for POST %s", path)
             app.metrics.record_error()
             response = (
                 500, {"Content-Type": "application/json"},

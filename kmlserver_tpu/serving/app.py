@@ -33,6 +33,7 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import faults
 from ..config import ServingConfig
 from ..observability import LoopLagMonitor, SloTracker, SpanRecorder
 from .batcher import (
@@ -112,6 +113,13 @@ class RecommendApp:
     # without __init__ behaves exactly reactively
     forecaster = None
     forecast_prefetch_total = 0
+    # gray-failure spine (ISSUE 18): requests whose forwarded
+    # X-KMLS-Deadline-Budget arrived already spent (wasted work a
+    # downstream hop sheds, distinct from slow-compute "deadline"
+    # degrades), and this replica's sorted-fleet index for the
+    # fleet.peer stall fault site (None = fleet tier unarmed)
+    deadline_expired_total = 0
+    _fleet_index = None
 
     def __init__(
         self, cfg: ServingConfig, engine: RecommendEngine | None = None,
@@ -236,6 +244,13 @@ class RecommendApp:
                 peers.append(me)
             self.ring = RendezvousRing(peers)
             self._ring_self = me
+            if self.fleet_routing:
+                # fleet.peer fault addressing (ISSUE 18): the stall site
+                # keys replicas by sorted-peer index — stable across the
+                # fleet regardless of each replica's KMLS_FLEET_PEERS
+                # ordering, so a chaos harness can aim at exactly one
+                self._fleet_index = sorted(peers).index(me)
+        self.deadline_expired_total = 0
         # predictive serving (ISSUE 17): with KMLS_FORECAST=0 (default)
         # the hook stays None and every touchpoint — batcher submit,
         # utilization, post-delta pre-fetch — is one is-None check; the
@@ -303,10 +318,11 @@ class RecommendApp:
         self, method: str, path: str, body: bytes | None,
         client_host: str | None = None,
         trace_header: str | None = None,
+        budget_header: str | None = None,
     ) -> Response:
         path, _, query = path.partition("?")
         if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
-            return self._post_recommend(body, trace_header)
+            return self._post_recommend(body, trace_header, budget_header)
         if method == "POST" and path == "/metrics/reset":
             # measurement-harness hook: windows the latency percentiles
             # to one replay run (VERDICT r4 #7). Loopback-only via the
@@ -528,6 +544,32 @@ class RecommendApp:
             if self.loop_lag is not None
             else 0.0
         )
+        # gray-failure spine (ISSUE 18): deadline propagation + mesh
+        # hedging observables. All 0 with KMLS_HEDGE=0 / no forwarded
+        # budgets — the hedge counters double as the zero-cost proof
+        # (pinned by test, costmodel-counter style). expired_on_arrival
+        # lives on the mesh WORKER (budget-shed frames); the hedge
+        # outcome counters + slow-peer ladder on the COORDINATOR.
+        state["deadline_expired_total"] = self.deadline_expired_total
+        mesh = getattr(self.engine, "mesh_coordinator", None)
+        worker = getattr(self.engine, "mesh_worker", None)
+        state["hedge_wins_total"] = getattr(mesh, "hedge_wins", 0)
+        state["hedge_losses_total"] = getattr(mesh, "hedge_losses", 0)
+        state["hedge_cancelled_total"] = getattr(mesh, "hedge_cancelled", 0)
+        state["peer_slow_ejections_total"] = getattr(
+            mesh, "slow_ejections", 0
+        )
+        state["peer_slow_readmissions_total"] = getattr(
+            mesh, "slow_readmissions", 0
+        )
+        slow_fn = getattr(mesh, "slow_ranks", None)
+        state["peer_slow"] = len(slow_fn()) if callable(slow_fn) else 0
+        state["mesh_straggler_degraded_total"] = getattr(
+            self.engine, "mesh_straggler_degraded", 0
+        )
+        state["mesh_expired_on_arrival_total"] = getattr(
+            worker, "expired_on_arrival", 0
+        )
         # span-tracing bookkeeping: began is the zero-cost proof counter
         # (must stay 0 while KMLS_TRACE_SAMPLE=0)
         state["traces_began_total"] = self.recorder.began
@@ -706,6 +748,35 @@ class RecommendApp:
         budget_ms = self.cfg.request_deadline_ms
         return t0 + budget_ms / 1e3 if budget_ms > 0 else None
 
+    def _effective_deadline(
+        self, t0: float, budget_header: str | None
+    ) -> tuple[float | None, float | None, bool]:
+        """Cross-hop deadline propagation (ISSUE 18): the effective
+        deadline is the TIGHTER of the local budget
+        (KMLS_REQUEST_DEADLINE_MS) and the remaining milliseconds an
+        upstream hop forwarded on ``X-KMLS-Deadline-Budget`` →
+        ``(deadline, forwarded_budget_ms, expired)``. ``expired=True``
+        means the budget arrived already spent: the caller answers the
+        degraded fallback IMMEDIATELY — counting wasted work
+        (kmls_deadline_expired_total), not slow compute. A malformed
+        header is ignored (local budget only): deadline propagation
+        must never turn a bad proxy into an outage."""
+        deadline = self._deadline_for(t0)
+        if not budget_header:
+            return deadline, None, False
+        try:
+            budget_ms = float(budget_header)
+        except (TypeError, ValueError):
+            return deadline, None, False
+        if not math.isfinite(budget_ms):
+            return deadline, None, False
+        if budget_ms <= 0.0:
+            return deadline, budget_ms, True
+        remote = t0 + budget_ms / 1e3
+        if deadline is None or remote < deadline:
+            deadline = remote
+        return deadline, budget_ms, False
+
     @staticmethod
     def _degrade_reason(exc: Exception) -> str | None:
         """Exceptions that degrade to a fallback answer instead of an
@@ -835,9 +906,18 @@ class RecommendApp:
                            "unavailable"},
             )
             headers["X-KMLS-Mesh-Unavailable"] = str(rank)
-            headers["Retry-After"] = str(
-                math.ceil(max(self.cfg.replica_probe_interval_s, 1.0))
-            )
+            # PR 8's Retry-After contract (the 429 path below): RFC 9110
+            # delay-seconds is a non-negative INTEGER, and a bounded
+            # jitter (KMLS_SHED_RETRY_JITTER) de-synchronizes the retry
+            # storm — the un-jittered constant here re-synchronized
+            # every spilled client onto the same probe tick
+            base = max(self.cfg.replica_probe_interval_s, 1.0)
+            jitter = max(0.0, getattr(self.cfg, "shed_retry_jitter", 0.0))
+            if jitter > 0.0:
+                base = random.uniform(
+                    base * (1.0 - jitter), base * (1.0 + jitter)
+                )
+            headers["Retry-After"] = str(math.ceil(max(base, 0.0)))
             self.metrics.record_degraded(f"mesh-shard-missing:{rank}")
             if trace is not None:
                 trace.annotate("mesh_shard_missing", rank)
@@ -944,6 +1024,17 @@ class RecommendApp:
             # lets load harnesses (serving/replay.py) split cached vs
             # computed latency without guessing from timing
             headers["X-KMLS-Cache"] = "hit"
+        # gray-failure spine (ISSUE 18): a "degraded:<reason>" source is
+        # an ANSWERED-but-partial result (e.g. a mesh merge that dropped
+        # a straggler slab) — same contract surface as the popularity
+        # fallback: X-KMLS-Degraded + the degraded counter. The cache
+        # layer independently refuses to store these (cache.put), so one
+        # slow moment can't pin a partial answer past the gang recovering.
+        degraded = source.startswith("degraded:")
+        if degraded:
+            reason = source.partition(":")[2] or source
+            headers["X-KMLS-Degraded"] = reason
+            self.metrics.record_degraded(reason)
         if trace is not None:
             trace.span(
                 "compose", t_compose, time.perf_counter(),
@@ -951,6 +1042,8 @@ class RecommendApp:
             )
             if cached:
                 trace.annotate("cached", True)
+            if degraded:
+                trace.annotate("reason", source.partition(":")[2] or source)
             self._trace_finish(trace, "ok", headers)
         return status, headers, payload
 
@@ -1109,13 +1202,17 @@ class RecommendApp:
         return "flight", future
 
     def recommend_direct(
-        self, songs: list[str], trace=None
+        self, songs: list[str], trace=None, deadline: float | None = None,
     ) -> tuple[list[str], str, bool]:
         """Blocking cached recommend → ``(songs, source, cache_hit)``.
         Used by the threaded POST path and the in-process replay harness;
         raises (Overloaded, DeadlineExceeded, NoHealthyReplicas included)
-        like the underlying batcher/engine."""
-        deadline = self._deadline_for(time.perf_counter())
+        like the underlying batcher/engine. ``deadline`` lets a caller
+        that already tightened the budget with a forwarded
+        X-KMLS-Deadline-Budget pass it through; None computes the local
+        one (the pre-ISSUE-18 behavior exactly)."""
+        if deadline is None:
+            deadline = self._deadline_for(time.perf_counter())
         state, payload = self._cache_lookup_or_lead(songs, deadline, trace)
         if state == "hit":
             return payload[0], payload[1], True
@@ -1148,14 +1245,31 @@ class RecommendApp:
         return recs, source, False
 
     def _post_recommend(
-        self, body: bytes | None, trace_header: str | None = None
+        self, body: bytes | None, trace_header: str | None = None,
+        budget_header: str | None = None,
     ) -> Response:
         t0 = time.perf_counter()
+        # gray-failure chaos site (ISSUE 18): a deterministic stall on
+        # ONE fleet replica, addressed by sorted-peer index — the
+        # slowpeer bench's fleet-side victim
+        faults.fire("fleet.peer", replica=self._fleet_index)
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err
         # trace begins AFTER validation: malformed bodies never allocate
         trace = self._trace_begin(trace_header)
+        deadline, budget_ms, expired = self._effective_deadline(
+            t0, budget_header
+        )
+        if budget_ms is not None and trace is not None:
+            trace.annotate("deadline_budget_ms", round(budget_ms, 3))
+        if expired:
+            # the budget arrived spent: shed the compute, answer the
+            # fallback — wasted-work, distinct from slow-compute
+            self.deadline_expired_total += 1
+            return self._degraded_response(
+                t0, songs, "deadline-expired", trace=trace
+            )
         # serve mesh (ISSUE 16): with a gang member known-dark, answer
         # the shard-loss policy BEFORE cache/batcher — a merged answer
         # missing one slab's candidates would be silently wrong, and
@@ -1166,7 +1280,9 @@ class RecommendApp:
                 t0, songs, missing[0], trace=trace
             )
         try:
-            recs, source, cached = self.recommend_direct(songs, trace=trace)
+            recs, source, cached = self.recommend_direct(
+                songs, trace=trace, deadline=deadline
+            )
         except Exception as exc:
             if isinstance(exc, MeshShardUnavailable):
                 # a gang member died mid-flight (after the pre-check)
@@ -1185,7 +1301,10 @@ class RecommendApp:
 
     # ---------- async-transport entry points ----------
 
-    def submit_recommend(self, body: bytes | None, trace_header: str | None = None):
+    def submit_recommend(
+        self, body: bytes | None, trace_header: str | None = None,
+        budget_header: str | None = None,
+    ):
         """Non-blocking twin of :meth:`_post_recommend` for the asyncio
         transport: → ``(response, None, t0, trace)`` when the answer is
         immediate (validation error, cache hit, shed, or the unbatched
@@ -1202,11 +1321,29 @@ class RecommendApp:
         re-readable — every joined connection builds its own reply off the
         same future)."""
         t0 = time.perf_counter()
+        # the fleet.peer chaos site is consumed by the TRANSPORT here,
+        # not fired inline: aioserver._dispatch calls faults.take() and
+        # schedules the stall on the loop timer, so an armed delay slows
+        # each request without blocking every other one on the loop
+        # (the threaded front end fires it in _post_recommend, where the
+        # sleep costs only that handler thread)
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err, None, t0, None
         trace = self._trace_begin(trace_header)
-        deadline = self._deadline_for(t0)
+        deadline, budget_ms, expired = self._effective_deadline(
+            t0, budget_header
+        )
+        if budget_ms is not None and trace is not None:
+            trace.annotate("deadline_budget_ms", round(budget_ms, 3))
+        if expired:
+            self.deadline_expired_total += 1
+            return (
+                self._degraded_response(
+                    t0, songs, "deadline-expired", trace=trace
+                ),
+                None, t0, None,
+            )
         # serve mesh (ISSUE 16): same pre-check as _post_recommend —
         # never cache/merge an answer a dark slab can't contribute to
         missing = self._mesh_missing_shards(probe=True)
@@ -1217,7 +1354,9 @@ class RecommendApp:
             )
         if self.batcher is None:
             try:
-                recs, source, cached = self.recommend_direct(songs, trace=trace)
+                recs, source, cached = self.recommend_direct(
+                    songs, trace=trace, deadline=deadline
+                )
             except Exception as exc:
                 if isinstance(exc, MeshShardUnavailable):
                     return (
@@ -1528,6 +1667,9 @@ def make_handler(app: RecommendApp):
                         method, self.path, body,
                         client_host=self.client_address[0],
                         trace_header=self.headers.get("X-KMLS-Trace"),
+                        budget_header=self.headers.get(
+                            "X-KMLS-Deadline-Budget"
+                        ),
                     )
                 except Exception:
                     logger.exception("unhandled error for %s %s", method, self.path)

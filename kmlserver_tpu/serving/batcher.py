@@ -103,6 +103,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import inspect
 import itertools
 import logging
 import math
@@ -310,6 +311,24 @@ class _Pending:
     trace: object | None = None
 
 
+def _takes_deadline(engine) -> bool:
+    """True when the engine's ``recommend_many_async`` accepts a
+    ``deadline`` kwarg (the real engine does; test fakes with the bare
+    legacy signature must keep working)."""
+    try:
+        sig = inspect.signature(engine.recommend_many_async)
+    except (TypeError, ValueError, AttributeError):
+        return False
+    return "deadline" in sig.parameters
+
+
+def _batch_deadline(batch: list[_Pending]) -> float | None:
+    """The earliest pending deadline in the batch — the budget the whole
+    device call (and any mesh hop under it) must fit inside."""
+    deadlines = [p.deadline for p in batch if p.deadline is not None]
+    return min(deadlines) if deadlines else None
+
+
 class MicroBatcher:
     def __init__(
         self,
@@ -366,6 +385,12 @@ class MicroBatcher:
         self.eject_threshold = eject_threshold
         self.probe_interval_s = probe_interval_s
         self.redispatch_max = max(0, redispatch_max)
+        # deadline propagation (ISSUE 18): engines that accept a
+        # ``deadline`` kwarg get the batch's earliest pending deadline
+        # (the mesh stamps it on peer frames as remaining budget).
+        # Detected once here so fakes with the bare legacy signature
+        # keep working untouched.
+        self._engine_takes_deadline = _takes_deadline(engine)
         self._consec_failures: dict[int, int] = {}
         self._ejected: dict[int, float] = {}  # idx -> perf_counter at eject
         self._probing: set[int] = set()  # half-open: one trial batch out
@@ -854,15 +879,17 @@ class MicroBatcher:
             try:
                 # the replica kwarg is passed only when there's a choice:
                 # single-replica engines (fakes, the native host kernel)
-                # keep the bare signature they always had
+                # keep the bare signature they always had; the deadline
+                # kwarg only when the engine declared it (deadline
+                # propagation across the mesh)
+                kwargs = {}
                 if n > 1:
-                    finish = self.engine.recommend_many_async(
-                        [p.seeds for p in batch], replica=idx
-                    )
-                else:
-                    finish = self.engine.recommend_many_async(
-                        [p.seeds for p in batch]
-                    )
+                    kwargs["replica"] = idx
+                if self._engine_takes_deadline:
+                    kwargs["deadline"] = _batch_deadline(batch)
+                finish = self.engine.recommend_many_async(
+                    [p.seeds for p in batch], **kwargs
+                )
             except Exception as exc:  # propagate, don't die
                 with self._pipe_cond:
                     self._inflight_by_replica[idx] -= 1
@@ -917,6 +944,10 @@ class MicroBatcher:
             # span recording BEFORE the futures resolve: the finishing
             # thread (app layer) must observe a complete span list when
             # the result lands (TraceContext's documented ordering)
+            # hedge outcome (ISSUE 18): the mesh finish() stamps its
+            # won/lost/cancelled decision on itself; ride it onto every
+            # traced request in the batch
+            hedged = getattr(finish, "_kmls_hedge", None)
             for pending in batch:
                 if pending.trace is not None:
                     pending.trace.span(
@@ -926,6 +957,8 @@ class MicroBatcher:
                     pending.trace.span(
                         "device", t_dispatch, t_complete, {"replica": idx},
                     )
+                    if hedged is not None:
+                        pending.trace.annotate("hedged", hedged)
             for pending, result in zip(batch, results):
                 if not pending.future.done():  # deadline may have expired it
                     pending.future.set_result(result)
@@ -1112,6 +1145,12 @@ class AsyncMicroBatcher:
         self.eject_threshold = eject_threshold
         self.probe_interval_s = probe_interval_s
         self.redispatch_max = max(0, redispatch_max)
+        # deadline propagation (ISSUE 18): engines that accept a
+        # ``deadline`` kwarg get the batch's earliest pending deadline
+        # (the mesh stamps it on peer frames as remaining budget).
+        # Detected once here so fakes with the bare legacy signature
+        # keep working untouched.
+        self._engine_takes_deadline = _takes_deadline(engine)
         self._consec_failures: dict[int, int] = {}
         self._ejected: dict[int, float] = {}
         self._probing: set[int] = set()
@@ -1392,15 +1431,17 @@ class AsyncMicroBatcher:
         t_dispatch = time.perf_counter()
         try:
             # replica kwarg only when there's a choice — single-replica
-            # engines (fakes, native host kernel) keep the bare signature
+            # engines (fakes, native host kernel) keep the bare
+            # signature; deadline only when the engine declared it
+            # (mesh deadline propagation, mirroring the threaded twin)
+            kwargs = {}
             if n > 1:
-                finish = self.engine.recommend_many_async(
-                    [p.seeds for p in batch], replica=idx
-                )
-            else:
-                finish = self.engine.recommend_many_async(
-                    [p.seeds for p in batch]
-                )
+                kwargs["replica"] = idx
+            if self._engine_takes_deadline:
+                kwargs["deadline"] = _batch_deadline(batch)
+            finish = self.engine.recommend_many_async(
+                [p.seeds for p in batch], **kwargs
+            )
         except Exception as exc:  # propagate, don't die
             self._on_replica_failure(idx, batch, exc, loop)
             if self._pending:
@@ -1434,7 +1475,7 @@ class AsyncMicroBatcher:
                 # kernel stall escalates admission before the next
                 # request is even parsed
                 self.lag_monitor.note(time.perf_counter() - t_dispatch)
-            self._resolve(batch, outcome, t_dispatch, loop, idx)
+            self._resolve(batch, outcome, t_dispatch, loop, idx, finish)
             return
 
         def run_finish():
@@ -1446,17 +1487,21 @@ class AsyncMicroBatcher:
         task = self._executor.submit(run_finish)
         task.add_done_callback(
             lambda f: loop.call_soon_threadsafe(
-                self._complete, batch, f, t_dispatch, loop, idx
+                self._complete, batch, f, t_dispatch, loop, idx, finish
             )
         )
         if self._pending:
             # overflow past max_size: keep draining
             loop.call_soon(self._flush, loop)
 
-    def _complete(self, batch, task, t_dispatch: float, loop, idx: int) -> None:
-        self._resolve(batch, task.result(), t_dispatch, loop, idx)
+    def _complete(
+        self, batch, task, t_dispatch: float, loop, idx: int, finish=None
+    ) -> None:
+        self._resolve(batch, task.result(), t_dispatch, loop, idx, finish)
 
-    def _resolve(self, batch, outcome, t_dispatch: float, loop, idx: int) -> None:
+    def _resolve(
+        self, batch, outcome, t_dispatch: float, loop, idx: int, finish=None
+    ) -> None:
         results, err = outcome
         t_complete = time.perf_counter()
         self._inflight_by_replica[idx] -= 1
@@ -1481,6 +1526,7 @@ class AsyncMicroBatcher:
                 )
             # spans recorded before the futures resolve (mirrors the
             # threaded completer's ordering contract)
+            hedged = getattr(finish, "_kmls_hedge", None)
             for pending in batch:
                 if pending.trace is not None:
                     pending.trace.span(
@@ -1490,6 +1536,8 @@ class AsyncMicroBatcher:
                     pending.trace.span(
                         "device", t_dispatch, t_complete, {"replica": idx},
                     )
+                    if hedged is not None:
+                        pending.trace.annotate("hedged", hedged)
             for pending, result in zip(batch, results):
                 if not pending.future.done():
                     pending.future.set_result(result)

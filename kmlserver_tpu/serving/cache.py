@@ -154,6 +154,14 @@ class RecommendCache:
             return key in self._lru
 
     def put(self, key: tuple, value: tuple[list[str], str]) -> None:
+        # gray-failure spine (ISSUE 18): a "degraded:<reason>" source is
+        # an answered-but-partial result (e.g. a mesh merge that dropped
+        # a straggler slab) — storing it would pin the partial answer
+        # for the key's whole cache lifetime, long past the one slow
+        # moment that produced it. Degraded answers are served, never
+        # remembered.
+        if value[1].startswith("degraded:"):
+            return
         with self._lock:
             self._lru[key] = value
             self._lru.move_to_end(key)

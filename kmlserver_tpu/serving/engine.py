@@ -385,6 +385,10 @@ class RecommendEngine:
         self.gang = mesh_mod.gang_from_config(cfg)
         self.mesh_worker = None
         self.mesh_coordinator = None
+        # answers served merged-without-a-straggler (ISSUE 18): degraded
+        # by contract, counted for /metrics (kmls_mesh_straggler_
+        # degraded_total) — stays 0 with hedging off
+        self.mesh_straggler_degraded = 0
         if self.gang is not None:
             # real-collectives wiring: on an accelerator gang this joins
             # the jax.distributed coordinator (GSPMD over DCN — the
@@ -1007,13 +1011,24 @@ class RecommendEngine:
                 self.mesh_worker.port, self.gang.rank, self.gang.size,
             )
         if self.mesh_coordinator is None:
-            self.mesh_coordinator = mesh_mod.MeshCoordinator(self.gang)
+            self.mesh_coordinator = mesh_mod.MeshCoordinator(
+                self.gang,
+                hedge=self.cfg.hedge_enabled,
+                hedge_delay_ms=self.cfg.hedge_delay_ms,
+                hedge_max_frac=self.cfg.hedge_max_frac,
+                peer_slow_ratio=self.cfg.peer_slow_ratio,
+            )
 
     def _mesh_serve_partial(self, seeds: np.ndarray):
         """Worker-side handler: run THIS rank's partial top-k for a
         peer's staged batch. Raising is the contract for 'shard not
         servable here' — the transport maps it to MeshShardUnavailable
         at the caller, which spills to the next ring peer."""
+        # gray-failure chaos hook (ISSUE 18): a delay fault here turns
+        # this gang member into the classic slow-but-alive straggler —
+        # fenced, correct, late — that the coordinator's hedge machinery
+        # must absorb without gating the merge
+        faults.fire("mesh.peer", replica=self.gang.rank if self.gang else 0)
         bundle = self.bundle
         if bundle is None or bundle.layout != "mesh":
             raise RuntimeError("no mesh bundle published on this rank")
@@ -1853,7 +1868,8 @@ class RecommendEngine:
         )
 
     def recommend_many_async(
-        self, seed_sets: list[list[str]], replica: int | None = None
+        self, seed_sets: list[list[str]], replica: int | None = None,
+        deadline: float | None = None,
     ):
         """Batched lookup split into DISPATCH (device call enqueued, returns
         immediately — jax dispatch is asynchronous) and FINISH (a zero-arg
@@ -1870,7 +1886,14 @@ class RecommendEngine:
         least-loaded dispatcher in serving/batcher.py passes it); None —
         or the native host kernel — uses the primary. Concurrent batches
         on DIFFERENT replicas run on different devices instead of
-        serializing on one in-order execution queue."""
+        serializing on one in-order execution queue.
+
+        ``deadline`` (perf_counter seconds, the batcher's earliest
+        pending deadline) propagates across the mesh as each partial
+        frame's remaining-budget field — a gang peer sheds work that
+        expired in transit instead of computing it (ISSUE 18). The
+        local device paths ignore it (their budget is enforced at the
+        app layer, as before)."""
         replicas = self.replicas
         idx = 0
         if replica is not None and replicas:
@@ -1888,7 +1911,9 @@ class RecommendEngine:
 
             return finish_fallback
         if bundle.layout == "mesh":
-            return self._mesh_recommend_async(bundle, seed_sets, idx)
+            return self._mesh_recommend_async(
+                bundle, seed_sets, idx, deadline=deadline
+            )
         if bundle.host_rule_ids is not None:
             # native host kernel: no compile, so no shape bucketing — the
             # seed array is exact-sized, built fresh (it must survive
@@ -2051,7 +2076,8 @@ class RecommendEngine:
         return finish
 
     def _mesh_recommend_async(
-        self, bundle: RuleBundle, seed_sets: list[list[str]], idx: int
+        self, bundle: RuleBundle, seed_sets: list[list[str]], idx: int,
+        deadline: float | None = None,
     ):
         """The pod-spanning dispatch/finish pair: fan the staged batch to
         every gang peer FIRST (socket I/O overlaps the local device
@@ -2081,8 +2107,15 @@ class RecommendEngine:
                 self._note_shard_dispatch(np.bincount(
                     hit // bundle.shard_size, minlength=bundle.n_shards
                 ))
+        # deadline propagation: stamp the REMAINING budget on the peer
+        # frames (computed now — staging time already spent), so a
+        # backed-up worker sheds expired partials instead of computing
+        # results nobody will wait for
+        budget_ms = None
+        if deadline is not None:
+            budget_ms = max(0.0, (deadline - time.perf_counter()) * 1e3)
         finish_remote = self.mesh_coordinator.fetch_partials(
-            arr, bundle.model_token or ""
+            arr, bundle.model_token or "", budget_ms=budget_ms
         )
         if shape not in bundle.warmed_shapes:
             self.unwarmed_dispatches += 1
@@ -2121,6 +2154,15 @@ class RecommendEngine:
             for rank, (ids_r, confs_r) in parts.items():
                 stack_ids[rank] = ids_r
                 stack_confs[rank] = confs_r
+            # hedged straggler-drop / deadline-shed (ISSUE 18): ranks the
+            # coordinator dropped contribute NOTHING to the merge — their
+            # slots get -inf confidences so the max-merge never selects
+            # them, and every answer is marked degraded (a partial
+            # catalog is a degraded answer, never a silent one)
+            dropped = getattr(finish_remote, "dropped", None) or []
+            for rank in dropped:
+                stack_ids[rank] = 0
+                stack_confs[rank] = np.float32(-np.inf)
             merged_ids, merged_confs = merge_partial_topk(
                 stack_ids, stack_confs, v=bundle.mesh_v, k_best=kb
             )
@@ -2145,6 +2187,17 @@ class RecommendEngine:
                     bundle, seeds, bool(known_rows[r]),
                     host_ids[r], host_confs[r], emb_row,
                 ))
+            if dropped:
+                # the degraded source string is the per-request side
+                # channel: the app maps it to X-KMLS-Degraded (never a
+                # 5xx) and the answer cache refuses to store it, so a
+                # recovered gang never serves a stale partial-catalog
+                # answer from cache
+                self.mesh_straggler_degraded += len(out)
+                out = [
+                    (songs, "degraded:mesh-straggler") for songs, _src in out
+                ]
+            finish._kmls_hedge = getattr(finish_remote, "hedge_outcome", None)
             return out
 
         return finish

@@ -47,7 +47,9 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass
 
 import numpy as np
@@ -184,6 +186,9 @@ class MeshWorkerServer:
                     raise
                 time.sleep(0.1)
         self.port = self._sock.getsockname()[1]
+        # partial frames shed because their deadline budget was already
+        # spent when they arrived (wasted-work, not slow-compute)
+        self.expired_on_arrival = 0
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._accept_loop, name="kmls-mesh-worker", daemon=True
@@ -244,6 +249,15 @@ class MeshWorkerServer:
             return
         if op != "partial":
             _send_frame(conn, {"ok": False, "error": f"unknown op {op!r}"})
+            return
+        budget = header.get("budget_ms")
+        if budget is not None and float(budget) <= 0.0:
+            # deadline propagation (ISSUE 18): the request's remaining
+            # budget died in transit — shed instead of computing a
+            # partial nobody will wait for. The counter distinguishes
+            # wasted-work (expired on ARRIVAL) from slow-compute.
+            self.expired_on_arrival += 1
+            _send_frame(conn, {"ok": False, "error": "deadline-expired"})
             return
         try:
             b, length = (int(x) for x in header["shape"])
@@ -316,18 +330,25 @@ class MeshPeerClient:
         return resp, body
 
     def partial(
-        self, seeds: np.ndarray, token: str
+        self, seeds: np.ndarray, token: str,
+        budget_ms: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """→ this peer slab's (B, k_best) partial for ``seeds``. The
         model token travels both ways: a peer serving a DIFFERENT
         publication (mid-rollout generation skew) must read as a
         missing shard — merging partials across epochs would be silent
-        corruption, spilling to a ring peer is a clean answer."""
+        corruption, spilling to a ring peer is a clean answer.
+        ``budget_ms`` rides the frame header (deadline propagation): a
+        worker receiving an already-expired budget sheds the partial
+        instead of computing it."""
         seeds = np.ascontiguousarray(seeds, dtype=np.int32)
-        resp, body = self._request({
+        header = {
             "op": "partial", "token": token,
             "shape": list(seeds.shape), "payload_bytes": seeds.nbytes,
-        }, seeds.tobytes())
+        }
+        if budget_ms is not None:
+            header["budget_ms"] = round(float(budget_ms), 3)
+        resp, body = self._request(header, seeds.tobytes())
         if resp.get("token") != token:
             raise MeshShardUnavailable(
                 self.rank,
@@ -365,6 +386,8 @@ class MeshCoordinator:
         self, gang: GangConfig, *,
         connect_timeout_s: float = 2.0, request_timeout_s: float = 30.0,
         probe_min_interval_s: float = 1.0, clock=time.monotonic,
+        hedge: bool = False, hedge_delay_ms: float = 30.0,
+        hedge_max_frac: float = 0.05, peer_slow_ratio: float = 0.0,
     ):
         self.gang = gang
         self.request_timeout_s = request_timeout_s
@@ -381,6 +404,37 @@ class MeshCoordinator:
         self._clock = clock
         self._probe_min_interval_s = probe_min_interval_s
         self._next_probe_at = 0.0
+        # gray-failure spine (ISSUE 18): per-rank latency tracking feeds
+        # an adaptive straggler bound — when ``hedge`` is on, a rank
+        # that hasn't answered within ~its own p95 (floored at
+        # hedge_delay_ms) is DROPPED from the merge under the
+        # deadline-degrade contract (no slab replica exists to re-issue
+        # to in the simulation transport), budget-capped by a token
+        # bucket so degrade amplification is structurally bounded.
+        # hedge=False allocates no decisions: the counters stay 0.
+        self.hedge = bool(hedge)
+        self.hedge_delay_ms = hedge_delay_ms
+        self.hedge_max_frac = hedge_max_frac
+        self._hedge_cap = max(1.0, 16.0 * hedge_max_frac)
+        self._hedge_tokens = self._hedge_cap
+        self._rank_recent: dict[int, deque] = {
+            r: deque(maxlen=64) for r in self.clients
+        }
+        self.hedge_wins = 0        # straggler dropped, merged without it
+        self.hedge_losses = 0      # straggler finished in the grace check
+        self.hedge_cancelled = 0   # budget exhausted → plain full wait
+        # slow-outlier ladder (the FleetRouter's ladder, mesh-side): a
+        # rank whose EWMA latency exceeds peer_slow_ratio × the healthy
+        # median is marked SLOW — its straggler bound collapses to the
+        # floor (hedge immediately, don't re-learn its p95 every
+        # request) until its EWMA, fed by the grace-landing and
+        # full-wait samples that double as probes, recovers under the
+        # same ratio. 0.0 (the default) disables the ladder entirely.
+        self.peer_slow_ratio = max(0.0, peer_slow_ratio)
+        self._rank_ewma: dict[int, float] = {}
+        self._rank_slow: set[int] = set()
+        self.slow_ejections = 0
+        self.slow_readmissions = 0
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, gang.size - 1),
             thread_name_prefix="kmls-mesh-fetch",
@@ -431,17 +485,86 @@ class MeshCoordinator:
 
     # -- the request fan-out ----------------------------------------------
 
-    def fetch_partials(self, seeds: np.ndarray, token: str):
+    def _rank_straggler_bound_s(self, rank: int) -> float:
+        """Per-rank adaptive straggler bound: ~p95 of its recent fetch
+        latencies, floored at ``hedge_delay_ms`` (a cold coordinator
+        must not drop ranks on noise)."""
+        floor = self.hedge_delay_ms / 1e3
+        with self._lock:
+            if rank in self._rank_slow:
+                # a slow-marked rank hedges at the floor: its own p95 IS
+                # the stall being routed around
+                return floor
+            recent = self._rank_recent.get(rank)
+            if not recent or len(recent) < 8:
+                return floor
+            ordered = sorted(recent)
+            q = ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+        return max(floor, q)
+
+    def _mark_rank_latency(self, rank: int, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            recent = self._rank_recent.get(rank)
+            if recent is None:
+                return
+            recent.append(seconds)
+            prev = self._rank_ewma.get(rank)
+            ewma = seconds if prev is None else 0.2 * seconds + 0.8 * prev
+            self._rank_ewma[rank] = ewma
+            if self.peer_slow_ratio <= 0.0 or len(recent) < 8:
+                return
+            peers = [
+                e for r, e in self._rank_ewma.items()
+                if r != rank and len(self._rank_recent[r]) >= 8
+                and r not in self._rank_slow
+            ]
+            if not peers:
+                return
+            peers.sort()
+            median = peers[len(peers) // 2]
+            bound = self.peer_slow_ratio * median
+            if rank not in self._rank_slow and ewma > bound:
+                self._rank_slow.add(rank)
+                self.slow_ejections += 1
+            elif rank in self._rank_slow and ewma <= bound:
+                self._rank_slow.discard(rank)
+                self.slow_readmissions += 1
+
+    def slow_ranks(self) -> list[int]:
+        """Ranks the slow-outlier ladder currently marks slow (sorted;
+        empty with KMLS_PEER_SLOW_RATIO=0)."""
+        with self._lock:
+            return sorted(self._rank_slow)
+
+    def fetch_partials(
+        self, seeds: np.ndarray, token: str,
+        budget_ms: float | None = None,
+    ):
         """Submit every peer's partial fetch NOW (concurrent with the
         local slab's device dispatch); the returned ``finish()`` blocks
         and yields ``{rank: (ids, confs)}`` or raises
         :class:`MeshShardUnavailable` for the first dead rank. The
         seeds array is serialized up front — the engine's staging
         buffer may be reused by the next batch before the pool thread
-        runs."""
+        runs.
+
+        ``budget_ms`` (deadline propagation) rides each partial frame so
+        a backed-up worker sheds expired work instead of computing it.
+
+        With ``hedge`` armed, a rank that hasn't answered within its
+        adaptive straggler bound is dropped from the merge (one token
+        from the hedge budget): ``finish.dropped`` lists the dropped
+        ranks — the engine merges without them and marks the answers
+        degraded — and ``finish.hedge_outcome`` carries
+        ``won``/``lost``/``cancelled`` for the trace span. A dropped
+        rank is NOT blamed as missing: it is alive, just late."""
         payload = np.ascontiguousarray(seeds, dtype=np.int32).copy()
+        t_submit = time.monotonic()
         futures = {
-            rank: self._pool.submit(client.partial, payload, token)
+            rank: self._pool.submit(
+                client.partial, payload, token, budget_ms
+            )
             for rank, client in self.clients.items()
         }
 
@@ -449,12 +572,95 @@ class MeshCoordinator:
             out: dict[int, tuple[np.ndarray, np.ndarray]] = {}
             failed: MeshShardUnavailable | None = None
             for rank, future in sorted(futures.items()):
+                timeout = self.request_timeout_s + 5.0
+                if self.hedge:
+                    bound = self._rank_straggler_bound_s(rank)
+                    remaining = (t_submit + bound) - time.monotonic()
+                    try:
+                        out[rank] = future.result(
+                            timeout=max(0.0, remaining)
+                        )
+                        self._mark_rank_latency(
+                            rank, time.monotonic() - t_submit
+                        )
+                        self._note_serving(rank)
+                        continue
+
+                    except FutureTimeoutError:
+                        with self._lock:
+                            has_token = self._hedge_tokens >= 1.0
+                            if has_token:
+                                self._hedge_tokens -= 1.0
+                        if has_token:
+                            # the "re-issue" equivalent when no slab
+                            # replica exists: one short grace (the cost
+                            # a hedge copy would have paid), then merge
+                            # WITHOUT the straggler — it is alive, just
+                            # late, so degrade, don't blame
+                            grace = min(
+                                0.25 * max(bound, 1e-3), 0.025
+                            )
+                            try:
+                                out[rank] = future.result(timeout=grace)
+                            except FutureTimeoutError:
+                                finish.dropped.append(rank)
+                                self.hedge_wins += 1
+                                continue
+                            except MeshShardUnavailable as exc:
+                                if exc.reason == "deadline-expired":
+                                    finish.dropped.append(rank)
+                                    continue
+                                self._note_missing(rank, exc.reason)
+                                failed = failed or exc
+                                continue
+                            # the straggler slipped in under the grace:
+                            # its answer is used, the token refunded
+                            with self._lock:
+                                self._hedge_tokens = min(
+                                    self._hedge_tokens + 1.0,
+                                    self._hedge_cap,
+                                )
+                            self.hedge_losses += 1
+                            finish.hedge_outcome = "lost"
+                            self._mark_rank_latency(
+                                rank, time.monotonic() - t_submit
+                            )
+                            self._note_serving(rank)
+                            continue
+                        # budget exhausted: plain waiting, the
+                        # pre-hedge behavior exactly
+                        self.hedge_cancelled += 1
+                        finish.hedge_outcome = "cancelled"
+                    except MeshShardUnavailable as exc:
+                        if exc.reason == "deadline-expired":
+                            # the worker shed expired work — that is
+                            # deadline propagation doing its job, not a
+                            # sick shard
+                            finish.dropped.append(rank)
+                            continue
+                        self._note_missing(rank, exc.reason)
+                        failed = failed or exc
+                        continue
+                    except Exception as exc:
+                        wrapped = MeshShardUnavailable(
+                            rank, f"{type(exc).__name__}: {exc}"
+                        )
+                        self._note_missing(rank, wrapped.reason)
+                        failed = failed or wrapped
+                        continue
                 try:
-                    out[rank] = future.result(
-                        timeout=self.request_timeout_s + 5.0
-                    )
+                    out[rank] = future.result(timeout=timeout)
+                    if self.hedge:
+                        # the cancelled fall-through: the straggler was
+                        # waited out plain-style (budget exhausted)
+                        self._mark_rank_latency(
+                            rank, time.monotonic() - t_submit
+                        )
                     self._note_serving(rank)
                 except MeshShardUnavailable as exc:
+                    if self.hedge and exc.reason == "deadline-expired":
+                        finish.dropped.append(rank)
+                        continue
                     self._note_missing(rank, exc.reason)
                     failed = failed or exc
                 except Exception as exc:  # pool/timeout faults
@@ -465,6 +671,10 @@ class MeshCoordinator:
                     failed = failed or wrapped
             if failed is not None:
                 raise failed
+            if finish.dropped and finish.hedge_outcome is None:
+                finish.hedge_outcome = "won"
             return out
 
+        finish.dropped = []
+        finish.hedge_outcome = None
         return finish

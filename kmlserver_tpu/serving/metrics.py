@@ -101,6 +101,30 @@ METRIC_REGISTRY: dict[str, str] = {
     "kmls_replicas_ejected": "gauge:serving",
     "kmls_utilization": "gauge:serving",
     "kmls_admission_degrade_total": "counter:serving",
+    # --- serving: gray-failure spine (ISSUE 18) ---
+    # deadline propagation: requests whose forwarded
+    # X-KMLS-Deadline-Budget arrived already spent (answered degraded,
+    # counted as wasted-work — distinct from slow-compute "deadline"
+    # degrades), and the mesh-worker twin (partial frames shed before
+    # compute because their budget field was ≤ 0 on arrival)
+    "kmls_deadline_expired_total": "counter:serving",
+    "kmls_mesh_expired_on_arrival_total": "counter:serving",
+    # hedged mesh dispatch (KMLS_HEDGE): straggler outcomes — won
+    # (merged without the late rank), lost (it landed in the grace
+    # re-check; token refunded), cancelled (hedge budget exhausted →
+    # plain waiting). All pinned 0 with the knob off (zero-cost proof).
+    "kmls_hedge_wins_total": "counter:serving",
+    "kmls_hedge_losses_total": "counter:serving",
+    "kmls_hedge_cancelled_total": "counter:serving",
+    # slow-outlier ladder (KMLS_PEER_SLOW_RATIO): gang ranks ejected
+    # for EWMA latency over ratio×healthy-median, re-admissions after
+    # recovery, and how many ranks are slow-marked right now
+    "kmls_peer_slow_ejections_total": "counter:serving",
+    "kmls_peer_slow_readmissions_total": "counter:serving",
+    "kmls_peer_slow": "gauge:serving",
+    # merges answered without a straggler slab's candidates (each one
+    # also counts kmls_degraded_total{reason="mesh-straggler"})
+    "kmls_mesh_straggler_degraded_total": "counter:serving",
     # --- serving: continuous freshness (ISSUE 10) ---
     # delta bundles applied in place vs rejected (torn/wrong-base/
     # injected), the chain position serving ((base, delta_seq) epoch

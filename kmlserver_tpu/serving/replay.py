@@ -56,6 +56,15 @@ import time
 
 import numpy as np
 
+# Hedged-dispatch zero-cost pin (ISSUE 18): every hedge copy
+# replay_fleet_http actually issues increments this module counter, and
+# NOTHING else touches it — so ``hedge=False`` (the default, mirroring
+# KMLS_HEDGE=0) is proven zero-cost the same way the SpanRecorder's
+# ``began`` counter proves tracing-off allocates nothing: tests pin it
+# at 0 across a full no-hedge replay, and the bench control leg asserts
+# it stayed 0 under real traffic.
+HEDGES_ISSUED = 0
+
 
 @dataclasses.dataclass
 class ReplayReport:
@@ -847,6 +856,11 @@ def replay_fleet_http(
     redispatch_max: int = 4,
     window_end: int | None = None,
     events: list | None = None,
+    hedge: bool = False,
+    hedge_delay_ms: float = 30.0,
+    hedge_max_frac: float = 0.05,
+    slow_ratio: float = 0.0,
+    deadline_ms: float = 0.0,
 ) -> tuple[ReplayReport, dict]:
     """Open-loop HTTP replay against an N-replica FLEET with client-side
     consistent-hash routing (ISSUE 15) — the load generator half of the
@@ -879,7 +893,30 @@ def replay_fleet_http(
     the pre-kill window so the kill's cold remap doesn't blur the
     routed-vs-independent comparison). → ``(ReplayReport, fleet)`` where
     ``fleet`` carries hit ratios, per-peer answer counts, 5xx/reroute/
-    ejection counters, and owner-stamped (misrouted) observations."""
+    ejection counters, and owner-stamped (misrouted) observations.
+
+    **Gray-failure spine** (ISSUE 18):
+
+    - ``slow_ratio > 0`` arms the router's slow-outlier ladder: every
+      primary answer feeds ``FleetRouter.mark_latency`` and a peer whose
+      EWMA exceeds ``slow_ratio ×`` the healthy median is ejected like a
+      failing one (``slow_ejections`` in the fleet dict).
+    - ``hedge=True`` arms hedged dispatch: after a per-peer adaptive
+      delay (tracked ~p95, floored at ``hedge_delay_ms``) an unanswered
+      request re-issues ONCE to the next-ranked peer; first valid answer
+      wins, the loser is discarded on arrival (the pipelined-HTTP form
+      of cancellation), and winner/loser bodies are digest-compared —
+      ``hedge_mismatch`` must stay 0 because fleet peers serve the same
+      artifacts. Hedges spend a token bucket earning ``hedge_max_frac``
+      per primary dispatch (amplification structurally bounded); an
+      empty bucket counts ``hedges_suppressed`` and falls back to plain
+      waiting. ``hedge=False`` is proven zero-cost via the module
+      :data:`HEDGES_ISSUED` counter.
+    - ``deadline_ms > 0`` stamps the remaining budget on every request
+      as ``X-KMLS-Deadline-Budget`` (computed at WRITE time, so queue
+      wait and hedge delay are already spent); servers answering
+      degraded with ``deadline-expired`` are counted separately from
+      slow-compute degradation (``deadline_expired``)."""
     import asyncio
     import urllib.parse
 
@@ -892,15 +929,20 @@ def replay_fleet_http(
         peers,
         eject_threshold=eject_threshold,
         probe_interval_s=probe_interval_s,
+        slow_ratio=slow_ratio,
     )
     addr: dict[str, tuple[str, int]] = {}
     for peer, url in peer_urls.items():
         u = urllib.parse.urlsplit(url)
         addr[peer] = (u.hostname or "127.0.0.1", u.port or 80)
     keys = [seeds_key(p) for p in payloads]
+    # dynamic heads (deadline budget stamped at WRITE time, hedge copies
+    # marked) are assembled per send; the pre-encoded fast path stays
+    # byte-identical to the pre-ISSUE-18 replay whenever both are off
+    dynamic_head = hedge or deadline_ms > 0
+    bodies = [json.dumps({"songs": seeds}).encode() for seeds in payloads]
     reqs: list[bytes] = []
-    for seeds in payloads:
-        body = json.dumps({"songs": seeds}).encode()
+    for body in bodies:
         reqs.append(
             b"POST /api/recommend/ HTTP/1.1\r\nHost: replay\r\n"
             b"Content-Type: application/json\r\nContent-Length: "
@@ -918,8 +960,51 @@ def replay_fleet_http(
     stats = {
         "http_5xx": 0, "owner_stamped": 0, "rerouted": 0, "errors": 0,
         "win_total": 0, "win_hits": 0, "mesh_unavailable": 0,
+        "hedges_issued": 0, "hedge_wins": 0, "hedge_losses": 0,
+        "hedges_suppressed": 0, "hedge_mismatch": 0, "deadline_expired": 0,
     }
     answered_by = {p: 0 for p in peers}
+    # per-request single-winner state (hedging races two copies):
+    # answered flags gate the discard path, digests back the bit-identity
+    # check, hedged marks indices whose hedge copy actually went out
+    answered = bytearray(len(payloads))
+    digests: dict[int, bytes] = {}
+    hedged: set[int] = set()
+    # token bucket: earns hedge_max_frac per primary dispatch, spends
+    # 1.0 per hedge, starts full at a small burst cap — extra dispatches
+    # are structurally bounded at hedge_max_frac of total (+ the cap)
+    hedge_cap = max(1.0, 16.0 * hedge_max_frac)
+    hedge_tokens = [hedge_cap]
+
+    def _bdigest(payload: bytes) -> bytes:
+        import hashlib
+
+        return hashlib.blake2b(payload, digest_size=8).digest()
+
+    def _wire(item) -> bytes:
+        """Request bytes for one copy — the pre-encoded fast path, or a
+        head rebuilt at write time carrying the remaining deadline
+        budget (what's left NOW, queue wait already spent) and the
+        hedge marker."""
+        t_arr, idx, _attempts, is_hedge = item
+        if not dynamic_head:
+            return reqs[idx]
+        extra = b""
+        if deadline_ms > 0:
+            remaining = deadline_ms - (time.perf_counter() - t_arr) * 1e3
+            extra += (
+                b"X-KMLS-Deadline-Budget: "
+                + str(max(0, int(remaining))).encode() + b"\r\n"
+            )
+        if is_hedge:
+            extra += b"X-KMLS-Hedge: 1\r\n"
+        body = bodies[idx]
+        return (
+            b"POST /api/recommend/ HTTP/1.1\r\nHost: replay\r\n"
+            b"Content-Type: application/json\r\n" + extra
+            + b"Content-Length: " + str(len(body)).encode()
+            + b"\r\n\r\n" + body
+        )
 
     async def _run() -> None:
         queues = {p: asyncio.Queue(maxsize=max_queue) for p in peers}
@@ -945,7 +1030,12 @@ def replay_fleet_http(
             routed leg and inflate the baseline hit ratio the multiplier
             is judged against — so it retries on the next peer in fixed
             cyclic order instead."""
-            t_arr, idx, attempts = item
+            t_arr, idx, attempts, is_hedge = item
+            if hedge and answered[idx]:
+                # the other copy of a hedged pair already won: this
+                # copy's failure is moot — drop it, no retry, no error
+                _leave()
+                return
             if attempts >= redispatch_max:
                 stats["errors"] += 1
                 _leave()
@@ -957,18 +1047,39 @@ def replay_fleet_http(
                 target = peers[(peers.index(failed_peer) + step) % len(peers)]
             stats["rerouted"] += 1
             try:
-                queues[target].put_nowait((t_arr, idx, attempts + 1))
+                queues[target].put_nowait((t_arr, idx, attempts + 1, is_hedge))
             except asyncio.QueueFull:
                 stats["errors"] += 1
                 _leave()
 
-        def _account(peer: str, item, status: int, head_lower: bytes) -> bool:
+        def _account(
+            peer: str, item, status: int, head_lower: bytes,
+            payload: bytes = b"",
+        ) -> bool:
             """Account one response → True when it was the gang-degraded
             refusal (the caller must NOT mark_success for a burst that
             carried one: transport-level success with every answer a
             mesh refusal would re-admit the gang and wipe the shard
             blame while the member is still dark)."""
-            t_arr, idx, _attempts = item
+            t_arr, idx, attempts, is_hedge = item
+            if hedge and answered[idx]:
+                # losing copy of a hedged pair: its answer is DISCARDED
+                # (first valid answer already won) — but it is still a
+                # real observation: a 200 body must be bit-identical to
+                # the winner's, a late primary still feeds the slow
+                # ladder, and a server 5xx is still a server 5xx
+                if status == 200:
+                    want = digests.get(idx)
+                    if want is not None and _bdigest(payload) != want:
+                        stats["hedge_mismatch"] += 1
+                    if not is_hedge and attempts == 0:
+                        router.mark_latency(
+                            peer, time.perf_counter() - t_arr
+                        )
+                elif status >= 500 and b"x-kmls-mesh-unavailable:" not in head_lower:
+                    stats["http_5xx"] += 1
+                _leave()
+                return False
             if status == 503 and b"x-kmls-mesh-unavailable:" in head_lower:
                 # gang-degraded (ISSUE 16): the peer is a pod-gang
                 # missing a member and REFUSED rather than serve a
@@ -995,16 +1106,35 @@ def replay_fleet_http(
                 stats["errors"] += 1
                 _leave()
                 return False
-            dt_ms = (time.perf_counter() - t_arr) * 1e3
+            dt_s = time.perf_counter() - t_arr
+            dt_ms = dt_s * 1e3
             lat_ms.append(dt_ms)
             hit = b"x-kmls-cache: hit" in head_lower
             (lat_cached if hit else lat_uncached).append(dt_ms)
             if b"x-kmls-cache-owner:" in head_lower:
                 stats["owner_stamped"] += 1
+            if b"x-kmls-degraded: deadline-expired" in head_lower:
+                # the deadline budget died in transit: the peer answered
+                # degraded instead of computing a result nobody waits
+                # for — wasted-work avoided, distinct from slow-compute
+                stats["deadline_expired"] += 1
             if window_end is not None and idx < window_end:
                 stats["win_total"] += 1
                 stats["win_hits"] += int(hit)
             answered_by[peer] += 1
+            # latency health: first-attempt primaries are clean
+            # arrival→answer observations of the peer that served them
+            # (retried/hedge copies would double-blame)
+            if not is_hedge and attempts == 0:
+                router.mark_latency(peer, dt_s)
+            if hedge:
+                answered[idx] = 1
+                if idx in hedged:
+                    digests[idx] = _bdigest(payload)
+                    if is_hedge:
+                        stats["hedge_wins"] += 1
+                    else:
+                        stats["hedge_losses"] += 1
             _leave()
             return False
 
@@ -1044,14 +1174,14 @@ def replay_fleet_http(
                 done = 0
                 burst_mesh_degraded = False
                 try:
-                    writer.write(b"".join(reqs[i] for _, i, _a in burst))
+                    writer.write(b"".join(_wire(it) for it in burst))
                     for it in burst:
                         head = await reader.readuntil(b"\r\n\r\n")
                         status, clen, head_lower = _parse_http_head(head)
-                        await reader.readexactly(clen)
+                        payload = await reader.readexactly(clen)
                         done += 1
                         burst_mesh_degraded |= _account(
-                            peer, it, status, head_lower
+                            peer, it, status, head_lower, payload
                         )
                     if not burst_mesh_degraded:
                         # gang-degraded refusals in the burst leave the
@@ -1074,11 +1204,50 @@ def replay_fleet_http(
                         pass
                     reader = writer = None
 
+        async def _hedge_after(idx: int, t_arr: float, primary: str) -> None:
+            """One hedge audition for request ``idx``: sleep the
+            adaptive per-peer delay, then — still unanswered and budget
+            permitting — issue ONE copy to the next-ranked peer. First
+            valid answer wins; the loser is discarded on arrival."""
+            global HEDGES_ISSUED
+            delay = router.hedge_delay_s(primary, hedge_delay_ms / 1e3)
+            wait = (t_arr + delay) - time.perf_counter()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            if answered[idx]:
+                return
+            if hedge_tokens[0] < 1.0:
+                # amplification bound: no token, no hedge — the request
+                # falls back to plain waiting on its primary
+                stats["hedges_suppressed"] += 1
+                return
+            if policy == "ring":
+                target = next(
+                    (p for p in router.ring.ranked(keys[idx]) if p != primary),
+                    None,
+                )
+            else:
+                target = peers[(peers.index(primary) + 1) % len(peers)]
+            if target is None or target == primary:
+                return
+            hedge_tokens[0] -= 1.0
+            HEDGES_ISSUED += 1
+            stats["hedges_issued"] += 1
+            hedged.add(idx)
+            _enter()
+            try:
+                queues[target].put_nowait((t_arr, idx, 0, True))
+            except asyncio.QueueFull:
+                hedged.discard(idx)
+                stats["hedges_suppressed"] += 1
+                _leave()
+
         workers = [
             asyncio.create_task(worker(p))
             for p in peers
             for _ in range(n_conns)
         ]
+        hedge_tasks: list = []
         fired: set = set()
         t0 = time.perf_counter()
         for i in range(len(payloads)):
@@ -1097,10 +1266,20 @@ def replay_fleet_http(
             )
             _enter()
             try:
-                queues[target].put_nowait((t0 + arrival[i], i, 0))
+                queues[target].put_nowait((t0 + arrival[i], i, 0, False))
             except asyncio.QueueFull:
                 stats["errors"] += 1
                 _leave()
+                continue
+            if hedge:
+                hedge_tokens[0] = min(
+                    hedge_tokens[0] + hedge_max_frac, hedge_cap
+                )
+                hedge_tasks.append(
+                    asyncio.create_task(
+                        _hedge_after(i, t0 + arrival[i], target)
+                    )
+                )
         # every request is answered, errored, or re-dispatched before the
         # pool shuts down — re-dispatches re-enter a queue, so sentinels
         # can only go out once the in-flight count settles to zero
@@ -1112,8 +1291,19 @@ def replay_fleet_http(
             stats["errors"] += max(outstanding[0], 0)
             for w in workers:
                 w.cancel()
-            await asyncio.gather(*workers, return_exceptions=True)
+            for h in hedge_tasks:
+                h.cancel()
+            await asyncio.gather(
+                *workers, *hedge_tasks, return_exceptions=True
+            )
             return
+        # drained ⇒ every logical request resolved: still-sleeping hedge
+        # auditions are moot — cancel before the sentinels go out so a
+        # late hedge can't race a closing queue
+        for h in hedge_tasks:
+            h.cancel()
+        if hedge_tasks:
+            await asyncio.gather(*hedge_tasks, return_exceptions=True)
         for p in peers:
             for _ in range(n_conns):
                 queues[p].put_nowait(None)
@@ -1157,6 +1347,13 @@ def replay_fleet_http(
         "owner_stamped": stats["owner_stamped"],
         "mesh_unavailable": stats["mesh_unavailable"],
         "failed_shards": router.failed_shards(),
+        "slow_ejections": router.slow_ejections,
+        "hedges_issued": stats["hedges_issued"],
+        "hedge_wins": stats["hedge_wins"],
+        "hedge_losses": stats["hedge_losses"],
+        "hedges_suppressed": stats["hedges_suppressed"],
+        "hedge_mismatch": stats["hedge_mismatch"],
+        "deadline_expired": stats["deadline_expired"],
     }
     return report, fleet
 

@@ -91,13 +91,24 @@ def join_timeline(client: dict, server: dict | None) -> dict:
         "server": None,
     }
     if server is not None:
+        attrs = server.get("attrs", {})
         out["server"] = {
             "status": server.get("status"),
             "start_unix": server.get("start_unix"),
             "duration_ms": server.get("duration_ms"),
-            "attrs": server.get("attrs", {}),
+            "attrs": attrs,
             "spans": server.get("spans", []),
         }
+        # gray-failure spine (ISSUE 18): the hedge outcome
+        # (won/lost/cancelled) and the forwarded deadline budget ride
+        # span attrs — lift them to first-class fields so a jq over the
+        # timeline can split hedged tails from plain ones without
+        # knowing the attr names
+        if isinstance(attrs, dict):
+            if "hedged" in attrs:
+                out["hedged"] = attrs["hedged"]
+            if "deadline_budget_ms" in attrs:
+                out["deadline_budget_ms"] = attrs["deadline_budget_ms"]
         rtt = client.get("client_rtt_ms")
         dur = server.get("duration_ms")
         if rtt is not None and dur is not None:
@@ -134,16 +145,21 @@ def main(argv: list[str] | None = None) -> int:
     # id is driving the propagation path on purpose)
     by_id = {t["trace_id"]: t for t in server_traces}
     joined = 0
+    hedged = 0
     for rec in client_records:
         server = by_id.get(rec["trace_id"])
         if server is None and not args.all:
             continue
-        print(json.dumps(join_timeline(rec, server)))
+        timeline = join_timeline(rec, server)
+        print(json.dumps(timeline))
         if server is not None:
             joined += 1
+            if timeline.get("hedged") is not None:
+                hedged += 1
     print(
         f"kmls-tracejoin: {joined}/{len(client_records)} client records "
         f"joined against {len(server_traces)} retained server traces"
+        + (f", {hedged} hedged" if hedged else "")
         + ("" if joined or not client_records else
            " (tail-based retention keeps only shed/degraded/error/"
            "slowest-N + a sampled slice — raise KMLS_TRACE_SAMPLE or "

@@ -1052,13 +1052,16 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     refs = collect_code_knobs(index, cfg)
     assert len(refs) >= 70 and set(refs) <= set(scopes)
     env_map = collect_fault_env_map(index, cfg)
-    assert len(env_map) == 8, env_map
+    assert len(env_map) == 10, env_map
     assert env_map["KMLS_FAULT_EMBED_CORRUPT"][0] == "embed.artifact"
     assert env_map["KMLS_FAULT_DELTA_CORRUPT"][0] == "delta.apply"
+    # the gray-failure delay sites (ISSUE 18)
+    assert env_map["KMLS_FAULT_FLEET_PEER_DELAY_MS"][0] == "fleet.peer"
+    assert env_map["KMLS_FAULT_MESH_PEER_DELAY_MS"][0] == "mesh.peer"
     sites = collect_fire_sites(index, cfg)
     assert {
         "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact",
-        "delta.apply",
+        "delta.apply", "fleet.peer", "mesh.peer",
     } <= sites
     # checker 7 anchors (ISSUE 9): the registry parses without import,
     # both exposition modules are indexed, and the dynamic robustness

@@ -533,6 +533,23 @@ class TestTpuSuiteWiring:
             "answered_by": {"gang": 2012, "solo": 1988},
             "platform": "cpu",
         },
+        "slowpeer": {
+            "qps": 32.0, "requests": 600, "stall_ms": 200,
+            "control_p50_ms": 6.1, "control_p99_ms": 260.8,
+            "hedged_p50_ms": 5.9, "hedged_p99_ms": 22.4,
+            "p99_ratio": 11.63, "hedge_overhead_pct": 4.0,
+            "hedges_issued": 12, "hedge_wins": 12, "hedge_losses": 0,
+            "hedges_suppressed": 0, "hedge_mismatch": 0,
+            "slow_ejections": 1, "deadline_expired": 0,
+            "server_deadline_expired": 0, "control_hedges_issued": 0,
+            "control_http_5xx": 0, "control_errors": 0,
+            "http_5xx": 0, "errors": 0, "identity_ok": True,
+            "mesh_requests": 300, "mesh_hedge_wins": 8,
+            "mesh_hedge_cancelled": 7, "mesh_straggler_degraded": 8,
+            "mesh_expired_on_arrival": 0, "mesh_p99_ms": 1502.0,
+            "mesh_http_5xx": 0, "mesh_errors": 0,
+            "platform": "cpu",
+        },
         "quality": {
             "recall_rules": 0.27, "recall_embed": 0.41,
             "recall_blend": 0.41, "recall_blend_best": 0.43,
@@ -640,6 +657,16 @@ class TestTpuSuiteWiring:
         assert final["meshserve_errors"] == 0
         assert final["meshserve_mesh_unavailable"] == 9
         assert final["meshserve_platform"] == "cpu"
+        # ... and the gray-failure slowpeer bracket (ISSUE 18)
+        assert final["slowpeer_p99_ratio"] == 11.63
+        assert final["slowpeer_hedge_overhead_pct"] == 4.0
+        assert final["slowpeer_hedge_mismatch"] == 0
+        assert final["slowpeer_control_hedges_issued"] == 0
+        assert final["slowpeer_http_5xx"] == 0
+        assert final["slowpeer_identity_ok"] is True
+        assert final["slowpeer_mesh_hedge_wins"] == 8
+        assert final["slowpeer_mesh_straggler_degraded"] == 8
+        assert final["slowpeer_platform"] == "cpu"
         # ... and so does the quality-loop bracket (ISSUE 14)
         assert final["quality_recall_blend"] == 0.43
         assert final["quality_weight_roundtrip"] is True
@@ -1111,7 +1138,7 @@ class TestBenchStateResume:
             "loadshape_cpu", "loadshape_pred_cpu", "mine_resume_cpu",
             "als_hybrid_cpu",
             "confserve_cpu", "scale_sparse_cpu", "quality_cpu",
-            "meshserve_cpu",
+            "meshserve_cpu", "slowpeer_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()

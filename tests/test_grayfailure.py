@@ -1,0 +1,501 @@
+"""Gray-failure spine tests (ISSUE 18): latency-aware peer health,
+hedged dispatch, and cross-hop deadline propagation.
+
+A gray-failed peer is slow-but-alive — every answer is a 200, just
+late — so it never trips the error breakers PR 15/16 built. These
+tests pin the three layers that route around it:
+
+- the FleetRouter's slow-outlier ladder (EWMA vs healthy-median,
+  ejection sharing the failure breaker's spill/probe machinery,
+  re-admission ONLY by a fast probe latency sample);
+- the MeshCoordinator's hedged merge (straggler dropped under the
+  deadline-degrade contract, token-bucket budget, plain waiting when
+  the budget is dry) and the worker's expired-budget shed;
+- the app front ends' X-KMLS-Deadline-Budget handling (expired on
+  arrival answers degraded, never 5xx; malformed headers are ignored)
+  plus the jittered integer Retry-After on the mesh 503.
+
+Everything latency-laddered runs on an injected fake clock where the
+ladder itself is under test; socket tests use stalls long enough that
+scheduler noise cannot flip the outcome.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kmlserver_tpu import faults
+from kmlserver_tpu.config import ServingConfig
+from kmlserver_tpu.freshness.ring import FleetRouter
+from kmlserver_tpu.serving import replay
+from kmlserver_tpu.observability.trace import SpanRecorder
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.batcher import MicroBatcher
+from kmlserver_tpu.serving.cache import RecommendCache
+from kmlserver_tpu.serving.mesh import (
+    GangConfig,
+    MeshCoordinator,
+    MeshPeerClient,
+    MeshShardUnavailable,
+    MeshWorkerServer,
+)
+
+
+def _key_owned_by(router: FleetRouter, peer: str) -> str:
+    for i in range(2000):
+        key = f"key-{i}"
+        if router.ring.ranked(key)[0] == peer:
+            return key
+    raise AssertionError(f"no key rendezvous-owned by {peer!r}")
+
+
+def _sleepy_partial(delay_s: float, token: str = "tok"):
+    def serve(seeds: np.ndarray):
+        if delay_s:
+            time.sleep(delay_s)
+        ids = np.maximum(seeds, 0).astype(np.int32)
+        confs = np.zeros(seeds.shape, dtype=np.float32)
+        return ids, confs, token
+
+    return serve
+
+
+def _start_worker(serve, token: str = "tok") -> MeshWorkerServer:
+    return MeshWorkerServer(
+        serve, lambda: {"rank": 0, "token": token},
+        host="127.0.0.1", port=0,
+    ).start()
+
+
+class TestSlowPeerLadder:
+    """FleetRouter's gray-failure ladder on a fake clock: slowness and
+    sickness converge on ONE peer-state machine, but re-admission for
+    slowness needs a fast probe SAMPLE — success is no evidence."""
+
+    def _slow_c_router(self, clock):
+        router = FleetRouter(
+            ["a", "b", "c"], slow_ratio=3.0, probe_interval_s=5.0,
+            clock=lambda: clock[0],
+        )
+        for _ in range(10):
+            router.mark_latency("a", 0.01)
+            router.mark_latency("b", 0.01)
+        for _ in range(8):
+            router.mark_latency("c", 0.1)
+        return router
+
+    def test_ewma_converges_on_observed_latency(self):
+        router = FleetRouter(["a", "b"])
+        for _ in range(30):
+            router.mark_latency("a", 0.05)
+        assert router.peer_latency_s("a") == pytest.approx(0.05)
+        assert router.peer_latency_s("b") == 0.0
+
+    def test_slow_outlier_ejected_against_healthy_median(self):
+        clock = [0.0]
+        router = self._slow_c_router(clock)
+        # c's EWMA (0.1) > 3.0 x healthy median (0.01): slow-ejected
+        assert router.slow_peers() == ["c"]
+        assert router.ejected_peers() == ["c"]
+        assert router.slow_ejections == 1
+        assert router.ejections == 1
+        # its keys spill to the next rendezvous weight, like any ejection
+        key = _key_owned_by(router, "c")
+        assert router.route(key) != "c"
+        assert router.spills >= 1
+
+    def test_mark_success_does_not_readmit_slow_peer(self):
+        clock = [0.0]
+        router = self._slow_c_router(clock)
+        router.mark_success("c")  # a gray failure still answers 200
+        assert router.slow_peers() == ["c"]
+        assert router.ejected_peers() == ["c"]
+        assert router.readmissions == 0
+
+    def test_fast_probe_sample_readmits_and_resets_ewma(self):
+        clock = [0.0]
+        router = self._slow_c_router(clock)
+        key = _key_owned_by(router, "c")
+        clock[0] = 10.0  # past the probe timer armed at ejection (5.0)
+        assert router.route(key) == "c"  # half-open: ONE audition
+        router.mark_latency("c", 0.01)  # the probe's own sample is fast
+        assert router.slow_peers() == []
+        assert router.ejected_peers() == []
+        assert router.readmissions == 1
+        # EWMA reset to the probe sample: the stale slow history must
+        # not instantly re-eject the recovered peer
+        assert router.peer_latency_s("c") == pytest.approx(0.01)
+
+    def test_still_slow_probe_rearms_the_timer(self):
+        clock = [0.0]
+        router = self._slow_c_router(clock)
+        key = _key_owned_by(router, "c")
+        clock[0] = 10.0
+        assert router.route(key) == "c"
+        router.mark_latency("c", 0.2)  # audition failed: still slow
+        assert router.slow_peers() == ["c"]
+        # timer re-armed to 15.0: same clock instant spills again
+        assert router.route(key) != "c"
+        clock[0] = 16.0
+        assert router.route(key) == "c"
+
+    def test_hedge_delay_floor_until_sampled_then_quantile(self):
+        router = FleetRouter(["a", "b"])
+        # cold window: the floor stands alone
+        assert router.hedge_delay_s("a", 0.03) == 0.03
+        for _ in range(10):
+            router.mark_latency("a", 0.01)
+        for _ in range(10):
+            router.mark_latency("a", 0.05)
+        # ~p95 of the recent window, floored
+        assert router.hedge_delay_s("a", 0.0) == pytest.approx(0.05)
+        assert router.hedge_delay_s("a", 0.2) == 0.2
+
+    def test_ratio_zero_tracks_but_never_ejects(self):
+        router = FleetRouter(["a", "b"], slow_ratio=0.0)
+        for _ in range(20):
+            router.mark_latency("a", 0.01)
+            router.mark_latency("b", 1.0)
+        assert router.ejected_peers() == []
+        assert router.slow_peers() == []
+        # the hedge-delay quantile still sees the samples
+        assert router.hedge_delay_s("b", 0.0) == pytest.approx(1.0)
+
+
+class TestMeshHedge:
+    """MeshCoordinator's merge-without-the-straggler: first valid
+    answer wins, budget-capped, and a dropped rank is late — never
+    blamed as missing."""
+
+    def test_straggler_dropped_is_a_hedge_win(self):
+        worker = _start_worker(_sleepy_partial(0.25))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+            hedge=True, hedge_delay_ms=20.0,
+        )
+        try:
+            seeds = np.array([[1, 2]], dtype=np.int32)
+            finish = coord.fetch_partials(seeds, "tok")
+            out = finish()
+            assert finish.dropped == [0]
+            assert finish.hedge_outcome == "won"
+            assert coord.hedge_wins == 1
+            assert 0 not in out
+            # alive-but-late: the straggler is NOT noted missing, so the
+            # gang never reads degraded to /readyz over one slow moment
+            assert coord.missing_shards() == []
+        finally:
+            coord.close()
+            worker.stop()
+
+    def test_exhausted_budget_waits_plain_and_answers_identically(self):
+        worker = _start_worker(_sleepy_partial(0.06))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+            hedge=True, hedge_delay_ms=10.0,
+        )
+        coord._hedge_tokens = 0.0  # amplification bound hit
+        try:
+            seeds = np.array([[3, -1]], dtype=np.int32)
+            finish = coord.fetch_partials(seeds, "tok")
+            out = finish()
+            assert finish.dropped == []
+            assert finish.hedge_outcome == "cancelled"
+            assert coord.hedge_cancelled == 1
+            assert coord.hedge_wins == 0
+            # the pre-hedge behavior exactly: full answer, bit-identical
+            np.testing.assert_array_equal(
+                out[0][0], np.maximum(seeds, 0).astype(np.int32)
+            )
+        finally:
+            coord.close()
+            worker.stop()
+
+    def test_worker_sheds_expired_budget_on_arrival(self):
+        worker = _start_worker(_sleepy_partial(0.0))
+        client = MeshPeerClient(0, ("127.0.0.1", worker.port))
+        try:
+            seeds = np.array([[1]], dtype=np.int32)
+            with pytest.raises(MeshShardUnavailable) as excinfo:
+                client.partial(seeds, "tok", budget_ms=0.0)
+            assert excinfo.value.reason == "deadline-expired"
+            assert worker.expired_on_arrival == 1
+            # with budget remaining the same connection still serves
+            ids, _confs = client.partial(seeds, "tok", budget_ms=50.0)
+            np.testing.assert_array_equal(ids, seeds)
+            assert worker.expired_on_arrival == 1
+        finally:
+            client.close()
+            worker.stop()
+
+    def test_expired_shed_drops_rank_without_blame(self):
+        worker = _start_worker(_sleepy_partial(0.0))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+            hedge=True, hedge_delay_ms=50.0,
+        )
+        try:
+            seeds = np.array([[1]], dtype=np.int32)
+            finish = coord.fetch_partials(seeds, "tok", budget_ms=-1.0)
+            out = finish()
+            # the worker shed expired work: that is propagation working,
+            # not a sick shard and not a hedge decision
+            assert finish.dropped == [0]
+            assert out == {}
+            assert coord.hedge_wins == 0
+            assert coord.missing_shards() == []
+            assert worker.expired_on_arrival == 1
+        finally:
+            coord.close()
+            worker.stop()
+
+    def test_mesh_slow_ladder_ejects_and_recovers(self):
+        # clients are lazy: no sockets needed to drive the ladder
+        coord = MeshCoordinator(
+            GangConfig("127.0.0.1:9300", 3, 1),
+            hedge=True, hedge_delay_ms=20.0, peer_slow_ratio=3.0,
+        )
+        try:
+            for _ in range(10):
+                coord._mark_rank_latency(0, 0.01)
+            for _ in range(8):
+                coord._mark_rank_latency(2, 0.1)
+            assert coord.slow_ranks() == [2]
+            assert coord.slow_ejections == 1
+            # a slow-marked rank hedges at the floor: its own p95 IS the
+            # stall being routed around
+            assert coord._rank_straggler_bound_s(2) == pytest.approx(0.02)
+            # fast samples (the grace/full-wait answers double as
+            # probes) decay the EWMA back under the bar
+            for _ in range(50):
+                if not coord.slow_ranks():
+                    break
+                coord._mark_rank_latency(2, 0.01)
+            assert coord.slow_ranks() == []
+            assert coord.slow_readmissions == 1
+        finally:
+            coord.close()
+
+
+@pytest.fixture()
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestDeadlinePropagation:
+    """X-KMLS-Deadline-Budget across both front ends: expired budgets
+    answer degraded (never 5xx), malformed headers are ignored, and the
+    forwarded budget rides the trace."""
+
+    def _body(self):
+        return json.dumps({"songs": ["seed-a", "seed-b"]}).encode()
+
+    def test_expired_budget_answers_degraded_threaded(self, tmp_path):
+        app = RecommendApp(ServingConfig(base_dir=str(tmp_path)))
+        status, headers, payload = app.handle(
+            "POST", "/api/recommend/", self._body(), budget_header="0"
+        )
+        assert status == 200
+        assert headers["X-KMLS-Degraded"] == "deadline-expired"
+        assert app.deadline_expired_total == 1
+        assert "songs" in json.loads(payload)
+
+    def test_expired_budget_answers_degraded_async(self, tmp_path):
+        app = RecommendApp(ServingConfig(base_dir=str(tmp_path)))
+        response, future, _t0, _trace = app.submit_recommend(
+            self._body(), None, "-5.5"
+        )
+        assert future is None  # immediate: no compute was submitted
+        status, headers, _payload = response
+        assert status == 200
+        assert headers["X-KMLS-Degraded"] == "deadline-expired"
+        assert app.deadline_expired_total == 1
+
+    def test_malformed_budget_header_is_ignored(self, tmp_path):
+        app = RecommendApp(ServingConfig(base_dir=str(tmp_path)))
+        status, headers, _payload = app.handle(
+            "POST", "/api/recommend/", self._body(),
+            budget_header="banana",
+        )
+        assert status == 200
+        assert "X-KMLS-Degraded" not in headers
+        assert app.deadline_expired_total == 0
+
+    def test_effective_deadline_takes_the_tighter_bound(self, tmp_path):
+        app = RecommendApp(
+            ServingConfig(base_dir=str(tmp_path), request_deadline_ms=1000.0)
+        )
+        t0 = 100.0
+        # no header: the local budget stands
+        deadline, budget, expired = app._effective_deadline(t0, None)
+        assert (deadline, budget, expired) == (pytest.approx(101.0), None, False)
+        # a tighter forwarded budget wins
+        deadline, budget, expired = app._effective_deadline(t0, "250")
+        assert deadline == pytest.approx(100.25)
+        assert budget == 250.0 and not expired
+        # a looser one does not loosen the local deadline
+        deadline, _, _ = app._effective_deadline(t0, "5000")
+        assert deadline == pytest.approx(101.0)
+        # malformed / non-finite: ignored, never an outage
+        assert app._effective_deadline(t0, "nope")[1] is None
+        assert app._effective_deadline(t0, "inf")[1] is None
+        # spent on arrival
+        assert app._effective_deadline(t0, "0")[2] is True
+
+    def test_budget_rides_the_trace_on_both_front_ends(self, tmp_path):
+        app = RecommendApp(
+            ServingConfig(base_dir=str(tmp_path), trace_sample=1.0)
+        )
+        app.handle(
+            "POST", "/api/recommend/", self._body(), budget_header="4500"
+        )
+        retained = app.recorder.debug_payload()["traces"]
+        assert any(
+            t["attrs"].get("deadline_budget_ms") == 4500.0 for t in retained
+        )
+        app.submit_recommend(self._body(), None, "0")
+        retained = app.recorder.debug_payload()["traces"]
+        assert any(
+            t["attrs"].get("deadline_budget_ms") == 0.0
+            and t["attrs"].get("reason") == "deadline-expired"
+            for t in retained
+        )
+
+    def test_fleet_peer_fault_stalls_the_indexed_replica(
+        self, tmp_path, monkeypatch, clean_faults
+    ):
+        # sorted peers ["replica-a", "replica-b"]: self is index 0
+        app = RecommendApp(
+            ServingConfig(
+                base_dir=str(tmp_path),
+                fleet_self="replica-a", fleet_peers="replica-a,replica-b",
+            )
+        )
+        assert app._fleet_index == 0
+        monkeypatch.setenv("KMLS_FAULT_FLEET_PEER_DELAY_MS", "0:80:1")
+        faults.clear()  # forget any prior env parse; fire() re-reads
+        t0 = time.perf_counter()
+        status, _headers, _payload = app.handle(
+            "POST", "/api/recommend/", self._body()
+        )
+        elapsed = time.perf_counter() - t0
+        assert status == 200
+        assert elapsed >= 0.06  # the injected stall, not an error
+        # times=1: the next request runs clean
+        t0 = time.perf_counter()
+        app.handle("POST", "/api/recommend/", self._body())
+        assert time.perf_counter() - t0 < 0.06
+
+    def test_mesh_peer_fault_keys_on_gang_rank(
+        self, monkeypatch, clean_faults
+    ):
+        monkeypatch.setenv("KMLS_FAULT_MESH_PEER_DELAY_MS", "1:80:2")
+        faults.clear()
+        t0 = time.perf_counter()
+        faults.fire("mesh.peer", replica=1)
+        assert time.perf_counter() - t0 >= 0.06
+        t0 = time.perf_counter()
+        faults.fire("mesh.peer", replica=0)  # not the armed rank
+        assert time.perf_counter() - t0 < 0.06
+
+
+class TestMeshRetryAfter:
+    """PR 8's Retry-After contract on the mesh 503: RFC 9110 integer
+    delay-seconds, jittered so spilled clients never re-synchronize on
+    one probe tick."""
+
+    def test_integer_jittered_retry_after(self, tmp_path):
+        app = RecommendApp(
+            ServingConfig(
+                base_dir=str(tmp_path),
+                fleet_self="replica-a", fleet_peers="replica-a,replica-b",
+                shed_retry_jitter=0.3, replica_probe_interval_s=4.0,
+            )
+        )
+        seen = set()
+        for _ in range(50):
+            status, headers, _payload = app._mesh_shard_response(
+                time.perf_counter(), ["seed-a"], 1
+            )
+            assert status == 503
+            assert headers["X-KMLS-Mesh-Unavailable"] == "1"
+            value = headers["Retry-After"]
+            assert value.isdigit()  # RFC 9110 delay-seconds
+            assert 3 <= int(value) <= 6  # ceil of 4.0 +/- 30%
+            seen.add(value)
+        assert len(seen) >= 2  # the jitter actually de-synchronizes
+
+
+class TestZeroCost:
+    """KMLS_HEDGE=0 (the default) allocates no hedge decisions anywhere:
+    pinned counters, untouched ladders, and degraded answers are served
+    but never cached."""
+
+    def test_defaults_are_off(self):
+        cfg = ServingConfig()
+        assert cfg.hedge_enabled is False
+        assert cfg.peer_slow_ratio == 0.0
+
+    def test_replay_hedge_counter_pinned_zero(self):
+        assert replay.HEDGES_ISSUED == 0
+
+    def test_unhedged_coordinator_makes_no_hedge_decisions(self):
+        worker = _start_worker(_sleepy_partial(0.0))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+        )
+        try:
+            seeds = np.array([[1, 2]], dtype=np.int32)
+            finish = coord.fetch_partials(seeds, "tok")
+            out = finish()
+            assert 0 in out
+            assert finish.dropped == []
+            assert finish.hedge_outcome is None
+            assert coord.hedge_wins == 0
+            assert coord.hedge_cancelled == 0
+            assert coord.slow_ejections == 0
+            # no latency tracking on the unhedged path either
+            assert all(len(d) == 0 for d in coord._rank_recent.values())
+        finally:
+            coord.close()
+            worker.stop()
+
+    def test_cache_serves_but_never_remembers_degraded(self):
+        cache = RecommendCache(max_entries=8)
+        key = cache.key(1, ["seed-a"], 5)
+        cache.put(key, (["x"], "degraded:mesh-straggler"))
+        assert len(cache) == 0
+        assert cache.get(key) is None
+        cache.put(key, (["x"], "rules"))
+        assert cache.get(key) == (["x"], "rules")
+
+
+class TestHedgeTraceAnnotation:
+    """The mesh finish() stamps its won/lost/cancelled decision on
+    itself; the batcher rides it onto every traced request BEFORE the
+    futures resolve, so result() observers always see it."""
+
+    class _HedgedEngine:
+        def recommend_many_async(self, seed_sets):
+            def finish():
+                return [(list(s), "rules") for s in seed_sets]
+
+            finish._kmls_hedge = "won"
+            return finish
+
+    def test_hedge_outcome_annotated_before_resolve(self):
+        recorder = SpanRecorder(sample=1.0)
+        trace = recorder.begin(None)
+        batcher = MicroBatcher(self._HedgedEngine(), max_size=4, window_ms=1.0)
+        future = batcher.submit(["seed-a"], trace=trace)
+        songs, source = future.result(timeout=5.0)
+        assert source == "rules"
+        assert trace.attrs["hedged"] == "won"
