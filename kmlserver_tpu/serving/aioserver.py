@@ -297,9 +297,11 @@ class _Conn(asyncio.Protocol):
             if app.batcher is None:
                 # batching disabled: the blocking engine call must
                 # still stay off the loop
+                # the fleet.peer stall was already take()n in _dispatch:
+                # the handler must not fire the site a second time
                 task = state.engine_pool.submit(
                     app.handle, "POST", path, body, self.peer_host,
-                    trace_header, budget_header,
+                    trace_header, budget_header, False,
                 )
                 task.add_done_callback(
                     lambda f: self.loop.call_soon_threadsafe(

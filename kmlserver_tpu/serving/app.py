@@ -319,10 +319,14 @@ class RecommendApp:
         client_host: str | None = None,
         trace_header: str | None = None,
         budget_header: str | None = None,
+        fire_fleet_fault: bool = True,
     ) -> Response:
         path, _, query = path.partition("?")
         if method == "POST" and path in ("/api/recommend/", "/api/recommend"):
-            return self._post_recommend(body, trace_header, budget_header)
+            return self._post_recommend(
+                body, trace_header, budget_header,
+                fire_fleet_fault=fire_fleet_fault,
+            )
         if method == "POST" and path == "/metrics/reset":
             # measurement-harness hook: windows the latency percentiles
             # to one replay run (VERDICT r4 #7). Loopback-only via the
@@ -1247,12 +1251,17 @@ class RecommendApp:
     def _post_recommend(
         self, body: bytes | None, trace_header: str | None = None,
         budget_header: str | None = None,
+        fire_fleet_fault: bool = True,
     ) -> Response:
         t0 = time.perf_counter()
         # gray-failure chaos site (ISSUE 18): a deterministic stall on
         # ONE fleet replica, addressed by sorted-peer index — the
-        # slowpeer bench's fleet-side victim
-        faults.fire("fleet.peer", replica=self._fleet_index)
+        # slowpeer bench's fleet-side victim. The asyncio transport
+        # consumes this site itself (faults.take on the loop timer) and
+        # passes fire_fleet_fault=False so a times=N budget is never
+        # decremented twice for one request.
+        if fire_fleet_fault:
+            faults.fire("fleet.peer", replica=self._fleet_index)
         err, songs = self._validate_recommend(body)
         if err is not None:
             return err

@@ -550,7 +550,9 @@ class MeshCoordinator:
         runs.
 
         ``budget_ms`` (deadline propagation) rides each partial frame so
-        a backed-up worker sheds expired work instead of computing it.
+        a backed-up worker sheds expired work instead of computing it; a
+        shed rank lands in ``finish.dropped`` whether or not hedging is
+        armed — the merge degrades, the shard is never blamed missing.
 
         With ``hedge`` armed, a rank that hasn't answered within its
         adaptive straggler bound is dropped from the merge (one token
@@ -560,6 +562,16 @@ class MeshCoordinator:
         ``won``/``lost``/``cancelled`` for the trace span. A dropped
         rank is NOT blamed as missing: it is alive, just late."""
         payload = np.ascontiguousarray(seeds, dtype=np.int32).copy()
+        if self.hedge:
+            # the bucket EARNS hedge_max_frac per dispatch (the replay
+            # client's accounting, coordinator-side): straggler drops
+            # are bounded at ~hedge_max_frac of traffic, not a one-time
+            # allowance that exhausts for the process lifetime
+            with self._lock:
+                self._hedge_tokens = min(
+                    self._hedge_tokens + self.hedge_max_frac,
+                    self._hedge_cap,
+                )
         t_submit = time.monotonic()
         futures = {
             rank: self._pool.submit(
@@ -605,6 +617,7 @@ class MeshCoordinator:
                             except FutureTimeoutError:
                                 finish.dropped.append(rank)
                                 self.hedge_wins += 1
+                                finish.hedge_outcome = "won"
                                 continue
                             except MeshShardUnavailable as exc:
                                 if exc.reason == "deadline-expired":
@@ -658,7 +671,11 @@ class MeshCoordinator:
                         )
                     self._note_serving(rank)
                 except MeshShardUnavailable as exc:
-                    if self.hedge and exc.reason == "deadline-expired":
+                    if exc.reason == "deadline-expired":
+                        # the worker shed expired work — deadline
+                        # propagation doing its job whether or not
+                        # hedging is armed: degrade, don't blame a
+                        # live shard (and don't fail the batch)
                         finish.dropped.append(rank)
                         continue
                     self._note_missing(rank, exc.reason)
@@ -671,8 +688,6 @@ class MeshCoordinator:
                     failed = failed or wrapped
             if failed is not None:
                 raise failed
-            if finish.dropped and finish.hedge_outcome is None:
-                finish.hedge_outcome = "won"
             return out
 
         finish.dropped = []

@@ -1221,14 +1221,33 @@ def replay_fleet_http(
                 # falls back to plain waiting on its primary
                 stats["hedges_suppressed"] += 1
                 return
+            # the hedge must land on a peer the router considers
+            # healthy — hedging to an ejected (or slow-ejected) peer
+            # re-issues to exactly the stall being routed around and
+            # wastes both the token and the hedge
+            unhealthy = set(router.ejected_peers())
             if policy == "ring":
                 target = next(
-                    (p for p in router.ring.ranked(keys[idx]) if p != primary),
+                    (
+                        p for p in router.ring.ranked(keys[idx])
+                        if p != primary and p not in unhealthy
+                    ),
                     None,
                 )
             else:
-                target = peers[(peers.index(primary) + 1) % len(peers)]
+                start = peers.index(primary)
+                target = next(
+                    (
+                        peers[(start + off) % len(peers)]
+                        for off in range(1, len(peers))
+                        if peers[(start + off) % len(peers)] not in unhealthy
+                    ),
+                    None,
+                )
             if target is None or target == primary:
+                # no healthy alternate exists: suppress, fall back to
+                # plain waiting on the primary
+                stats["hedges_suppressed"] += 1
                 return
             hedge_tokens[0] -= 1.0
             HEDGES_ISSUED += 1

@@ -254,6 +254,55 @@ class TestMeshHedge:
             coord.close()
             worker.stop()
 
+    def test_expired_shed_drops_rank_without_hedging_too(self):
+        # deadline propagation is NOT a hedge feature: with KMLS_HEDGE=0
+        # a worker shedding an expired partial still degrades the merge
+        # instead of 503-failing the batch and blaming a live shard
+        worker = _start_worker(_sleepy_partial(0.0))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+        )
+        try:
+            seeds = np.array([[1]], dtype=np.int32)
+            finish = coord.fetch_partials(seeds, "tok", budget_ms=-1.0)
+            out = finish()
+            assert finish.dropped == [0]
+            assert out == {}
+            # no hedge decision was made anywhere
+            assert finish.hedge_outcome is None
+            assert coord.hedge_wins == 0
+            assert coord.missing_shards() == []
+            assert worker.expired_on_arrival == 1
+        finally:
+            coord.close()
+            worker.stop()
+
+    def test_hedge_bucket_earns_per_dispatch(self):
+        # the amplification bound is a RATE (hedge_max_frac of traffic),
+        # not a one-time allowance: an emptied bucket re-earns on
+        # subsequent dispatches instead of cancelling hedges forever
+        worker = _start_worker(_sleepy_partial(0.0))
+        coord = MeshCoordinator(
+            GangConfig(f"127.0.0.1:{worker.port}", 2, 1),
+            connect_timeout_s=1.0, request_timeout_s=2.0,
+            hedge=True, hedge_delay_ms=50.0, hedge_max_frac=0.5,
+        )
+        coord._hedge_tokens = 0.0
+        try:
+            seeds = np.array([[1]], dtype=np.int32)
+            for expected in (0.5, 1.0):
+                finish = coord.fetch_partials(seeds, "tok")
+                finish()
+                assert coord._hedge_tokens == pytest.approx(expected)
+            # capped at the burst cap, never beyond
+            for _ in range(8):
+                coord.fetch_partials(seeds, "tok")()
+            assert coord._hedge_tokens <= coord._hedge_cap
+        finally:
+            coord.close()
+            worker.stop()
+
     def test_mesh_slow_ladder_ejects_and_recovers(self):
         # clients are lazy: no sockets needed to drive the ladder
         coord = MeshCoordinator(
@@ -392,6 +441,30 @@ class TestDeadlinePropagation:
         t0 = time.perf_counter()
         app.handle("POST", "/api/recommend/", self._body())
         assert time.perf_counter() - t0 < 0.06
+
+    def test_aio_transport_path_does_not_refire_fleet_fault(
+        self, tmp_path, monkeypatch, clean_faults
+    ):
+        # the asyncio transport take()s the fleet.peer stall itself and
+        # re-enters the handler with fire_fleet_fault=False: the site's
+        # times=N budget must be consumed ONCE per request, not twice
+        app = RecommendApp(
+            ServingConfig(
+                base_dir=str(tmp_path),
+                fleet_self="replica-a", fleet_peers="replica-a,replica-b",
+            )
+        )
+        monkeypatch.setenv("KMLS_FAULT_FLEET_PEER_DELAY_MS", "0:80:1")
+        faults.clear()
+        t0 = time.perf_counter()
+        status, _headers, _payload = app.handle(
+            "POST", "/api/recommend/", self._body(),
+            fire_fleet_fault=False,
+        )
+        assert status == 200
+        assert time.perf_counter() - t0 < 0.06  # site untouched
+        # the budget is still armed for whoever consumes it next
+        assert faults.take("fleet.peer", replica=0) == pytest.approx(0.08)
 
     def test_mesh_peer_fault_keys_on_gang_rank(
         self, monkeypatch, clean_faults
