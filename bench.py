@@ -433,6 +433,18 @@ _COMPACT_PRIORITY = (
     "slowpeer_hedge_wins", "slowpeer_hedge_mismatch",
     "slowpeer_http_5xx", "slowpeer_errors", "slowpeer_identity_ok",
     "slowpeer_control_hedges_issued", "slowpeer_mesh_hedge_wins",
+    # judged storage gray-failure claims (ISSUE 19): serving p99 unmoved
+    # under the 400 ms PVC read stall, conviction flips /readyz to
+    # degraded (never unready), the armed reload parks in bounded
+    # backoff holding last-good, and the ENOSPC-mid-publish leg pins
+    # exit 75 + bit-identity + zero torn temps — ranked with the
+    # slowpeer block (CPU-measured by construction); per-leg latency
+    # detail is sidecar-only
+    "graystore_p99_ratio", "graystore_storage_slow",
+    "graystore_readyz_degraded", "graystore_reload_deferred",
+    "graystore_last_good_held", "graystore_enospc_exit_resumable",
+    "graystore_enospc_identical", "graystore_torn_parts",
+    "graystore_http_5xx", "graystore_errors",
     # judged quality-loop claims (ISSUE 14): held-out recall@k per
     # serving mode (blend at the MEASURED optimum vs both pure modes),
     # the measured weight round-tripping report → bundle → serve time,
@@ -1710,6 +1722,199 @@ with tempfile.TemporaryDirectory(prefix="kmls_fresh_") as base:
         "fleet_affinity_hit_ratio": fleet["affinity_hit_ratio"],
         "fleet_baseline_hit_ratio": fleet["baseline_hit_ratio"],
         "fleet_multiplier": fleet["multiplier"],
+        "platform": dev.platform,
+    }))
+"""
+
+# the storage gray-failure phase (ISSUE 19): the SAME in-process app the
+# freshness bracket uses, with the artifact plane stall/ENOSPC-injected
+# through the path-scoped io.* fault sites. Four legs: (1) clean control
+# replay; (2) replay with every PVC read stalled 400 ms — serving runs
+# from memory so p99 must not move, the reload (armed by a mid-leg
+# invalidation) parks in bounded backoff at the read deadline with
+# last-good serving, and the token-poll latency EWMA convicts
+# storage-slow (/readyz ready-but-degraded); (3) ENOSPC exactly on the
+# recommendations write of the next publication — resumable exit
+# classification, token unconsumed, last-good BIT-IDENTICAL (sha256),
+# no torn temp files, serving probe still 200; (4) clean re-publish
+# recovers end-to-end. Zero 5xx across all legs.
+_GRAYSTORE_BENCH = r"""
+import dataclasses, errno, hashlib, json, os, sys, tempfile, threading, time
+import jax
+from kmlserver_tpu import faults
+from kmlserver_tpu.config import MiningConfig, ServingConfig
+from kmlserver_tpu.data.csv import write_tracks_csv
+from kmlserver_tpu.data.synthetic import DS2_SHAPE, synthetic_table
+from kmlserver_tpu.io import artifacts, iohealth, registry
+from kmlserver_tpu.mining.job import EXIT_RESUMABLE, classify_exception
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.replay import replay_pooled, sample_seed_sets
+
+dev = jax.devices()[0]
+print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr, flush=True)
+qps = float(os.environ.get("KMLS_BENCH_GRAYSTORE_QPS", "1000"))
+n_req = int(os.environ.get("KMLS_BENCH_GRAYSTORE_REQUESTS", "6000"))
+STALL_MS = 400.0  # > the 250 ms conviction default, < any replay budget
+with tempfile.TemporaryDirectory(prefix="kmls_graystore_") as base:
+    ds_dir = os.path.join(base, "datasets")
+    os.makedirs(ds_dir)
+    write_tracks_csv(
+        os.path.join(ds_dir, "2023_spotify_ds2.csv"),
+        synthetic_table(**DS2_SHAPE, seed=123),
+    )
+    mcfg = MiningConfig(base_dir=base, datasets_dir=ds_dir, min_support=0.05)
+    run_mining_job(mcfg)
+    cfg = dataclasses.replace(
+        ServingConfig.from_env(), base_dir=base, batch_max_size=64,
+        shed_queue_budget_ms=0.0, io_read_deadline_s=0.15,
+        reload_backoff_base_s=0.5, reload_backoff_max_s=4.0,
+    )
+    app = RecommendApp(cfg)
+    assert app.engine.load(), "mined artifacts must load"
+    pickles = os.path.join(base, "pickles")
+    rec_path = os.path.join(pickles, mcfg.recommendations_file)
+
+    http_5xx = [0]
+    lock = threading.Lock()
+    def send(seeds):
+        status, headers, _ = app.handle(
+            "POST", "/api/recommend/", json.dumps({"songs": seeds}).encode(),
+        )
+        if status >= 500:
+            with lock:
+                http_5xx[0] += 1
+            raise RuntimeError(f"HTTP {status}")
+        if status != 200:
+            raise RuntimeError(f"HTTP {status}")
+        return ("degraded" if "X-KMLS-Degraded" in headers else "ok", False)
+
+    vocab = app.engine.bundle.vocab
+    payloads = sample_seed_sets(vocab, n_req, rng_seed=11, zipf_s=1.1)
+
+    # ---- leg 1: clean control ----
+    control = replay_pooled(lambda: send, payloads, qps=qps, n_workers=16,
+                            max_queue=8192)
+
+    # ---- leg 2: every PVC read stalls 400 ms ----
+    # the production poll loop keeps running (its token reads ARE the
+    # conviction evidence); an invalidation mid-stall arms a reload that
+    # must fail at the read deadline into backoff, not wedge
+    stop = [False]
+    def poller():
+        while not stop[0]:
+            app.engine.reload_if_required()
+            time.sleep(0.02)
+    pt = threading.Thread(target=poller, daemon=True)
+    pt.start()
+    token_before = app.engine.cache_value
+    registry.append_history_and_invalidate(
+        MiningConfig(base_dir=base), 1, "graystore-ds"
+    )
+    faults.inject("io.read", delay_s=STALL_MS / 1e3, times=-1)
+    stalled = replay_pooled(lambda: send, payloads, qps=qps, n_workers=16,
+                            max_queue=8192)
+    # drive conviction to its sample floor: each pure staleness check IS
+    # a stalled 400 ms token poll (production reaches the floor over
+    # minutes of polling; the bench compresses that to ~3 s)
+    for _ in range(12):
+        if iohealth.MONITOR.storage_slow():
+            break
+        app.engine.is_data_stale()
+    storage_slow = iohealth.MONITOR.storage_slow()
+    reload_deferred = app.engine.consecutive_reload_failures >= 1
+    backoff_bounded = (
+        app.engine._backoff_until > 0.0
+        and app.engine._backoff_until - time.monotonic() <= 8.0
+    )
+    last_good_held = (
+        app.engine.finished_loading
+        and app.engine.cache_value == token_before
+    )
+    status, _, payload = app.handle("GET", "/readyz", b"")
+    readyz = json.loads(payload)
+    readyz_degraded = (
+        status == 200 and readyz.get("status") == "degraded"
+        and "storage-slow" in readyz.get("reasons", ())
+    )
+    faults.clear()
+    iohealth.MONITOR.reset()
+    # drain the pending invalidation (loop: the poller may hold the
+    # reload lock mid-stall for one last 400 ms read)
+    deadline = time.monotonic() + 30.0
+    while (
+        app.engine.cache_value == token_before
+        and time.monotonic() < deadline
+    ):
+        app.engine._backoff_until = 0.0
+        app.engine.reload_if_required()
+        time.sleep(0.05)
+    assert app.engine.cache_value != token_before, (
+        "reload must recover once the stall clears"
+    )
+
+    # ---- leg 3: ENOSPC exactly on the recommendations write ----
+    with open(rec_path, "rb") as fh:
+        sha_before = hashlib.sha256(fh.read()).hexdigest()
+    token_path = registry.token_path_for(base, mcfg.data_invalidation_file)
+    with open(token_path) as fh:
+        disk_token_before = fh.read()
+    faults.inject("io.write", kind="enospc", times=1, path="recommendations")
+    enospc_exit = None
+    try:
+        run_mining_job(mcfg)
+    except OSError as exc:
+        if exc.errno == errno.ENOSPC:
+            enospc_exit = classify_exception(exc)
+    faults.clear()
+    with open(rec_path, "rb") as fh:
+        sha_after = hashlib.sha256(fh.read()).hexdigest()
+    with open(token_path) as fh:
+        disk_token_after = fh.read()
+    torn_parts = sum(
+        1 for name in os.listdir(pickles)
+        if name.startswith(".tmp_") and name.endswith(".part")
+    )
+    probe = replay_pooled(lambda: send, payloads[:200], qps=qps,
+                          n_workers=8, max_queue=8192)
+
+    # ---- leg 4: clean re-publish recovers ----
+    token_pre_recover = app.engine.cache_value
+    run_mining_job(mcfg)
+    deadline = time.monotonic() + 30.0
+    while (
+        app.engine.cache_value == token_pre_recover
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    recovered = app.engine.cache_value != token_pre_recover
+    stop[0] = True
+    pt.join(timeout=5.0)
+
+    print(json.dumps({
+        "qps": qps,
+        "requests": n_req,
+        "stall_ms": STALL_MS,
+        "control_p50_ms": control.p50_ms,
+        "control_p99_ms": control.p99_ms,
+        "stalled_p50_ms": stalled.p50_ms,
+        "stalled_p99_ms": stalled.p99_ms,
+        "p99_ratio": stalled.p99_ms / max(control.p99_ms, 1e-9),
+        "storage_slow": bool(storage_slow),
+        "readyz_degraded": bool(readyz_degraded),
+        "reload_deferred": bool(reload_deferred),
+        "backoff_bounded": bool(backoff_bounded),
+        "last_good_held": bool(last_good_held),
+        "enospc_exit": enospc_exit,
+        "enospc_exit_resumable": enospc_exit == EXIT_RESUMABLE,
+        "enospc_identical": sha_after == sha_before,
+        "enospc_token_moved": disk_token_after != disk_token_before,
+        "torn_parts": torn_parts,
+        "probe_p99_ms": probe.p99_ms,
+        "recovered": bool(recovered),
+        "io_retries": iohealth.MONITOR.snapshot()["retries"],
+        "http_5xx": http_5xx[0],
+        "errors": (control.n_errors + stalled.n_errors + probe.n_errors),
         "platform": dev.platform,
     }))
 """
@@ -4907,6 +5112,14 @@ def _run_tpu_suite_inner(em: ArtifactEmitter, npz_path: str) -> dict | None:
         _record_slowpeer(result, bank="slowpeer_cpu", budget_s=240)
         em.checkpoint()
 
+    # storage gray-failure bracket (ISSUE 19): CPU-measured by
+    # construction (tmpfs artifact dir + injected IO faults) — the
+    # zero-5xx / p99-unmoved / torn-free ENOSPC evidence must ride the
+    # TPU artifact too
+    if "graystore_http_5xx" not in result:
+        _record_graystore(result, bank="graystore_cpu", budget_s=200)
+        em.checkpoint()
+
     # quality-loop bracket (ISSUE 14): CPU-measured by construction —
     # the held-out recall / measured-weight / compaction-identity
     # evidence must ride the TPU artifact too
@@ -5051,6 +5264,13 @@ def run_cpu_suite(em: ArtifactEmitter, npz_path: str) -> dict | None:
         # on one fleet peer and one gang member, hedged leg vs no-hedge
         # control at equal capacity
         _record_slowpeer(result)
+        em.checkpoint()
+
+    if _remaining() > 200:
+        # storage gray-failure spine (ISSUE 19): a 400 ms PVC read stall
+        # under replay (degraded-not-unready, reload parked in backoff)
+        # + ENOSPC landing exactly on the recommendations write
+        _record_graystore(result)
         em.checkpoint()
 
     if _remaining() > 240:
@@ -6003,6 +6223,61 @@ def _record_slowpeer(
         if src in res and res[src] is not None:
             val = res[src]
             result[dst] = round(val, 3) if isinstance(val, float) else val
+
+
+def _record_graystore(
+    result: dict, bank: str | None = None, budget_s: float | None = None,
+) -> None:
+    """The storage gray-failure bracket (ISSUE 19): the shared artifact
+    volume goes gray — every PVC read stalls 400 ms under a 1k-QPS
+    replay, then ENOSPC lands exactly on the recommendations write of a
+    full publication. Judged claims: zero 5xx on every leg, serving p99
+    unmoved by the stall (the hot path never touches the volume), slow-IO
+    conviction flips /readyz to ready-but-degraded reason storage-slow,
+    the armed reload parks in bounded backoff holding last-good (and
+    recovers once the stall clears), and the ENOSPC publication aborts
+    resumable (exit 75) with the last-good bytes bit-identical, the
+    token unmoved, and zero torn temp files on the volume. CPU-platform
+    by construction (tmpfs-backed artifact dir + injected faults),
+    self-labeled."""
+
+    def _run() -> dict | None:
+        return _run_phase(
+            "graystore", _GRAYSTORE_BENCH, [], platform="cpu",
+            timeout=min(600, _remaining()),
+        )
+
+    res = _banked(bank, _run, budget_s, extras=result) if bank else _run()
+    if res is None:
+        return
+    log(
+        f"graystore: serving p99 {res['control_p99_ms']:.1f}ms clean vs "
+        f"{res['stalled_p99_ms']:.1f}ms under a {res['stall_ms']:.0f}ms "
+        f"PVC read stall ({res['p99_ratio']:.2f}x), "
+        f"storage_slow={res['storage_slow']}, "
+        f"readyz_degraded={res['readyz_degraded']}, reload deferred="
+        f"{res['reload_deferred']} (backoff bounded={res['backoff_bounded']}, "
+        f"last-good held={res['last_good_held']}); ENOSPC mid-publish: "
+        f"exit {res['enospc_exit']} (resumable={res['enospc_exit_resumable']}), "
+        f"identical={res['enospc_identical']}, "
+        f"token_moved={res['enospc_token_moved']}, "
+        f"{res['torn_parts']} torn temps, recovered={res['recovered']}; "
+        f"{res['http_5xx']} 5xx / {res['errors']} drops across all legs"
+    )
+    for src in (
+        "qps", "requests", "stall_ms", "control_p50_ms", "control_p99_ms",
+        "stalled_p50_ms", "stalled_p99_ms", "p99_ratio", "storage_slow",
+        "readyz_degraded", "reload_deferred", "backoff_bounded",
+        "last_good_held", "enospc_exit", "enospc_exit_resumable",
+        "enospc_identical", "enospc_token_moved", "torn_parts",
+        "probe_p99_ms", "recovered", "io_retries", "http_5xx", "errors",
+        "platform",
+    ):
+        if src in res and res[src] is not None:
+            val = res[src]
+            result["graystore_" + src] = (
+                round(val, 3) if isinstance(val, float) else val
+            )
 
 
 def _record_scale_shard(

@@ -15,9 +15,15 @@ Flags, outside the allowlist:
   ``+``), and ``os.fdopen`` likewise;
 - ``pickle.dump``, ``json.dump``, ``np.save``/``np.savez*``,
   ``np.savetxt`` — direct serialization to a handle/path;
-- ``os.replace``/``os.rename`` (an atomic rename belongs in the writer,
-  not scattered — scattered renames are how two "atomic" writers tear
-  each other's manifests).
+- ``os.replace``/``os.rename`` anywhere in the package outside
+  ``cfg.durable_rename_function`` (``io/artifacts.py::durable_replace``)
+  and ``cfg.rename_allowed_modules``. ISSUE 19 tightened this from "a
+  rename belongs in the writer module" to "a rename belongs in THE
+  durable rename": publication-critical renames must fsync the source
+  file and the parent directory, or a power cut after the rename can
+  silently vanish the publication — so even inside the approved writer
+  module, a bare ``os.replace`` that is not ``durable_replace`` itself
+  is flagged.
 
 Scope is the package only (``kmlserver_tpu/``): bench/scripts write
 their own local state files and are not part of the PVC contract.
@@ -72,19 +78,29 @@ def _write_mode(call: ast.Call) -> str | None:
     return None
 
 
+def _module_allowed(relpath: str, allowed: set[str]) -> bool:
+    if relpath in allowed:
+        return True
+    return any(
+        m.endswith("/") and relpath.startswith(m) for m in allowed
+    )
+
+
 def run(index: ProjectIndex, cfg: AnalysisConfig) -> list[Finding]:
     allowed_modules = set(cfg.atomic_allowed_modules)
     allowed_functions = set(cfg.atomic_allowed_functions)
+    rename_allowed = set(cfg.rename_allowed_modules)
     findings: list[Finding] = []
     for relpath in sorted(index.modules):
         if not relpath.startswith(cfg.package_dir):
             continue
-        if relpath in allowed_modules:
-            continue
-        if any(
-            m.endswith("/") and relpath.startswith(m)
-            for m in allowed_modules
-        ):
+        # renames are checked EVERYWHERE in the package, including the
+        # atomic-allowed writer modules (the durable-rename rule is
+        # stricter than the direct-write rule); plain writes keep the
+        # module allowlist.
+        writes_allowed = _module_allowed(relpath, allowed_modules)
+        renames_allowed = _module_allowed(relpath, rename_allowed)
+        if writes_allowed and renames_allowed:
             continue
         mod = index.modules[relpath]
         # top-level function spans, so a write can be attributed to (and
@@ -112,16 +128,40 @@ def run(index: ProjectIndex, cfg: AnalysisConfig) -> list[Finding]:
                 ):
                     best_span = (start, end)
                     info = fn_info
-            if info.ref in allowed_functions:
-                continue
             site = resolve_call(index, info, node)
+            if site.dotted in _RENAMES:
+                if (
+                    renames_allowed
+                    or info.ref == cfg.durable_rename_function
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        checker="atomic-write",
+                        severity=SEVERITY_ERROR,
+                        file=info.relpath,
+                        line=node.lineno,
+                        key=f"{site.dotted}@{info.qualname}",
+                        message=(
+                            f"publication-critical rename `{site.dotted}`"
+                            f" in `{info.qualname}` bypasses "
+                            "io/artifacts.py::durable_replace; without "
+                            "the fsync-file + fsync-parent-dir "
+                            "discipline a power cut after the rename "
+                            "can silently vanish the publication"
+                        ),
+                    )
+                )
+                continue
+            if writes_allowed or info.ref in allowed_functions:
+                continue
             construct: str | None = None
             mode: str | None = None
             if site.dotted in ("open", "os.fdopen"):
                 mode = _write_mode(node)
                 if mode is not None:
                     construct = f"{site.dotted}(mode={mode!r})"
-            elif site.dotted in _SERIALIZERS or site.dotted in _RENAMES:
+            elif site.dotted in _SERIALIZERS:
                 construct = site.dotted
             if construct is None:
                 continue

@@ -176,6 +176,20 @@ class AnalysisConfig:
     atomic_allowed_functions: tuple[str, ...] = (
         "kmlserver_tpu/io/registry.py::append_history_and_invalidate",
     )
+    # the ONE function allowed to call os.replace/os.rename anywhere in
+    # the package (ISSUE 19): publication-critical renames must carry
+    # the fsync-file + fsync-parent-dir discipline, which only
+    # durable_replace implements — a bare os.replace elsewhere is a
+    # publication that a power cut can silently vanish.
+    durable_rename_function: str = (
+        "kmlserver_tpu/io/artifacts.py::durable_replace"
+    )
+    # modules whose renames are NOT publication-critical (tooling state,
+    # not PVC artifacts); trailing "/" = directory prefix, like
+    # atomic_allowed_modules.
+    rename_allowed_modules: tuple[str, ...] = (
+        "kmlserver_tpu/analysis/",
+    )
 
     # --- knob registry checker ---
     config_file: str = "kmlserver_tpu/config.py"

@@ -422,7 +422,10 @@ def collect_fire_sites(index: ProjectIndex, cfg: AnalysisConfig) -> set[str]:
                     name = func.attr
                 elif isinstance(func, ast.Name):
                     name = func.id
-                if name == "fire" and node.args:
+                # fire() raises/kills at the site; take()/take_io() are
+                # the consume-style variants (fleet peer delays, ISSUE 19
+                # storage faults) — all three mean "this site is wired"
+                if name in ("fire", "take", "take_io") and node.args:
                     site = _site_literal(node.args[0])
                     if site:
                         sites.add(site)

@@ -229,6 +229,15 @@ KNOB_REGISTRY: dict[str, str] = {
     # dispatches (token bucket earning FRAC per primary dispatch);
     # exhausted budget falls back to plain waiting
     "KMLS_HEDGE_MAX_FRAC": "serving",
+    # --- serving: storage gray-failure spine (ISSUE 19) ---
+    # slow-IO conviction threshold: any artifact-plane op whose latency
+    # EWMA crosses this flips /readyz ready-but-degraded with reason
+    # storage-slow (kmls_storage_slow gauge); clears at half (hysteresis)
+    "KMLS_IO_SLOW_MS": "serving",
+    # deadline on reload-path artifact reads: a hung NFS read parks the
+    # reload in the normal failure backoff with last-good still serving
+    # instead of wedging the reload thread (0 = no deadline)
+    "KMLS_IO_READ_DEADLINE_S": "serving",
     # --- serving: observability (ISSUE 9) ---
     # span tracing: baseline sample rate for OK traces (0 = tracing off —
     # the zero-hot-path-cost default; shed/degraded/slowest-N traces are
@@ -323,6 +332,21 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_LEASE_ENABLED": "mining",
     "KMLS_LEASE_TTL_S": "mining",
     "KMLS_LEASE_HEARTBEAT_S": "mining",
+    # --- mining: storage gray-failure spine (ISSUE 19) ---
+    # ENOSPC ladder floor: publication preflight requires
+    # max(last-manifest bytes, this) free on the artifact volume,
+    # reclaims (quarantine + orphaned temp files) when short, then
+    # exits resumable (75) rather than starting a write it can't finish
+    "KMLS_DISK_MIN_FREE_BYTES": "mining",
+    # transient-EIO retry ladder for artifact-plane writes: attempt
+    # count and exponential-backoff base (ENOSPC and fsync failures
+    # never retry — see io/artifacts.py)
+    "KMLS_IO_RETRIES": "mining",
+    "KMLS_IO_RETRY_BASE_MS": "mining",
+    # lease heartbeat self-fence: a heartbeat write stalling past this
+    # fraction of the TTL means the writer can't prove it still holds
+    # the lease (hung mount) — it marks itself lost and aborts resumable
+    "KMLS_LEASE_STALL_FRACTION": "mining",
     "KMLS_RANK_TIMEOUT_S": "mining",
     "KMLS_RANK_HEARTBEAT_S": "mining",
     "KMLS_COLLECTIVE_TIMEOUT_S": "mining",
@@ -418,6 +442,11 @@ KNOB_REGISTRY: dict[str, str] = {
     # bracket's hedged-vs-control legs (CI smoke shrinks both)
     "KMLS_BENCH_SLOWPEER_QPS": "tool",
     "KMLS_BENCH_SLOWPEER_REQUESTS": "tool",
+    # storage gray-failure phase (ISSUE 19): rate / volume for the
+    # graystore bracket's stall-injected artifact-plane replay legs
+    # (CI smoke shrinks both)
+    "KMLS_BENCH_GRAYSTORE_QPS": "tool",
+    "KMLS_BENCH_GRAYSTORE_REQUESTS": "tool",
     # quality-loop phase (ISSUE 14): membership-row volume of the eval/
     # compaction bracket's synthetic workload (CI smoke shrinks it)
     "KMLS_BENCH_QUALITY_ROWS": "tool",
@@ -440,6 +469,13 @@ KNOB_REGISTRY: dict[str, str] = {
     "KMLS_FAULT_DELTA_CORRUPT": "fault",
     "KMLS_FAULT_MESH_PEER_DELAY_MS": "fault",
     "KMLS_FAULT_FLEET_PEER_DELAY_MS": "fault",
+    # storage plane (ISSUE 19): path-scoped faults consumed inside
+    # io/artifacts.py's single writer/reader (faults.take_io)
+    "KMLS_FAULT_IO_WRITE": "fault",
+    "KMLS_FAULT_IO_WRITE_STALL_MS": "fault",
+    "KMLS_FAULT_IO_READ": "fault",
+    "KMLS_FAULT_IO_READ_STALL_MS": "fault",
+    "KMLS_FAULT_IO_FSYNC": "fault",
 }
 
 # Columns dropped from the raw CSV before any processing
@@ -670,6 +706,14 @@ class MiningConfig:
     # too-long recompute). Keep below the Job's activeDeadlineSeconds;
     # 0 = 6 × rank_timeout_s.
     collective_timeout_s: float = 1800.0
+    # Storage gray-failure spine (ISSUE 19): operator floor for the
+    # publication free-space preflight — publication requires
+    # max(estimated artifact bytes, this) free, reclaims, then exits
+    # resumable. 0 disables the preflight.
+    disk_min_free_bytes: int = 64 * (1 << 20)
+    # Lease heartbeat self-fence threshold as a fraction of the TTL
+    # (0 disables self-fencing).
+    lease_stall_fraction: float = 0.5
 
     @property
     def pickles_dir(self) -> str:
@@ -748,6 +792,12 @@ class MiningConfig:
             ),
             collective_timeout_s=_getenv_float(
                 "KMLS_COLLECTIVE_TIMEOUT_S", 1800.0
+            ),
+            disk_min_free_bytes=_getenv_int(
+                "KMLS_DISK_MIN_FREE_BYTES", 64 * (1 << 20)
+            ),
+            lease_stall_fraction=_getenv_float(
+                "KMLS_LEASE_STALL_FRACTION", 0.5
             ),
         )
 
@@ -878,6 +928,11 @@ class ServingConfig:
     # the miner writes next.
     reload_backoff_base_s: float = 0.5
     reload_backoff_max_s: float = 30.0
+    # Storage gray-failure spine (ISSUE 19): deadline on reload-path
+    # artifact reads — a hung NFS read fails the reload into the normal
+    # backoff ladder above (last-good keeps serving) instead of wedging
+    # the reload thread forever. 0 disables the deadline.
+    io_read_deadline_s: float = 0.0
     # Per-replica consecutive-failure circuit breaker in the batchers:
     # after this many consecutive batch failures a replica is EJECTED from
     # the least-loaded dispatcher (its in-flight requests re-dispatch to
@@ -1121,6 +1176,7 @@ class ServingConfig:
             ),
             reload_backoff_base_s=_getenv_float("KMLS_RELOAD_BACKOFF_BASE_S", 0.5),
             reload_backoff_max_s=_getenv_float("KMLS_RELOAD_BACKOFF_MAX_S", 30.0),
+            io_read_deadline_s=_getenv_float("KMLS_IO_READ_DEADLINE_S", 0.0),
             replica_eject_threshold=_getenv_int("KMLS_REPLICA_EJECT_THRESHOLD", 3),
             replica_probe_interval_s=_getenv_float(
                 "KMLS_REPLICA_PROBE_INTERVAL_S", 5.0

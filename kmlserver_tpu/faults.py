@@ -55,6 +55,18 @@ Sites currently wired:
   member: a delay fault stalls every answer this peer serves, the
   fleet-side gray failure that the router's slow-outlier ladder and
   client hedging must absorb without a single 5xx.
+- ``"io.write"`` / ``"io.read"`` / ``"io.fsync"`` — the STORAGE fault
+  plane, consumed via :func:`take_io` inside ``io/artifacts.py``'s
+  single writer/reader so every artifact, manifest, token, lease and
+  checkpoint byte is coverable. Unlike the call-site faults above these
+  are **path-scoped**: each armed fault carries an optional path
+  substring, so a test can tear exactly ``recommendations`` while the
+  lease heartbeat keeps writing. Kinds: ``enospc`` (raise
+  ``OSError(ENOSPC)``), ``eio`` (raise ``OSError(EIO)``), ``torn@N``
+  (write only the first N bytes, then raise :class:`TornWrite` — what
+  a crashed writer leaves behind), ``stall`` (return seconds for the
+  caller to sleep — the slow-NFS gray failure), and plain ``fail`` for
+  ``io.fsync`` (fsyncgate: an fsync failure must abort, never retry).
 
 Arming, two ways:
 
@@ -83,7 +95,19 @@ Arming, two ways:
     stalls ``ms`` per partial it serves (default every partial);
   - ``KMLS_FAULT_FLEET_PEER_DELAY_MS=idx:ms[:N]`` — fleet peer ``idx``
     (sorted-peer position) stalls ``ms`` per request it answers
-    (default every request).
+    (default every request);
+  - ``KMLS_FAULT_IO_WRITE=kind[:N][:substr]`` — next N artifact-plane
+    writes whose destination path contains ``substr`` fail with
+    ``kind`` ∈ ``enospc`` | ``eio`` | ``torn@BYTES`` (default N=1,
+    any path);
+  - ``KMLS_FAULT_IO_WRITE_STALL_MS=ms[:N][:substr]`` — stall matching
+    writes ``ms`` each (default every write, any path);
+  - ``KMLS_FAULT_IO_READ=N[:substr]`` — next N matching artifact reads
+    raise ``OSError(EIO)``;
+  - ``KMLS_FAULT_IO_READ_STALL_MS=ms[:N][:substr]`` — stall matching
+    reads ``ms`` each (the hung-NFS-mount shape; default every read);
+  - ``KMLS_FAULT_IO_FSYNC=N[:substr]`` — next N matching fsyncs fail
+    (publication must abort cleanly — fsync errors are never retried).
 
 File corruption is a separate concern (faults happen to BYTES, not call
 sites): :func:`truncate_file` and :func:`flip_byte` are the helpers the
@@ -95,6 +119,7 @@ what an interrupted writer actually leaves behind.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import os
 import threading
 import time
@@ -112,6 +137,17 @@ class FaultInjected(RuntimeError):
     """Raised by :func:`fire` when a fail fault triggers."""
 
 
+class TornWrite(OSError):
+    """Raised by :func:`take_io` for a ``torn@N`` write fault: the caller
+    must write only the first ``keep_bytes`` bytes to the TEMP file and
+    then re-raise — reproducing exactly what a writer killed mid-write
+    leaves behind (a short temp file, never a torn destination)."""
+
+    def __init__(self, site: str, keep_bytes: int):
+        super().__init__(errno.EIO, f"injected torn write at {site}")
+        self.keep_bytes = keep_bytes
+
+
 @dataclasses.dataclass
 class _Fault:
     remaining: int  # -1 = unlimited
@@ -119,9 +155,25 @@ class _Fault:
     fired: int = 0
 
 
+@dataclasses.dataclass
+class _IoFault:
+    """A path-scoped storage fault (``io.*`` sites only)."""
+
+    kind: str  # "enospc" | "eio" | "torn" | "stall" | "fail"
+    remaining: int  # -1 = unlimited
+    stall_s: float = 0.0
+    torn_at: int = -1
+    path_substr: str = ""
+    fired: int = 0
+
+
 # (site, replica-or-None) -> _Fault; a replica-keyed lookup falls back to
 # the site-wide (replica=None) entry
 _faults: dict[tuple[str, int | None], _Fault] = {}
+
+# "io.write"/"io.read"/"io.fsync" -> armed storage faults, consumed in
+# arming order by the first fault whose path_substr matches
+_io_faults: dict[str, list[_IoFault]] = {}
 
 
 def inject(
@@ -130,11 +182,41 @@ def inject(
     replica: int | None = None,
     times: int = 1,
     delay_s: float = 0.0,
+    kind: str = "",
+    torn_at: int = -1,
+    path: str = "",
 ) -> None:
     """Arm a fault at ``site``: ``delay_s > 0`` sleeps per fire (a slow
     kernel), otherwise the fire raises :class:`FaultInjected` (a failing
-    kernel / reload). ``times=-1`` keeps firing until :func:`clear`."""
+    kernel / reload). ``times=-1`` keeps firing until :func:`clear`.
+
+    ``io.*`` sites route to the path-scoped storage plane instead:
+    ``kind`` picks the failure (``enospc``/``eio``/``torn``/``stall``/
+    ``fail``; defaults to ``stall`` when ``delay_s > 0``, else ``eio``
+    for reads/writes and ``fail`` for fsync), ``torn_at`` is the byte
+    count kept by a torn write, and ``path`` scopes the fault to
+    destinations containing that substring (empty = every path)."""
     global _armed
+    if site.startswith("io."):
+        if not kind:
+            if delay_s > 0:
+                kind = "stall"
+            elif torn_at >= 0:
+                kind = "torn"
+            else:
+                kind = "fail" if site == "io.fsync" else "eio"
+        with _lock:
+            _io_faults.setdefault(site, []).append(
+                _IoFault(
+                    kind=kind,
+                    remaining=times,
+                    stall_s=delay_s,
+                    torn_at=torn_at,
+                    path_substr=path,
+                )
+            )
+            _armed = True
+        return
     with _lock:
         _faults[(site, replica)] = _Fault(remaining=times, delay_s=delay_s)
         _armed = True
@@ -146,6 +228,7 @@ def clear() -> None:
     global _armed, _env_loaded
     with _lock:
         _faults.clear()
+        _io_faults.clear()
         _armed = False
         _env_loaded = False
 
@@ -153,7 +236,11 @@ def clear() -> None:
 def active() -> dict[tuple[str, int | None], int]:
     """Snapshot of armed faults → remaining counts (diagnostics)."""
     with _lock:
-        return {k: f.remaining for k, f in _faults.items()}
+        snap = {k: f.remaining for k, f in _faults.items()}
+        for site, lst in _io_faults.items():
+            for i, io_fault in enumerate(lst):
+                snap[(f"{site}#{i}", None)] = io_fault.remaining
+        return snap
 
 
 def fired_counts() -> dict[tuple[str, int | None], int]:
@@ -196,6 +283,41 @@ def fire(site: str, replica: int | None = None) -> None:
     delay = take(site, replica)
     if delay > 0:
         time.sleep(delay)
+
+
+def take_io(site: str, path: str) -> float:
+    """Consume one armed storage fault at ``site`` whose path scope
+    matches ``path`` → stall seconds (0.0 when nothing matches; the
+    CALLER sleeps, so read stalls can run under a deadline thread).
+    Error kinds raise the errno a real bad mount would: ``enospc`` →
+    ``OSError(ENOSPC)``, ``eio`` → ``OSError(EIO)``, ``torn`` →
+    :class:`TornWrite` (caller keeps ``keep_bytes`` then re-raises),
+    ``fail`` (fsync) → ``OSError(EIO)``."""
+    if not _armed and _env_loaded:
+        return 0.0
+    _ensure_env()
+    if not _armed:
+        return 0.0
+    with _lock:
+        fault = None
+        for candidate in _io_faults.get(site, ()):
+            if candidate.remaining != 0 and candidate.path_substr in path:
+                fault = candidate
+                break
+        if fault is None:
+            return 0.0
+        if fault.remaining > 0:
+            fault.remaining -= 1
+        fault.fired += 1
+        kind, stall_s, torn_at = fault.kind, fault.stall_s, fault.torn_at
+    if kind == "stall":
+        return stall_s
+    if kind == "enospc":
+        raise OSError(errno.ENOSPC, f"injected ENOSPC at {site}: {path}")
+    if kind == "torn":
+        raise TornWrite(site, max(torn_at, 0))
+    # "eio" and fsync "fail" both surface as the mount's EIO
+    raise OSError(errno.EIO, f"injected EIO at {site}: {path}")
 
 
 def load_env(force: bool = False) -> None:
@@ -259,6 +381,55 @@ def load_env(force: bool = False) -> None:
             "fleet.peer", replica=int(parts[0]),
             delay_s=float(parts[1]) / 1e3,
             times=int(parts[2]) if len(parts) > 2 else -1,
+        )
+    raw = os.getenv("KMLS_FAULT_IO_WRITE")
+    if raw:
+        parts = raw.split(":")
+        kind, _, torn = parts[0].partition("@")
+        inject(
+            "io.write",
+            kind="torn" if kind == "torn" else kind,
+            torn_at=int(torn) if torn else -1,
+            times=int(parts[1]) if len(parts) > 1 else 1,
+            path=parts[2] if len(parts) > 2 else "",
+        )
+    raw = os.getenv("KMLS_FAULT_IO_WRITE_STALL_MS")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "io.write",
+            kind="stall",
+            delay_s=float(parts[0]) / 1e3,
+            times=int(parts[1]) if len(parts) > 1 else -1,
+            path=parts[2] if len(parts) > 2 else "",
+        )
+    raw = os.getenv("KMLS_FAULT_IO_READ")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "io.read",
+            kind="eio",
+            times=int(parts[0]) if parts[0] else 1,
+            path=parts[1] if len(parts) > 1 else "",
+        )
+    raw = os.getenv("KMLS_FAULT_IO_READ_STALL_MS")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "io.read",
+            kind="stall",
+            delay_s=float(parts[0]) / 1e3,
+            times=int(parts[1]) if len(parts) > 1 else -1,
+            path=parts[2] if len(parts) > 2 else "",
+        )
+    raw = os.getenv("KMLS_FAULT_IO_FSYNC")
+    if raw:
+        parts = raw.split(":")
+        inject(
+            "io.fsync",
+            kind="fail",
+            times=int(parts[0]) if parts[0] else 1,
+            path=parts[1] if len(parts) > 1 else "",
         )
 
 

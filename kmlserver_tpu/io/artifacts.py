@@ -23,11 +23,26 @@ reference could interoperate with this rebuild, and adds:
   fencing token, :class:`PublicationLease`) so a zombie mining job left
   behind by the GitOps ``Replace`` resync cannot tear artifacts a newer
   run already published — the manifest records the fencing token of the
-  generation that wrote it.
+  generation that wrote it;
+- a **durable-write discipline** (ISSUE 19): every publication-critical
+  rename goes through :func:`durable_replace` — fsync the temp file,
+  rename, fsync the parent directory — because ``os.replace`` alone
+  orders nothing against the page cache: a node crash after the rename
+  can reboot into a manifest whose bytes never hit the platter. Writes
+  retry transient errnos (EIO/EAGAIN/ESTALE — the NFS gray-failure
+  set) with bounded exponential backoff; ENOSPC never retries (the
+  :func:`ensure_free_space` ladder + resumable exit own that), and an
+  fsync failure never retries (after a failed fsync the kernel may have
+  DROPPED the dirty pages — retrying reports durability that doesn't
+  exist; see :class:`FsyncFailedError`). Every byte in or out feeds the
+  IO-health monitor (``io/iohealth.py``) and every write/read/fsync
+  passes a path-scoped fault gate (``faults.take_io``), so the whole
+  artifact plane is chaos-coverable.
 """
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import io
 import json
@@ -40,6 +55,9 @@ import time
 from typing import Any
 
 import numpy as np
+
+from .. import faults
+from .iohealth import MONITOR
 
 TENSOR_ARTIFACT_SUFFIX = ".tensors.npz"
 MANIFEST_FILENAME = "artifacts.manifest.json"
@@ -88,23 +106,222 @@ class ArtifactIntegrityError(RuntimeError):
         self.paths = paths
 
 
-def _atomic_write_bytes(path: str, data: bytes) -> None:
+class StorageExhaustedError(RuntimeError):
+    """The artifact volume is out of space even after reclamation.
+    Resumable (exit 75): checkpoints are already on disk, so the retried
+    job skips straight back to publication once an operator (or the
+    cluster autoscaler) restores capacity."""
+
+
+class FsyncFailedError(OSError):
+    """``fsync`` reported failure on a publication-critical file.
+
+    NEVER retried (the fsyncgate lesson): after a failed fsync, Linux
+    marks the dirty pages clean — a second fsync returns success while
+    the bytes were silently dropped. The only safe move is to abort the
+    publication with the destination untouched and re-run from
+    checkpoints, which rewrites the bytes from scratch."""
+
+
+class IoStallError(OSError):
+    """A deadline-bounded artifact read outlived its deadline — the
+    hung-NFS-mount shape. The reader thread is parked (daemon) and the
+    caller fails the operation instead of wedging; the engine turns this
+    into a normal reload failure (backoff + last-good serving)."""
+
+
+# the NFS/Filestore gray-failure errno set: worth one bounded retry
+# ladder. ENOSPC is deliberately absent (the reclamation ladder owns
+# it) and fsync failures bypass retries entirely (FsyncFailedError).
+_TRANSIENT_ERRNOS = (errno.EIO, errno.EAGAIN, errno.ESTALE)
+
+
+def _io_retries() -> int:
+    from ..config import _getenv_int
+
+    return max(_getenv_int("KMLS_IO_RETRIES", 2), 0)
+
+
+def _io_retry_base_s() -> float:
+    from ..config import _getenv_float
+
+    return max(_getenv_float("KMLS_IO_RETRY_BASE_MS", 50.0), 0.0) / 1e3
+
+
+def _fsync_file(path: str, dest_path: str) -> None:
+    """fsync ``path`` (the temp file about to be renamed over
+    ``dest_path``, which is the path fault scopes match against).
+    Raises :class:`FsyncFailedError` — and only that — on failure."""
+    try:
+        stall = faults.take_io("io.fsync", dest_path)
+        if stall > 0:
+            time.sleep(stall)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError as exc:
+        raise FsyncFailedError(
+            exc.errno or errno.EIO, f"fsync failed for {dest_path}: {exc}"
+        ) from exc
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync the parent directory so the RENAME itself is durable. Best
+    effort on refusal: some filesystems reject directory fsync (EINVAL)
+    and the file fsync already carried the data — only the name's
+    durability window remains, which a re-run closes."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def durable_replace(src: str, dst: str, *, durable: bool = True) -> None:
+    """THE publication rename: fsync ``src``, ``os.replace`` it over
+    ``dst``, fsync the parent directory. Every rename that publishes
+    bytes readers trust (manifest, token, lease, delta bundles,
+    checkpoints) must come through here — the atomic-write checker
+    (``analysis/atomicwrite.py``) flags any rename that bypasses it.
+    ``durable=False`` skips both fsyncs for best-effort writers
+    (telemetry, quarantine moves) that still want the atomic rename."""
+    if durable:
+        _fsync_file(src, dst)
+    os.replace(src, dst)
+    if durable:
+        _fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def _atomic_write_once(
+    path: str, data: bytes, *, durable: bool, op: str
+) -> None:
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     fd, tmp_path = tempfile.mkstemp(dir=directory, prefix=".tmp_", suffix=".part")
+    torn = False
+    start = time.monotonic()
     try:
         with os.fdopen(fd, "wb") as fh:
+            try:
+                stall = faults.take_io("io.write", path)
+            except faults.TornWrite as exc:
+                # a torn write IS the crash artifact: leave the short
+                # temp file behind (reclaim_space collects orphans), the
+                # destination is never touched
+                torn = True
+                fh.write(data[: exc.keep_bytes])
+                raise
+            if stall > 0:
+                time.sleep(stall)
             fh.write(data)
         # mkstemp creates 0600; artifacts are read by the API replicas
         # (possibly a different uid on the shared volume)
         os.chmod(tmp_path, 0o644)
-        os.replace(tmp_path, path)
+        durable_replace(tmp_path, path, durable=durable)
+        MONITOR.note_latency(op, time.monotonic() - start)
     except BaseException:
-        try:
-            os.unlink(tmp_path)
-        except OSError:
-            pass
+        if not torn:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
         raise
+
+
+def _atomic_write_bytes(
+    path: str, data: bytes, *, durable: bool = True, op: str = "write"
+) -> None:
+    """Atomic (and by default durable) write with the bounded transient-
+    errno retry ladder. The retry set is deliberately narrow: EIO/
+    EAGAIN/ESTALE (a flaky NFS mount) retry up to ``KMLS_IO_RETRIES``
+    times with ``KMLS_IO_RETRY_BASE_MS`` exponential backoff; ENOSPC
+    surfaces immediately (reclamation + resumable exit own it),
+    :class:`FsyncFailedError` surfaces immediately (retrying a failed
+    fsync masks dropped pages), torn writes surface immediately (they
+    model a dead writer — nobody is left to retry)."""
+    attempt = 0
+    while True:
+        try:
+            _atomic_write_once(path, data, durable=durable, op=op)
+            return
+        except (FsyncFailedError, faults.TornWrite) as exc:
+            MONITOR.note_error(op, exc.errno or 0)
+            raise
+        except OSError as exc:
+            MONITOR.note_error(op, exc.errno or 0)
+            if (
+                exc.errno not in _TRANSIENT_ERRNOS
+                or attempt >= _io_retries()
+            ):
+                raise
+            MONITOR.note_retry()
+            time.sleep(_io_retry_base_s() * (2**attempt))
+            attempt += 1
+
+
+def _read_bytes(
+    path: str, *, op: str = "read", deadline_s: float | None = None
+) -> bytes:
+    """Read ``path`` through the fault gate + IO-health ledger.
+
+    With ``deadline_s`` the read runs on a parked daemon thread and
+    :class:`IoStallError` fires at the deadline — a hung NFS read must
+    park the RELOAD in backoff (last-good keeps serving), not wedge the
+    reload thread forever."""
+
+    def _do_read() -> bytes:
+        stall = faults.take_io("io.read", path)
+        if stall > 0:
+            time.sleep(stall)
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    start = time.monotonic()
+    if deadline_s is None or deadline_s <= 0:
+        try:
+            data = _do_read()
+        except OSError as exc:
+            MONITOR.note_error(op, exc.errno or 0)
+            raise
+        MONITOR.note_latency(op, time.monotonic() - start)
+        return data
+    result: list[bytes] = []
+    error: list[BaseException] = []
+
+    def _worker() -> None:
+        try:
+            result.append(_do_read())
+        except BaseException as exc:  # noqa: BLE001 — relayed below
+            error.append(exc)
+
+    thread = threading.Thread(
+        target=_worker, name="kmls-io-read", daemon=True
+    )
+    thread.start()
+    thread.join(deadline_s)
+    if thread.is_alive():
+        # the read's latency is AT LEAST the deadline — feed that floor
+        # to the EWMA so a silently hung mount still convicts
+        MONITOR.note_error(op, errno.ETIMEDOUT)
+        MONITOR.note_latency(op, deadline_s)
+        raise IoStallError(
+            errno.ETIMEDOUT,
+            f"read of {path} exceeded its {deadline_s:.3f}s deadline",
+        )
+    if error:
+        exc = error[0]
+        if isinstance(exc, OSError):
+            MONITOR.note_error(op, exc.errno or 0)
+        raise exc
+    MONITOR.note_latency(op, time.monotonic() - start)
+    return result[0]
 
 
 def _reference_race_compat() -> bool:
@@ -134,18 +351,22 @@ def save_pickle(obj: Any, path: str) -> None:
     _atomic_write_bytes(path, data)
 
 
-def load_pickle(path: str) -> Any:
-    with open(path, "rb") as fh:
-        return pickle.load(fh)
+def load_pickle(
+    path: str, *, op: str = "read", deadline_s: float | None = None
+) -> Any:
+    return pickle.loads(_read_bytes(path, op=op, deadline_s=deadline_s))
 
 
-def atomic_write_text(path: str, text: str) -> None:
-    _atomic_write_bytes(path, text.encode("utf-8"))
+def atomic_write_text(
+    path: str, text: str, *, durable: bool = True, op: str = "write"
+) -> None:
+    _atomic_write_bytes(path, text.encode("utf-8"), durable=durable, op=op)
 
 
-def read_text(path: str) -> str:
-    with open(path, "r", encoding="utf-8") as fh:
-        return fh.read()
+def read_text(
+    path: str, *, op: str = "read", deadline_s: float | None = None
+) -> str:
+    return _read_bytes(path, op=op, deadline_s=deadline_s).decode("utf-8")
 
 
 def tensor_artifact_path(recommendations_pickle_path: str) -> str:
@@ -222,14 +443,17 @@ def write_manifest(
     return out
 
 
-def load_manifest(pickles_dir: str) -> dict[str, Any] | None:
+def load_manifest(
+    pickles_dir: str, *, deadline_s: float | None = None
+) -> dict[str, Any] | None:
     """The parsed manifest, or None when absent/unreadable — a PVC written
     by an older miner (or the reference) has no manifest, and integrity
     checking must degrade to the pre-manifest behavior there, not block."""
     path = manifest_path(pickles_dir)
     try:
-        with open(path, "r", encoding="utf-8") as fh:
-            data = json.load(fh)
+        data = json.loads(
+            _read_bytes(path, deadline_s=deadline_s).decode("utf-8")
+        )
     except (OSError, ValueError):
         return None
     return data if isinstance(data.get("files"), dict) else None
@@ -281,10 +505,120 @@ def quarantine_file(path: str) -> str | None:
         dest = os.path.join(
             qdir, f"{os.path.basename(path)}.{int(time.time())}"
         )
-        os.replace(path, dest)
+        # atomic but NOT durable: quarantine is forensics, not
+        # publication — losing the move in a crash costs nothing
+        durable_replace(path, dest, durable=False)
         return dest
     except OSError:
         return None
+
+
+# ---------- the ENOSPC ladder (free space before publication) ----------
+
+
+def disk_free_bytes(path: str) -> int:
+    """Free bytes available to this process on ``path``'s filesystem."""
+    stat = os.statvfs(path)
+    return stat.f_bavail * stat.f_frsize
+
+
+def estimate_publication_bytes(pickles_dir: str) -> int:
+    """Expected size of the NEXT artifact set, estimated from the last
+    manifest (generation-over-generation sizes move slowly — the vocab
+    and rule caps are config-pinned). 0 with no manifest: the preflight
+    then falls back to the operator floor alone."""
+    manifest = load_manifest(pickles_dir)
+    if manifest is None:
+        return 0
+    total = 0
+    for entry in manifest.get("files", {}).values():
+        try:
+            total += int(entry.get("bytes", 0))
+        except (TypeError, ValueError):
+            continue
+    return total
+
+
+def reclaim_space(
+    pickles_dir: str, extra_dirs: tuple[str, ...] | list[str] = ()
+) -> int:
+    """Delete every reclaimable byte the artifact plane owns → bytes
+    freed (by file size, best effort, never raises).
+
+    The ladder, cheapest-to-lose first: quarantined corpses (forensics
+    only), orphaned ``.tmp_*.part`` files (dead writers' leftovers),
+    then ``extra_dirs`` (retired checkpoint stores a caller explicitly
+    hands over — NEVER the live store, which resume depends on).
+    Delta bundles are deliberately NOT reclaimed here: pre-publication
+    the serving fleet may still be applying them to last-good."""
+    freed = 0
+
+    def _unlink(path: str) -> None:
+        nonlocal freed
+        try:
+            size = os.path.getsize(path)
+            os.unlink(path)
+            freed += size
+        except OSError:
+            pass
+
+    qdir = os.path.join(pickles_dir, QUARANTINE_DIRNAME)
+    try:
+        for name in os.listdir(qdir):
+            _unlink(os.path.join(qdir, name))
+    except OSError:
+        pass
+    try:
+        for name in os.listdir(pickles_dir):
+            if name.startswith(".tmp_") and name.endswith(".part"):
+                _unlink(os.path.join(pickles_dir, name))
+    except OSError:
+        pass
+    for directory in extra_dirs:
+        try:
+            entries = os.listdir(directory)
+        except OSError:
+            continue
+        for name in entries:
+            path = os.path.join(directory, name)
+            if os.path.isfile(path):
+                _unlink(path)
+    return freed
+
+
+def ensure_free_space(
+    pickles_dir: str,
+    min_free_bytes: int,
+    extra_dirs: tuple[str, ...] | list[str] = (),
+) -> int:
+    """The publication preflight: require ``min_free_bytes`` free on the
+    artifact volume, reclaiming (:func:`reclaim_space`) if short, and
+    raising :class:`StorageExhaustedError` (→ resumable exit 75) if
+    still short — so publication NEVER starts a write it cannot finish:
+    the failure mode is \"last-good keeps serving, job retries under
+    k8s backoff\", never a torn artifact set. → free bytes after."""
+    if min_free_bytes <= 0:
+        return 0
+    # first run: the artifact dir may not exist yet — the preflight runs
+    # before any write, and the writer owns creating it anyway
+    os.makedirs(pickles_dir, exist_ok=True)
+    free = disk_free_bytes(pickles_dir)
+    MONITOR.watch_disk(pickles_dir)
+    if free >= min_free_bytes:
+        return free
+    freed = reclaim_space(pickles_dir, extra_dirs)
+    free = disk_free_bytes(pickles_dir)
+    if free >= min_free_bytes:
+        print(
+            f"Artifact volume short on space — reclaimed {freed} bytes "
+            f"({free} now free, {min_free_bytes} required)"
+        )
+        return free
+    raise StorageExhaustedError(
+        f"artifact volume has {free} free bytes after reclaiming {freed}; "
+        f"publication needs {min_free_bytes} — exiting resumable rather "
+        "than risking a torn publication"
+    )
 
 
 # ---------- lease-fenced publication ----------
@@ -310,8 +644,9 @@ def lease_path(pickles_dir: str) -> str:
 
 def _read_lease(pickles_dir: str) -> dict[str, Any] | None:
     try:
-        with open(lease_path(pickles_dir), "r", encoding="utf-8") as fh:
-            data = json.load(fh)
+        data = json.loads(
+            _read_bytes(lease_path(pickles_dir)).decode("utf-8")
+        )
     except (OSError, ValueError):
         return None
     return data if isinstance(data, dict) else None
@@ -358,12 +693,24 @@ class PublicationLease:
         fencing_token: int,
         ttl_s: float,
         heartbeat_interval_s: float | None = None,
+        stall_fraction: float | None = None,
     ):
+        from ..config import _getenv_float
+
         self.pickles_dir = pickles_dir
         self.owner = owner
         self.fencing_token = fencing_token
         self.ttl_s = ttl_s
         self.heartbeat_interval_s = heartbeat_interval_s or max(ttl_s / 3, 0.05)
+        # self-fencing threshold: a heartbeat WRITE that takes longer
+        # than this fraction of the TTL means the mount is hung badly
+        # enough that our on-disk heartbeat may already look dead to a
+        # challenger — assume expropriated rather than risk two writers
+        self.stall_fraction = (
+            stall_fraction
+            if stall_fraction is not None
+            else _getenv_float("KMLS_LEASE_STALL_FRACTION", 0.5)
+        )
         self.lost = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -375,6 +722,7 @@ class PublicationLease:
         ttl_s: float = 60.0,
         owner: str | None = None,
         heartbeat_interval_s: float | None = None,
+        stall_fraction: float | None = None,
     ) -> "PublicationLease":
         """Take the publication lease or raise :class:`LeaseHeldError`."""
         owner = owner or (
@@ -395,7 +743,8 @@ class PublicationLease:
                     f"{current.get('ttl_s')}s)"
                 )
         lease = cls(
-            pickles_dir, owner, prev_token + 1, ttl_s, heartbeat_interval_s
+            pickles_dir, owner, prev_token + 1, ttl_s, heartbeat_interval_s,
+            stall_fraction=stall_fraction,
         )
         lease._write()
         # read-back: in a same-instant race the later rename wins; the
@@ -440,9 +789,27 @@ class PublicationLease:
         )
 
     def heartbeat(self) -> None:
-        """One ownership-checked heartbeat (raises when fenced)."""
+        """One ownership-checked heartbeat (raises when fenced).
+
+        SELF-FENCES on its own slowness: if the heartbeat write stalls
+        past ``stall_fraction·ttl_s`` (a hung NFS mount), this writer
+        cannot know whether its on-disk heartbeat is still younger than
+        the TTL — a challenger may already hold a newer token. The only
+        safe belief is "lost": mark sticky-lost and raise, so the
+        pipeline's next :meth:`check` aborts resumable BEFORE any
+        artifact write a real holder wouldn't have raced."""
         self.check()
+        start = time.monotonic()
         self._write()
+        elapsed = time.monotonic() - start
+        if self.stall_fraction > 0 and elapsed > self.ttl_s * self.stall_fraction:
+            self.lost = True
+            raise LeaseLostError(
+                f"lease heartbeat stalled {elapsed:.2f}s (> "
+                f"{self.stall_fraction:.2f}·ttl {self.ttl_s:.2f}s) — this "
+                "writer cannot prove it still holds the lease and "
+                "self-fences"
+            )
 
     def start_heartbeat(self) -> None:
         """Refresh the lease every ``heartbeat_interval_s`` until
@@ -541,11 +908,16 @@ def save_rule_tensors(
     _atomic_write_bytes(path, buf.getvalue())
 
 
-def load_rule_tensors(path: str) -> dict[str, Any]:
-    """Load the npz artifact, deriving serving-ready float32 confidences."""
+def load_rule_tensors(
+    path: str, *, deadline_s: float | None = None
+) -> dict[str, Any]:
+    """Load the npz artifact, deriving serving-ready float32 confidences.
+    The BYTES come through :func:`_read_bytes` (fault gate + IO health +
+    optional deadline); parsing happens off-disk on a BytesIO."""
     from ..ops.rules import derive_confs
 
-    with np.load(path, allow_pickle=True) as npz:
+    raw = io.BytesIO(_read_bytes(path, deadline_s=deadline_s))
+    with np.load(raw, allow_pickle=True) as npz:
         rule_counts = npz["rule_counts"]
         item_counts = npz["item_counts"]
         n_playlists = int(npz["n_playlists"])
@@ -634,13 +1006,16 @@ def remove_embeddings(pickles_dir: str) -> bool:
         return False
 
 
-def load_embeddings(path: str) -> dict[str, Any]:
+def load_embeddings(
+    path: str, *, deadline_s: float | None = None
+) -> dict[str, Any]:
     """Load + validate the embedding artifact. Raises ``ValueError`` on
     any structural problem (shape mismatch, non-finite factors, unknown
     format version) — the engine treats every raise here as "corrupt"
     and serves rules-only, so validation must be strict enough that a
     torn file can never publish garbage similarities."""
-    with np.load(path, allow_pickle=True) as npz:
+    raw = io.BytesIO(_read_bytes(path, deadline_s=deadline_s))
+    with np.load(raw, allow_pickle=True) as npz:
         if "item_factors" not in npz.files or "vocab" not in npz.files:
             raise ValueError(f"{path}: not an embedding artifact")
         version = int(npz["version"]) if "version" in npz.files else 0
@@ -689,8 +1064,9 @@ def load_quality_report(pickles_dir: str) -> dict[str, Any] | None:
     serving engine treats every None as 'no measurement published' and
     the measured blend mode fails safe to its default."""
     try:
-        with open(quality_report_path(pickles_dir), "r", encoding="utf-8") as fh:
-            data = json.load(fh)
+        data = json.loads(
+            _read_bytes(quality_report_path(pickles_dir)).decode("utf-8")
+        )
     except (OSError, ValueError):
         return None
     return data if isinstance(data, dict) else None
@@ -802,7 +1178,8 @@ def load_delta_bundle(path: str, expect_sha256: str | None = None) -> dict[str, 
                 f"{path}: bundle sha256 {digest} != chain entry "
                 f"{expect_sha256} (torn or tampered delta)"
             )
-    with np.load(path, allow_pickle=True) as npz:
+    raw = io.BytesIO(_read_bytes(path))
+    with np.load(raw, allow_pickle=True) as npz:
         required = (
             "version", "seq", "base_token", "base_npz_sha256",
             "n_playlists", "min_count", "vocab", "changed_rows",
@@ -853,8 +1230,9 @@ def read_delta_state(pickles_dir: str) -> dict[str, Any] | None:
     """The parsed delta chain file, or None when absent/unreadable (no
     chain is the normal state between full publications)."""
     try:
-        with open(delta_state_path(pickles_dir), "r", encoding="utf-8") as fh:
-            data = json.load(fh)
+        data = json.loads(
+            _read_bytes(delta_state_path(pickles_dir)).decode("utf-8")
+        )
     except (OSError, ValueError):
         return None
     if not isinstance(data, dict) or not isinstance(data.get("entries"), list):

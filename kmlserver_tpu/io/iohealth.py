@@ -1,0 +1,141 @@
+"""IO-health monitor — the storage counterpart of the peer gray-failure
+spine (ISSUE 18).
+
+The artifact plane lives on a ReadWriteMany PVC which in practice means
+NFS (Filestore, EFS, …), and the canonical NFS failure is not ENOENT —
+it is *slow*: reads that take hundreds of milliseconds, writes that hang
+for seconds, a mount that is alive enough to never error but sick enough
+to wedge any thread that touches it. This module gives the artifact
+plane the same observability the fleet router gives peers: a per-
+operation latency EWMA (token poll, reload reads, publication writes,
+fsync), an error/retry ledger, a free-space gauge, and a hysteresis
+"storage slow" conviction that the app surfaces as a ready-but-degraded
+``/readyz`` reason (``storage-slow``) — degraded, NOT unready, because
+serving runs entirely from memory and a slow disk must never knock a
+healthy replica out of the load balancer.
+
+Conviction mirrors the peer-health constants: EWMA alpha 0.2, a minimum
+sample count before any conviction (a single cold-cache read must not
+flip the gauge), convict when any op's EWMA crosses
+``KMLS_IO_SLOW_MS``, clear only when every op falls back under half the
+threshold (hysteresis — a mount bouncing around the threshold reads as
+one conviction, not a pulse train).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..config import _getenv_float
+
+# Same spine constants as serving/fleet.py's peer-health machine: a
+# 0.2-alpha EWMA converges in a handful of observations while one
+# outlier moves it only 20%, and 8 samples is enough history that a
+# conviction means a *pattern*, not a cold cache.
+EWMA_ALPHA = 0.2
+MIN_SAMPLES = 8
+DEFAULT_SLOW_MS = 250.0
+
+
+class IoHealthMonitor:
+    """Latency/error/space ledger for one process's artifact plane."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ewma_s: dict[str, float] = {}
+        self._samples: dict[str, int] = {}
+        self._errors: dict[tuple[str, int], int] = {}
+        self._retries = 0
+        self._slow = False
+        self._disk_path: str | None = None
+
+    # ---------- observations ----------
+
+    def note_latency(self, op: str, seconds: float) -> None:
+        """Record one operation's wall clock and re-evaluate the slow
+        conviction. ``op`` ∈ token_poll / read / write / fsync."""
+        seconds = max(seconds, 0.0)
+        slow_s = _getenv_float("KMLS_IO_SLOW_MS", DEFAULT_SLOW_MS) / 1e3
+        with self._lock:
+            prev = self._ewma_s.get(op)
+            self._ewma_s[op] = (
+                seconds
+                if prev is None
+                else prev + EWMA_ALPHA * (seconds - prev)
+            )
+            self._samples[op] = self._samples.get(op, 0) + 1
+            convicted = any(
+                ewma > slow_s and self._samples.get(name, 0) >= MIN_SAMPLES
+                for name, ewma in self._ewma_s.items()
+            )
+            if convicted:
+                self._slow = True
+            elif self._slow and all(
+                ewma < slow_s / 2 for ewma in self._ewma_s.values()
+            ):
+                self._slow = False
+
+    def note_error(self, op: str, err_errno: int) -> None:
+        with self._lock:
+            key = (op, err_errno)
+            self._errors[key] = self._errors.get(key, 0) + 1
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self._retries += 1
+
+    # ---------- disk space ----------
+
+    def watch_disk(self, path: str) -> None:
+        """Point the free-space gauge at the artifact mount."""
+        with self._lock:
+            self._disk_path = path
+
+    def disk_free_bytes(self) -> int | None:
+        with self._lock:
+            path = self._disk_path
+        if not path:
+            return None
+        try:
+            stat = os.statvfs(path)
+        except OSError:
+            return None
+        return stat.f_bavail * stat.f_frsize
+
+    # ---------- state reads ----------
+
+    def storage_slow(self) -> bool:
+        with self._lock:
+            return self._slow
+
+    def snapshot(self) -> dict[str, object]:
+        """One coherent view for the metrics renderer."""
+        with self._lock:
+            latency = dict(self._ewma_s)
+            errors = dict(self._errors)
+            retries = self._retries
+            slow = self._slow
+        return {
+            "latency_s": latency,
+            "errors": errors,
+            "retries": retries,
+            "storage_slow": slow,
+            "disk_free_bytes": self.disk_free_bytes(),
+        }
+
+    def reset(self) -> None:
+        """Forget everything (test teardown)."""
+        with self._lock:
+            self._ewma_s.clear()
+            self._samples.clear()
+            self._errors.clear()
+            self._retries = 0
+            self._slow = False
+            self._disk_path = None
+
+
+# One process-wide monitor: artifacts.py feeds it from whichever thread
+# touches the PVC; the app renders it. Same singleton shape as the
+# faults switchboard.
+MONITOR = IoHealthMonitor()

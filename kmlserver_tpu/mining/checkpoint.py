@@ -18,10 +18,14 @@ Correctness is guarded on three axes:
   checkpoint written for a different config or dataset NEVER resumes — the
   whole store self-retires to full recompute (it is stale state, not
   evidence of corruption, so it is deleted rather than quarantined).
-- **integrity**: each payload is pickled, written atomically
-  (tmp + ``os.replace``), and manifested with size + sha256 in the store's
-  ``state.json``. Bytes that disagree with the manifest (a torn write, bit
-  rot) retire that phase to recompute on the spot.
+- **integrity**: each payload is pickled, written through the shared
+  durable writer (``io.artifacts._atomic_write_bytes``: tmp file,
+  fsync, ``durable_replace``, transient-EIO retries — ISSUE 19 made
+  that writer fsync-before-rename, closing the latent gap where a node
+  crash after the rename rebooted into a state.json whose bytes never
+  hit disk), and manifested with size + sha256 in the store's
+  ``state.json``. Bytes that disagree with the manifest (a torn write,
+  bit rot) retire that phase to recompute on the spot.
 - **parse strikes**: bytes that VERIFY but fail to unpickle are a poison
   payload (e.g. written corrupt — ``KMLS_FAULT_CKPT_CORRUPT`` fires
   exactly this). One failure could be bad luck; after
@@ -47,6 +51,7 @@ from typing import Any
 
 from .. import faults
 from ..config import MiningConfig
+from ..io import artifacts
 from ..io.artifacts import _atomic_write_bytes, file_digest, quarantine_file
 
 # ordered checkpoint phases of the mining pipeline (mining/pipeline.py):
@@ -404,10 +409,19 @@ def heartbeat_dir(cfg: MiningConfig) -> str:
     return os.path.join(cfg.checkpoint_path, "heartbeats")
 
 
+def retired_dirs(cfg: MiningConfig) -> tuple[str, ...]:
+    """Checkpoint-side directories whose contents are safe to delete when
+    the PVC runs short (``io.artifacts.reclaim_space`` extra_dirs): the
+    store's quarantine of corrupt ``.ckpt`` corpses. The LIVE store is
+    never offered — deleting it would cost this run its resume state."""
+    return (os.path.join(cfg.checkpoint_path, artifacts.QUARANTINE_DIRNAME),)
+
+
 __all__ = [
     "PHASES",
     "CheckpointStore",
     "compute_fingerprint",
     "open_store",
     "heartbeat_dir",
+    "retired_dirs",
 ]

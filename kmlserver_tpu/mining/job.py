@@ -13,8 +13,10 @@ Exit-code contract (kubernetes/job.yaml podFailurePolicy binds it):
   PVC, invalid dataset content. Retrying burns TPU quota for the same
   failure, so the Job's podFailurePolicy fails the whole Job on it.
 - ``75`` (EXIT_RESUMABLE, EX_TEMPFAIL) — transient abort: an injected
-  preemption-style crash, or the publication lease held/lost to another
-  writer. A retry resumes from the phase checkpoint; podFailurePolicy
+  preemption-style crash, the publication lease held/lost to another
+  writer, or the PVC out of space even after reclamation
+  (``StorageExhaustedError`` / ENOSPC — retention frees space, then a
+  retry resumes). A retry resumes from the phase checkpoint; podFailurePolicy
   Ignores it (does not count against backoffLimit — a preempted pod is
   not a crashing pod).
 - ``76`` (EXIT_RANK_DEAD) — the dead-rank watchdog bounded a multi-host
@@ -45,8 +47,14 @@ RETRYABLE_EXIT_CODES = (EXIT_RESUMABLE, EXIT_RANK_DEAD)
 def classify_exception(exc: BaseException) -> int:
     """Map an abort to the exit-code contract above. The ONE policy
     deciding what k8s should retry."""
+    import errno
+
     from .. import faults
-    from ..io.artifacts import LeaseHeldError, LeaseLostError
+    from ..io.artifacts import (
+        LeaseHeldError,
+        LeaseLostError,
+        StorageExhaustedError,
+    )
     from .vocab import DuplicateArtistURIError
 
     if isinstance(exc, faults.FaultInjected):
@@ -54,6 +62,13 @@ def classify_exception(exc: BaseException) -> int:
     if isinstance(exc, (LeaseHeldError, LeaseLostError)):
         # another writer is live (or superseded us): back off and retry —
         # by then the holder has finished or its lease expired
+        return EXIT_RESUMABLE
+    if isinstance(exc, StorageExhaustedError) or (
+        isinstance(exc, OSError) and exc.errno == errno.ENOSPC
+    ):
+        # disk full is an OPERATOR condition, not a config bug: reclaim/
+        # retention frees space and a retry resumes from the checkpoint.
+        # Must precede the FileNotFoundError branch — both are OSErrors.
         return EXIT_RESUMABLE
     if isinstance(exc, (DuplicateArtistURIError, ValueError, FileNotFoundError)):
         # bad config/env/data: the same inputs fail the same way forever

@@ -312,11 +312,27 @@ def run_mining_job(
     # its heartbeats prove liveness for the whole mine, and a superseding
     # run (GitOps Replace) fences this one out before it can publish.
     lease = None
+    if is_writer:
+        # ENOSPC preflight BEFORE the expensive phases: estimate the
+        # publication from the last manifest (0 on first run), reclaim
+        # quarantine + orphaned temp files if short, and exit resumable
+        # (75) rather than tear a publication hours from now. Retired
+        # phase checkpoints are fair game — a full mine re-derives them.
+        free = artifacts.ensure_free_space(
+            cfg.pickles_dir,
+            max(
+                artifacts.estimate_publication_bytes(cfg.pickles_dir),
+                cfg.disk_min_free_bytes,
+            ),
+            extra_dirs=(ckpt_mod.retired_dirs(cfg)),
+        )
+        print(f"Disk preflight: {free / (1 << 20):.0f} MiB free on PVC")
     if is_writer and cfg.lease_enabled:
         lease = artifacts.PublicationLease.acquire(
             cfg.pickles_dir,
             ttl_s=cfg.lease_ttl_s,
             heartbeat_interval_s=cfg.lease_heartbeat_interval_s or None,
+            stall_fraction=cfg.lease_stall_fraction,
         )
         lease.start_heartbeat()
         print(f"Publication lease acquired (fencing token {lease.fencing_token})")

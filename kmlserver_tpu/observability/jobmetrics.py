@@ -204,7 +204,10 @@ class JobMetrics:
         text = self.render()
         try:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            artifacts.atomic_write_text(self.path, text)
+            # atomic + retried via the shared writer, but durable=False:
+            # telemetry does not need an fsync per phase, and a textfile
+            # lost to a crash is regenerated by the next run anyway
+            artifacts.atomic_write_text(self.path, text, durable=False)
         except OSError as exc:
             # Telemetry is best-effort BY CONTRACT: a transient PVC error
             # (ENOSPC, EIO, stale NFS handle) on this file must never fail

@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import faults
 from ..config import ServingConfig
+from ..io import iohealth
 from ..observability import LoopLagMonitor, SloTracker, SpanRecorder
 from .batcher import (
     DeadlineExceeded,
@@ -427,6 +428,7 @@ class RecommendApp:
                     artifact_ages=ages,
                     artifact_stale=self._artifact_stale_flags(ages),
                     mesh_shards=self._mesh_shard_states(),
+                    io=iohealth.MONITOR.snapshot(),
                 )
                 return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
             if path.startswith("/static/"):
@@ -973,6 +975,13 @@ class RecommendApp:
         # member even on an otherwise idle pod)
         for rank in self._mesh_missing_shards(probe=True):
             reasons.append(f"serve_mesh_shard_missing:{rank}")
+        # storage gray-failure spine (ISSUE 19): the IO-health monitor
+        # convicted the artifact plane as slow (latency EWMA past
+        # KMLS_IO_SLOW_MS). Degraded, NOT unready — serving runs from
+        # memory; a slow PVC must never knock a healthy replica out of
+        # the load balancer.
+        if iohealth.MONITOR.storage_slow():
+            reasons.append("storage-slow")
         return reasons
 
     def _recommend_error_response(self, exc: Exception, trace=None) -> Response:

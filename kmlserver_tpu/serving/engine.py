@@ -83,7 +83,7 @@ import numpy as np
 
 from .. import faults
 from ..config import ServingConfig
-from ..io import artifacts, registry
+from ..io import artifacts, iohealth, registry
 from ..io.artifacts import ArtifactIntegrityError
 from ..observability import costmodel as costmodel_mod
 from ..ops.embed import embed_topk
@@ -400,17 +400,36 @@ class RecommendEngine:
             maybe_initialize_serve_gang(
                 self.gang.coordinator, self.gang.size, self.gang.rank
             )
+        # storage gray-failure spine (ISSUE 19): point the IO-health
+        # monitor's free-space gauge at the artifact volume this engine
+        # polls — kmls_disk_free_bytes then tracks the PVC, and every
+        # artifact read below feeds the latency EWMAs behind the
+        # storage-slow conviction
+        iohealth.MONITOR.watch_disk(cfg.pickles_dir)
 
     # ---------- artifact loading / hot swap ----------
 
     def _token_path(self) -> str:
         return registry.token_path_for(self.cfg.base_dir, self.cfg.data_invalidation_file)
 
+    def _read_deadline(self) -> float | None:
+        """Deadline for reload-path artifact reads (None = unbounded)."""
+        return self.cfg.io_read_deadline_s or None
+
     def _read_token(self) -> str | None:
         try:
-            return artifacts.read_text(self._token_path())
+            return artifacts.read_text(self._token_path(), op="token_poll")
         except FileNotFoundError:
             return None
+        except OSError as exc:
+            # a transient EIO/stall on the per-poll token read must NOT
+            # flip is_data_stale — that would turn one flaky NFS read
+            # into reload churn. The poll failure decays: report the
+            # cached token (no change seen) and let the next poll retry.
+            logger.warning(
+                "token poll failed (%s); keeping cached token", exc
+            )
+            return self.cache_value
 
     def is_data_stale(self) -> bool:
         """Token-comparison staleness (reference: rest_api/app/main.py:82-97);
@@ -457,7 +476,9 @@ class RecommendEngine:
                 use_npz, use_emb = self._verify_before_load(
                     best_path, rec_path, npz_path
                 )
-                best = artifacts.load_pickle(best_path)
+                best = artifacts.load_pickle(
+                    best_path, deadline_s=self._read_deadline()
+                )
                 replicas = self._build_replicas(
                     rec_path, npz_path, use_npz=use_npz
                 )
@@ -539,7 +560,9 @@ class RecommendEngine:
             # bundle it was measured against (fail-soft — no report or a
             # malformed one serves the configured default, loudly)
             self.measured_blend_weight = self._read_measured_blend_weight()
-            manifest = artifacts.load_manifest(self.cfg.pickles_dir)
+            manifest = artifacts.load_manifest(
+                self.cfg.pickles_dir, deadline_s=self._read_deadline()
+            )
             if manifest is not None and manifest.get("token") == self.cache_value:
                 self._applied_written_at = float(
                     manifest.get("written_at") or time.time()
@@ -666,7 +689,9 @@ class RecommendEngine:
                     f"{emb_path} fails its manifest checksum", [emb_path]
                 )
             faults.fire("embed.artifact")
-            loaded = artifacts.load_embeddings(emb_path)
+            loaded = artifacts.load_embeddings(
+                emb_path, deadline_s=self._read_deadline()
+            )
         except FileNotFoundError:
             # raced a writer retiring the artifact (an embed-disabled
             # publication removes it before the token rewrite): absent,
@@ -743,9 +768,13 @@ class RecommendEngine:
             if not os.path.exists(path):
                 continue
             try:
-                probe(path)
+                probe(path, deadline_s=self._read_deadline())
                 continue  # parses fine: never quarantine on suspicion
             except FileNotFoundError:
+                continue
+            except artifacts.IoStallError:
+                # a slow mount is not corruption: condemning a good file
+                # because the PROBE timed out would cost real bytes
                 continue
             except Exception:
                 pass
@@ -772,7 +801,14 @@ class RecommendEngine:
             and os.path.exists(npz_path)
         ):
             try:
-                loaded = artifacts.load_rule_tensors(npz_path)
+                loaded = artifacts.load_rule_tensors(
+                    npz_path, deadline_s=self._read_deadline()
+                )
+            except artifacts.IoStallError:
+                # a hung read is not a torn artifact: fail the RELOAD
+                # (backoff + last-good serving) instead of falling back
+                # to an equally-hung pickle read
+                raise
             except Exception:
                 # torn/corrupt npz next to a possibly-intact pickle of the
                 # same generation: fall through to the pickle rather than
@@ -815,7 +851,9 @@ class RecommendEngine:
                     "sha256"
                 ]
         else:
-            rules_dict = artifacts.load_pickle(rec_path)
+            rules_dict = artifacts.load_pickle(
+                rec_path, deadline_s=self._read_deadline()
+            )
             vocab = sorted(
                 set(rules_dict)
                 | {o for row in rules_dict.values() for o in row}

@@ -125,6 +125,17 @@ METRIC_REGISTRY: dict[str, str] = {
     # merges answered without a straggler slab's candidates (each one
     # also counts kmls_degraded_total{reason="mesh-straggler"})
     "kmls_mesh_straggler_degraded_total": "counter:serving",
+    # --- serving: storage gray-failure spine (ISSUE 19) ---
+    # artifact-plane IO health (io/iohealth.py, fed by io/artifacts.py):
+    # per-operation latency EWMA {op ∈ token_poll/read/write/fsync},
+    # errors by (op, errno), transient-EIO retries, free bytes on the
+    # artifact volume, and the storage-slow conviction behind the
+    # /readyz "storage-slow" degraded reason
+    "kmls_io_latency_seconds": "gauge:serving",
+    "kmls_io_errors_total": "counter:serving",
+    "kmls_io_retries_total": "counter:serving",
+    "kmls_disk_free_bytes": "gauge:serving",
+    "kmls_storage_slow": "gauge:serving",
     # --- serving: continuous freshness (ISSUE 10) ---
     # delta bundles applied in place vs rejected (torn/wrong-base/
     # injected), the chain position serving ((base, delta_seq) epoch
@@ -470,7 +481,7 @@ class ServingMetrics:
         self, reload_counter: int, finished_loading: bool,
         cache=None, dispatch_counts=None, robustness=None,
         shard_counts=None, cost=None, slo=None, artifact_ages=None,
-        artifact_stale=None, mesh_shards=None,
+        artifact_stale=None, mesh_shards=None, io=None,
     ) -> str:
         """Prometheus text. ``cache`` (a serving.cache.RecommendCache),
         ``dispatch_counts`` (the engine's per-replica dispatch counters),
@@ -632,6 +643,35 @@ class ServingMetrics:
                 f"{int(artifact_stale[name])}"
                 for name in sorted(artifact_stale)
             ]
+        if io is not None:
+            # storage gray-failure spine (ISSUE 19): the IO-health
+            # monitor's snapshot (io/iohealth.py). Latency EWMAs are
+            # gauges (not summaries — they carry the conviction math's
+            # exact inputs), errors are labeled by the errno a real bad
+            # mount would return, and kmls_storage_slow is the 0/1
+            # conviction behind /readyz's "storage-slow" reason.
+            lines.append("# TYPE kmls_io_latency_seconds gauge")
+            lines += [
+                f'kmls_io_latency_seconds{{op="{op}"}} {ewma:.6f}'
+                for op, ewma in sorted(io.get("latency_s", {}).items())
+            ]
+            lines.append("# TYPE kmls_io_errors_total counter")
+            lines += [
+                f'kmls_io_errors_total{{op="{op}",errno="{err}"}} {count}'
+                for (op, err), count in sorted(io.get("errors", {}).items())
+            ]
+            lines += [
+                "# TYPE kmls_io_retries_total counter",
+                f"kmls_io_retries_total {int(io.get('retries', 0))}",
+                "# TYPE kmls_storage_slow gauge",
+                f"kmls_storage_slow {int(bool(io.get('storage_slow')))}",
+            ]
+            free = io.get("disk_free_bytes")
+            if free is not None:
+                lines += [
+                    "# TYPE kmls_disk_free_bytes gauge",
+                    f"kmls_disk_free_bytes {int(free)}",
+                ]
         if robustness:
             # dedupe by series name (ISSUE 9 satellite): a robustness key
             # colliding with a statically rendered series (e.g. a

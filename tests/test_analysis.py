@@ -69,6 +69,8 @@ def fixture_cfg(**overrides) -> AnalysisConfig:
         job_manifests=("k8s/job.yaml",),
         atomic_allowed_modules=("pkg/writer.py",),
         atomic_allowed_functions=(),
+        durable_rename_function="pkg/writer.py::save_pickle",
+        rename_allowed_modules=(),
         hotpath_entries=("pkg/serve.py::Batcher.dispatch",),
         hot_locks=("Cache._lock",),
     )
@@ -298,6 +300,38 @@ def test_atomic_allows_writer_module_and_reads(tmp_path):
         },
     )
     result = run_fixture(tmp_path, fixture_cfg(), ["atomic-write"])
+    assert result["findings"] == []
+
+
+_ATOMIC_ROGUE_RENAME = """
+    import os
+
+    def publish(tmp, path):
+        os.replace(tmp, path)
+    """
+
+
+def test_atomic_flags_rename_outside_durable_function(tmp_path):
+    """ISSUE 19: a publication-critical rename anywhere but the
+    designated durable-rename function is an ERROR — even inside an
+    atomic-ALLOWED writer module (the rename rule is stricter than the
+    direct-write rule)."""
+    write_tree(
+        tmp_path,
+        {
+            "pkg/writer.py": _ATOMIC_GOOD_WRITER,
+            "pkg/rogue.py": _ATOMIC_ROGUE_RENAME,
+        },
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["atomic-write"])
+    got = keys(result, "atomic-write")
+    assert got == {"os.replace@publish"}
+    # ...and a rename-allowed module is exempt from the rename rule only
+    result = run_fixture(
+        tmp_path,
+        fixture_cfg(rename_allowed_modules=("pkg/rogue.py",)),
+        ["atomic-write"],
+    )
     assert result["findings"] == []
 
 
@@ -1052,16 +1086,23 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     refs = collect_code_knobs(index, cfg)
     assert len(refs) >= 70 and set(refs) <= set(scopes)
     env_map = collect_fault_env_map(index, cfg)
-    assert len(env_map) == 10, env_map
+    assert len(env_map) == 15, env_map
     assert env_map["KMLS_FAULT_EMBED_CORRUPT"][0] == "embed.artifact"
     assert env_map["KMLS_FAULT_DELTA_CORRUPT"][0] == "delta.apply"
     # the gray-failure delay sites (ISSUE 18)
     assert env_map["KMLS_FAULT_FLEET_PEER_DELAY_MS"][0] == "fleet.peer"
     assert env_map["KMLS_FAULT_MESH_PEER_DELAY_MS"][0] == "mesh.peer"
+    # the storage gray-failure sites (ISSUE 19)
+    assert env_map["KMLS_FAULT_IO_WRITE"][0] == "io.write"
+    assert env_map["KMLS_FAULT_IO_READ"][0] == "io.read"
+    assert env_map["KMLS_FAULT_IO_FSYNC"][0] == "io.fsync"
+    assert env_map["KMLS_FAULT_IO_WRITE_STALL_MS"][0] == "io.write"
+    assert env_map["KMLS_FAULT_IO_READ_STALL_MS"][0] == "io.read"
     sites = collect_fire_sites(index, cfg)
     assert {
         "engine.load", "replica.kernel", "ckpt.corrupt", "embed.artifact",
         "delta.apply", "fleet.peer", "mesh.peer",
+        "io.write", "io.read", "io.fsync",
     } <= sites
     # checker 7 anchors (ISSUE 9): the registry parses without import,
     # both exposition modules are indexed, and the dynamic robustness

@@ -550,6 +550,19 @@ class TestTpuSuiteWiring:
             "mesh_http_5xx": 0, "mesh_errors": 0,
             "platform": "cpu",
         },
+        "graystore": {
+            "qps": 1000.0, "requests": 6000, "stall_ms": 400.0,
+            "control_p50_ms": 0.26, "control_p99_ms": 12.2,
+            "stalled_p50_ms": 0.24, "stalled_p99_ms": 13.9,
+            "p99_ratio": 1.14, "storage_slow": True,
+            "readyz_degraded": True, "reload_deferred": True,
+            "backoff_bounded": True, "last_good_held": True,
+            "enospc_exit": 75, "enospc_exit_resumable": True,
+            "enospc_identical": True, "enospc_token_moved": False,
+            "torn_parts": 0, "probe_p99_ms": 1.1, "recovered": True,
+            "io_retries": 0, "http_5xx": 0, "errors": 0,
+            "platform": "cpu",
+        },
         "quality": {
             "recall_rules": 0.27, "recall_embed": 0.41,
             "recall_blend": 0.41, "recall_blend_best": 0.43,
@@ -667,6 +680,17 @@ class TestTpuSuiteWiring:
         assert final["slowpeer_mesh_hedge_wins"] == 8
         assert final["slowpeer_mesh_straggler_degraded"] == 8
         assert final["slowpeer_platform"] == "cpu"
+        # ... and the storage gray-failure bracket (ISSUE 19)
+        assert final["graystore_storage_slow"] is True
+        assert final["graystore_readyz_degraded"] is True
+        assert final["graystore_reload_deferred"] is True
+        assert final["graystore_last_good_held"] is True
+        assert final["graystore_enospc_exit_resumable"] is True
+        assert final["graystore_enospc_identical"] is True
+        assert final["graystore_enospc_token_moved"] is False
+        assert final["graystore_torn_parts"] == 0
+        assert final["graystore_http_5xx"] == 0
+        assert final["graystore_platform"] == "cpu"
         # ... and so does the quality-loop bracket (ISSUE 14)
         assert final["quality_recall_blend"] == 0.43
         assert final["quality_weight_roundtrip"] is True
@@ -1138,7 +1162,7 @@ class TestBenchStateResume:
             "loadshape_cpu", "loadshape_pred_cpu", "mine_resume_cpu",
             "als_hybrid_cpu",
             "confserve_cpu", "scale_sparse_cpu", "quality_cpu",
-            "meshserve_cpu", "slowpeer_cpu",
+            "meshserve_cpu", "slowpeer_cpu", "graystore_cpu",
         }
         assert Path(state_path + ".npz").read_bytes() == b"npz-sentinel"
         capsys.readouterr()

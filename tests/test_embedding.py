@@ -476,7 +476,7 @@ class TestEmbeddingChaos:
         run_mining_job(cfg)
         real_load = artifacts.load_embeddings
 
-        def vanish(path):
+        def vanish(path, **kwargs):
             raise FileNotFoundError(path)
 
         monkeypatch.setattr(

@@ -1014,9 +1014,9 @@ class TestJobMetricsTextfile:
         calls = []
         real = artifacts.atomic_write_text
 
-        def spy(path, text):
+        def spy(path, text, **kwargs):
             calls.append(path)
-            return real(path, text)
+            return real(path, text, **kwargs)
 
         monkeypatch.setattr(artifacts, "atomic_write_text", spy)
         jm = JobMetrics(str(tmp_path))
@@ -1033,7 +1033,7 @@ class TestJobMetricsTextfile:
         OSError is survivable: a registry KeyError (drift) still raises."""
         from kmlserver_tpu.io import artifacts
 
-        def boom(path, text):
+        def boom(path, text, **kwargs):
             raise OSError(28, "No space left on device")
 
         monkeypatch.setattr(artifacts, "atomic_write_text", boom)

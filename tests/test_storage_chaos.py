@@ -1,0 +1,396 @@
+"""Storage gray-failure chaos suite (ISSUE 19): the PVC fault plane
+fired deterministically through the path-scoped ``io.*`` sites
+(kmlserver_tpu/faults.py) against the durable publication spine
+(io/artifacts.py) and the IO-health monitor (io/iohealth.py).
+
+The acceptance bar, scenario by scenario:
+
+- ENOSPC mid-publish → last-good keeps serving bit-identical, the token
+  is never consumed, no torn ``.part`` files, the job exits resumable;
+- transient EIO on the token poll → NO reload churn (a flaky poll read
+  must never look like an invalidation);
+- a hung NFS read at reload → the read deadline fires, reload parks in
+  backoff, last-good serves; recovery on the next clean poll;
+- disk-full → quarantine + orphan reclamation, then publication; still
+  short → ``StorageExhaustedError`` → resumable exit 75;
+- a stalled lease heartbeat → the writer self-fences (sticky lost)
+  before it can race a challenger's publication;
+- fsync failure → publication aborts immediately (never retried — a
+  failed fsync means the kernel may have dropped the pages), the
+  destination untouched.
+
+Env-knob arming (``KMLS_FAULT_IO_WRITE``, ``KMLS_FAULT_IO_WRITE_STALL_MS``,
+``KMLS_FAULT_IO_READ``, ``KMLS_FAULT_IO_READ_STALL_MS``,
+``KMLS_FAULT_IO_FSYNC``) is covered so the CI chaos job can drive the
+same paths from the outside.
+
+All tests carry the ``chaos`` marker (the dedicated CI job runs
+``-m chaos``); they are fast enough to ride tier-1 too.
+"""
+
+import dataclasses
+import errno
+import json
+import os
+import time
+
+import pytest
+
+from kmlserver_tpu import faults
+from kmlserver_tpu.config import MiningConfig
+from kmlserver_tpu.io import artifacts, iohealth, registry
+from kmlserver_tpu.mining.job import (
+    EXIT_RESUMABLE,
+    classify_exception,
+)
+from kmlserver_tpu.mining.pipeline import run_mining_job
+from kmlserver_tpu.serving.app import RecommendApp
+from kmlserver_tpu.serving.engine import RecommendEngine
+
+from .test_serving import mined_pvc  # noqa: F401  (fixture re-export)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    iohealth.MONITOR.reset()
+    yield
+    faults.clear()
+    iohealth.MONITOR.reset()
+
+
+def _token_text(cfg) -> str | None:
+    path = registry.token_path_for(cfg.base_dir, cfg.data_invalidation_file)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _part_files(directory: str) -> list[str]:
+    return [
+        name for name in os.listdir(directory)
+        if name.startswith(".tmp_") and name.endswith(".part")
+    ]
+
+
+class TestEnospcMidPublish:
+    def test_last_good_serves_and_token_unconsumed(self, mined_pvc):
+        """THE tentpole leg: ENOSPC while writing recommendations.pickle
+        on the next publication — the previous publication keeps serving
+        bit-identical, the invalidation token never moves, and the
+        aborted writer leaves no torn temp files behind."""
+        cfg, _, mining_cfg = mined_pvc
+        pickles = os.path.join(cfg.base_dir, "pickles")
+        rec_path = os.path.join(pickles, cfg.recommendations_file)
+        with open(rec_path, "rb") as fh:
+            good_bytes = fh.read()
+        token_before = _token_text(mining_cfg)
+        assert token_before is not None
+
+        faults.inject(
+            "io.write", kind="enospc", times=1, path="recommendations"
+        )
+        with pytest.raises(OSError) as excinfo:
+            run_mining_job(mining_cfg)
+        assert excinfo.value.errno == errno.ENOSPC
+        assert classify_exception(excinfo.value) == EXIT_RESUMABLE
+
+        with open(rec_path, "rb") as fh:
+            assert fh.read() == good_bytes  # bit-identical last-good
+        assert _token_text(mining_cfg) == token_before
+        assert _part_files(pickles) == []  # ENOSPC unlinks its temp
+
+        # the serving side never noticed: a fresh engine loads last-good
+        engine = RecommendEngine(cfg)
+        assert engine.load()
+
+    def test_write_retries_transient_eio_then_succeeds(self, tmp_path):
+        """The bounded retry ladder: one injected EIO, the shared writer
+        retries with backoff and the publication lands intact."""
+        target = str(tmp_path / "artifact.pickle")
+        faults.inject("io.write", kind="eio", times=1, path="artifact")
+        artifacts.save_pickle({"ok": 1}, target)
+        assert artifacts.load_pickle(target) == {"ok": 1}
+        snap = iohealth.MONITOR.snapshot()
+        assert snap["retries"] == 1
+        assert snap["errors"].get(("write", errno.EIO)) == 1
+
+    def test_torn_write_leaves_crash_artifact_not_destination(
+        self, tmp_path
+    ):
+        """A torn write models a dead writer: the short temp file stays
+        (forensics; reclaim_space collects it), the destination is never
+        touched, and nothing retries on the corpse's behalf."""
+        target = str(tmp_path / "artifact.bin")
+        faults.inject("io.write", torn_at=3, times=1)
+        with pytest.raises(faults.TornWrite):
+            artifacts._atomic_write_bytes(target, b"0123456789")
+        assert not os.path.exists(target)
+        parts = _part_files(str(tmp_path))
+        assert len(parts) == 1
+        with open(os.path.join(str(tmp_path), parts[0]), "rb") as fh:
+            assert fh.read() == b"012"  # exactly torn_at bytes
+
+
+class TestTokenPollEio:
+    def test_transient_eio_on_token_poll_causes_no_reload_churn(
+        self, mined_pvc
+    ):
+        """A flaky NFS read of last_execution.txt must NOT look like an
+        invalidation: the poll decays to the cached token, no reload
+        runs, no failure counters move."""
+        cfg, _, _ = mined_pvc
+        engine = RecommendEngine(cfg)
+        assert engine.load()
+        token_before = engine.cache_value
+        faults.inject("io.read", kind="eio", times=1, path="last_execution")
+        assert engine.is_data_stale() is False  # EIO poll → not stale
+        engine.reload_if_required()
+        assert engine.cache_value == token_before
+        assert engine.reload_failures == 0
+        assert engine.consecutive_reload_failures == 0
+        assert engine.finished_loading
+
+
+class TestSlowReadReload:
+    def test_hung_read_parks_reload_in_backoff_with_last_good(
+        self, mined_pvc
+    ):
+        """A reload read that hangs (stalled NFS) trips the read
+        deadline: the reload fails into the standard backoff with
+        last-good serving — the reload thread is never wedged."""
+        cfg, _, mining_cfg = mined_pvc
+        engine = RecommendEngine(
+            dataclasses.replace(cfg, io_read_deadline_s=0.2)
+        )
+        assert engine.load()
+        token_before = engine.cache_value
+        registry.append_history_and_invalidate(
+            MiningConfig(base_dir=cfg.base_dir), 1, "graystore-ds"
+        )
+        faults.inject(
+            "io.read", delay_s=5.0, times=1, path="recommendations"
+        )
+        t0 = time.monotonic()
+        engine.reload_if_required()  # fails at the deadline, not at 5s
+        assert time.monotonic() - t0 < 2.0
+        assert engine.consecutive_reload_failures == 1
+        assert engine._backoff_until > time.monotonic()
+        assert engine.finished_loading  # last-good still serving
+        assert engine.cache_value == token_before  # token not consumed
+
+        # recovery: fault spent, backoff collapsed → reload succeeds
+        engine._backoff_until = 0.0
+        faults.clear()
+        engine.reload_if_required()
+        assert engine.consecutive_reload_failures == 0
+        assert engine.cache_value != token_before
+
+    def test_slow_io_conviction_degrades_readyz(self, mined_pvc):
+        """Sustained slow IO convicts storage-slow: /readyz flips to
+        ready-but-degraded (HTTP 200 — serving runs from memory) with
+        reason "storage-slow", and clears below the hysteresis floor."""
+        cfg, _, _ = mined_pvc
+        app = RecommendApp(cfg)
+        assert app.engine.load()
+        for _ in range(iohealth.MIN_SAMPLES):
+            iohealth.MONITOR.note_latency("write", 1.0)  # 1s ≫ 250ms
+        assert iohealth.MONITOR.storage_slow()
+        status, _, payload = app.handle("GET", "/readyz", b"")
+        assert status == 200
+        body = json.loads(payload)
+        assert body["status"] == "degraded"
+        assert "storage-slow" in body["reasons"]
+        # /metrics exports the conviction + the ledger
+        status, _, payload = app.handle("GET", "/metrics", b"")
+        text = payload.decode()
+        assert "kmls_storage_slow 1" in text
+        assert 'kmls_io_latency_seconds{op="write"}' in text
+        # hysteresis: fast samples pull the EWMA under slow/2 → clears
+        for _ in range(200):
+            iohealth.MONITOR.note_latency("write", 0.001)
+        assert not iohealth.MONITOR.storage_slow()
+
+
+class TestDiskFullReclaim:
+    def test_reclaim_frees_quarantine_and_orphans_only(self, mined_pvc):
+        cfg, _, _ = mined_pvc
+        pickles = os.path.join(cfg.base_dir, "pickles")
+        qdir = os.path.join(pickles, artifacts.QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        with open(os.path.join(qdir, "corpse.pickle"), "wb") as fh:
+            fh.write(b"x" * 1024)
+        with open(os.path.join(pickles, ".tmp_dead.part"), "wb") as fh:
+            fh.write(b"y" * 512)
+        live = os.path.join(pickles, cfg.recommendations_file)
+        live_size = os.path.getsize(live)
+        freed = artifacts.reclaim_space(pickles)
+        assert freed == 1024 + 512
+        assert os.listdir(qdir) == []
+        assert _part_files(pickles) == []
+        assert os.path.getsize(live) == live_size  # live store untouched
+
+    def test_preflight_reclaims_then_publishes(self, mined_pvc):
+        """ensure_free_space with a satisfiable floor reclaims and
+        returns; the mining preflight then publishes normally."""
+        cfg, _, mining_cfg = mined_pvc
+        pickles = os.path.join(cfg.base_dir, "pickles")
+        qdir = os.path.join(pickles, artifacts.QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        with open(os.path.join(qdir, "corpse.pickle"), "wb") as fh:
+            fh.write(b"x" * 2048)
+        free = artifacts.ensure_free_space(pickles, 1)
+        assert free > 0
+        token_before = _token_text(mining_cfg)
+        run_mining_job(
+            dataclasses.replace(
+                mining_cfg, disk_min_free_bytes=1 << 20
+            )
+        )
+        assert _token_text(mining_cfg) != token_before
+
+    def test_exhausted_after_reclaim_exits_resumable(self, mined_pvc):
+        cfg, _, mining_cfg = mined_pvc
+        pickles = os.path.join(cfg.base_dir, "pickles")
+        with pytest.raises(artifacts.StorageExhaustedError) as excinfo:
+            artifacts.ensure_free_space(pickles, 1 << 60)
+        assert classify_exception(excinfo.value) == EXIT_RESUMABLE
+        # the preflight wires through the pipeline too: an absurd floor
+        # aborts the job BEFORE any expensive phase or artifact write
+        token_before = _token_text(mining_cfg)
+        with pytest.raises(artifacts.StorageExhaustedError):
+            run_mining_job(
+                dataclasses.replace(mining_cfg, disk_min_free_bytes=1 << 60)
+            )
+        assert _token_text(mining_cfg) == token_before
+
+
+class TestHeartbeatSelfFence:
+    def test_stalled_heartbeat_self_fences_sticky(self, tmp_path):
+        """A heartbeat write that stalls past stall_fraction·ttl means
+        this writer cannot prove its lease is still younger than the TTL
+        — it must assume expropriated: sticky-lost, resumable exit."""
+        pickles = str(tmp_path / "pickles")
+        os.makedirs(pickles)
+        lease = artifacts.PublicationLease.acquire(
+            pickles, ttl_s=0.5, stall_fraction=0.2
+        )
+        faults.inject(
+            "io.write", delay_s=0.3, times=1, path="publish.lease"
+        )
+        with pytest.raises(artifacts.LeaseLostError) as excinfo:
+            lease.heartbeat()
+        assert lease.lost
+        assert classify_exception(excinfo.value) == EXIT_RESUMABLE
+        # sticky: even a fast later heartbeat refuses
+        with pytest.raises(artifacts.LeaseLostError):
+            lease.heartbeat()
+
+    def test_fast_heartbeat_does_not_fence(self, tmp_path):
+        pickles = str(tmp_path / "pickles")
+        os.makedirs(pickles)
+        lease = artifacts.PublicationLease.acquire(
+            pickles, ttl_s=0.5, stall_fraction=0.5
+        )
+        lease.heartbeat()
+        assert not lease.lost
+        lease.release()
+
+
+class TestFsyncFailure:
+    def test_fsync_failure_aborts_cleanly_never_retried(self, tmp_path):
+        """fsyncgate discipline: after a failed fsync the kernel may
+        have dropped the dirty pages — retrying would falsely report
+        durability. The publication aborts, the destination keeps its
+        old bytes, no temp files linger, zero retries burned."""
+        target = str(tmp_path / "artifact.pickle")
+        artifacts.save_pickle({"generation": 1}, target)
+        faults.inject("io.fsync", times=1)
+        with pytest.raises(artifacts.FsyncFailedError):
+            artifacts.save_pickle({"generation": 2}, target)
+        assert artifacts.load_pickle(target) == {"generation": 1}
+        assert _part_files(str(tmp_path)) == []
+        assert iohealth.MONITOR.snapshot()["retries"] == 0
+        # fault spent → the next publication goes through
+        artifacts.save_pickle({"generation": 2}, target)
+        assert artifacts.load_pickle(target) == {"generation": 2}
+
+
+class TestEnvKnobArming:
+    """Each KMLS_FAULT_IO_* knob arms its site from the environment —
+    the contract the CI chaos job and the graystore bench drive."""
+
+    def test_io_write_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMLS_FAULT_IO_WRITE", "enospc:1:scoped")
+        faults.load_env(force=True)
+        with pytest.raises(OSError) as excinfo:
+            artifacts.atomic_write_text(str(tmp_path / "scoped.txt"), "x")
+        assert excinfo.value.errno == errno.ENOSPC
+        # path scope: a non-matching destination is untouched by the knob
+        artifacts.atomic_write_text(str(tmp_path / "other.txt"), "y")
+
+    def test_io_write_torn_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMLS_FAULT_IO_WRITE", "torn@4:1")
+        faults.load_env(force=True)
+        with pytest.raises(faults.TornWrite):
+            artifacts._atomic_write_bytes(
+                str(tmp_path / "t.bin"), b"abcdefgh"
+            )
+        (part,) = _part_files(str(tmp_path))
+        assert os.path.getsize(os.path.join(str(tmp_path), part)) == 4
+
+    def test_io_write_stall_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMLS_FAULT_IO_WRITE_STALL_MS", "60:1")
+        faults.load_env(force=True)
+        t0 = time.monotonic()
+        artifacts.atomic_write_text(str(tmp_path / "s.txt"), "x")
+        assert time.monotonic() - t0 >= 0.06
+
+    def test_io_read_knob(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "r.txt")
+        artifacts.atomic_write_text(path, "payload")
+        monkeypatch.setenv("KMLS_FAULT_IO_READ", "1")
+        faults.load_env(force=True)
+        with pytest.raises(OSError) as excinfo:
+            artifacts.read_text(path)
+        assert excinfo.value.errno == errno.EIO
+        assert artifacts.read_text(path) == "payload"  # fault spent
+
+    def test_io_read_stall_knob(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "r.txt")
+        artifacts.atomic_write_text(path, "payload")
+        monkeypatch.setenv("KMLS_FAULT_IO_READ_STALL_MS", "60:1")
+        faults.load_env(force=True)
+        t0 = time.monotonic()
+        assert artifacts.read_text(path) == "payload"
+        assert time.monotonic() - t0 >= 0.06
+
+    def test_io_fsync_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMLS_FAULT_IO_FSYNC", "1")
+        faults.load_env(force=True)
+        with pytest.raises(artifacts.FsyncFailedError):
+            artifacts.atomic_write_text(str(tmp_path / "f.txt"), "x")
+
+
+class TestDurableReplace:
+    def test_durable_replace_publishes_and_fsyncs(self, tmp_path):
+        src = str(tmp_path / "incoming")
+        dst = str(tmp_path / "published")
+        with open(src, "wb") as fh:
+            fh.write(b"payload")
+        artifacts.durable_replace(src, dst)
+        assert not os.path.exists(src)
+        with open(dst, "rb") as fh:
+            assert fh.read() == b"payload"
+
+    def test_read_deadline_zero_means_no_thread(self, tmp_path):
+        """deadline_s=0/None reads inline — the common case pays no
+        thread overhead; only deadline-bearing reads park on a worker."""
+        path = str(tmp_path / "x.bin")
+        artifacts._atomic_write_bytes(path, b"z")
+        assert artifacts._read_bytes(path, deadline_s=0) == b"z"
+        assert artifacts._read_bytes(path, deadline_s=None) == b"z"
+        assert artifacts._read_bytes(path, deadline_s=5.0) == b"z"
