@@ -101,8 +101,15 @@ REPEATS = 5
 # soft wall-clock budget: optional phases are skipped once exceeded so the
 # required JSON line is never lost to a driver-side timeout. 1200 s sits
 # well under the driver's observed ~1500 s kill (BENCH_r03.json, rc 124).
-DEADLINE_S = float(os.environ.get("KMLS_BENCH_DEADLINE_S", "1200"))
+DEADLINE_S = 1200.0
 _T0 = time.monotonic()
+
+
+def _deadline_s() -> float:
+    # env read at call time, not import time (envread checker): an
+    # exported KMLS_BENCH_DEADLINE_S must keep working however late the
+    # driver sets it relative to this module's first import
+    return float(os.environ.get("KMLS_BENCH_DEADLINE_S", str(DEADLINE_S)))
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
 
@@ -160,7 +167,7 @@ def _elapsed() -> float:
 
 
 def _remaining() -> float:
-    return DEADLINE_S - _elapsed()
+    return _deadline_s() - _elapsed()
 
 
 def _phase_env(platform: str) -> dict:
@@ -675,7 +682,13 @@ class BenchState:
     artifact produced while the pool is down.
     """
 
-    MAX_AGE_S = float(os.environ.get("KMLS_BENCH_STATE_MAX_AGE_S", "43200"))
+    MAX_AGE_S = 43200.0
+
+    def _max_age_s(self) -> float:
+        # env read at call time, not import time (envread checker)
+        return float(
+            os.environ.get("KMLS_BENCH_STATE_MAX_AGE_S", str(self.MAX_AGE_S))
+        )
 
     def __init__(self, path: str | None):
         self.path = path
@@ -708,7 +721,7 @@ class BenchState:
                 stale = [
                     n for n in self.phases
                     if self.banked_at.get(n) is None
-                    or now - self.banked_at[n] > self.MAX_AGE_S
+                    or now - self.banked_at[n] > self._max_age_s()
                 ]
                 for n in stale:
                     self.phases.pop(n, None)
@@ -716,7 +729,7 @@ class BenchState:
                 if stale:
                     log(
                         f"state bank {path}: dropped stale phases "
-                        f"{sorted(stale)} (> {self.MAX_AGE_S:.0f}s old)"
+                        f"{sorted(stale)} (> {self._max_age_s():.0f}s old)"
                     )
                 log(
                     f"state bank {path}: resuming with "
@@ -4219,7 +4232,14 @@ print(json.dumps(out))
 # the phase's full timeout on a process that will never start computing.
 # Default matches the prober's timeout: a pool the prober certifies
 # healthy must not have phases killed under a shorter fuse.
-STARTUP_GRACE_S = float(os.environ.get("KMLS_BENCH_STARTUP_GRACE_S", "240"))
+STARTUP_GRACE_S = 240.0
+
+
+def _startup_grace_s() -> float:
+    # env read at call time, not import time (envread checker)
+    return float(
+        os.environ.get("KMLS_BENCH_STARTUP_GRACE_S", str(STARTUP_GRACE_S))
+    )
 
 
 def _salvage_checkpoint(
@@ -4300,7 +4320,7 @@ def _run_phase(
             # count grace time AGAINST that budget below — otherwise a
             # short-deadline phase could overrun the bench deadline by
             # grace+timeout and cost the whole JSON artifact
-            grace = min(STARTUP_GRACE_S, timeout)
+            grace = min(_startup_grace_s(), timeout)
             t_end = t_phase + grace
             # poll alongside the wait: a phase that crashes at import never
             # prints a device line and must not idle out the full grace
@@ -6485,7 +6505,7 @@ def main() -> int:
                         em.checkpoint()
                 log(
                     f"TPU never became reachable within the "
-                    f"{DEADLINE_S:.0f}s window "
+                    f"{_deadline_s():.0f}s window "
                     f"({len(prober.history_snapshot())} probes) — JSON "
                     "carries the full probe history"
                 )

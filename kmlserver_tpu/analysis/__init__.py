@@ -2,7 +2,7 @@
 
 PRs 1–4 made the serving+mining stack fast and fault-tolerant; this
 package makes the invariants that correctness now rests on MACHINE-
-CHECKED instead of reviewer-remembered. Eight checkers, each a pure-AST
+CHECKED instead of reviewer-remembered. Eleven checkers, each a pure-AST
 pass (stdlib only — the analyzer must run in a bare CI job without jax):
 
 - ``hotpath``      — no host-sync constructs reachable from the serving
@@ -35,7 +35,21 @@ pass (stdlib only — the analyzer must run in a bare CI job without jax):
                      ``observability.costmodel.KERNEL_COST_SPECS`` and
                      vice versa, the required kernel set stays
                      registered, and every cost-model series is in
-                     ``METRIC_REGISTRY`` (ISSUE 12).
+                     ``METRIC_REGISTRY`` (ISSUE 12);
+- ``loopblock``    — no blocking constructs (sleeps, file/socket I/O,
+                     un-awaited ``.result()``/``.wait()``, ``faults.
+                     fire``, durable writers) in EVENT-LOOP context, on
+                     the async-aware call graph's execution-context
+                     classification (the PR 18 ``_dispatch`` stall bug
+                     class; ISSUE 20);
+- ``lockown``      — for classes that own a lock, each mutable field's
+                     owning lock is inferred by majority vote over
+                     guarded accesses and unguarded WRITES are flagged
+                     (conservative data-race inference; ISSUE 20);
+- ``envread``      — no ``KMLS_*``/``os.environ`` reads at module
+                     import time or inside jit-traced functions, cross-
+                     checked against ``config.KNOB_REGISTRY`` scopes
+                     (the PR 12 frozen-knob bug class; ISSUE 20).
 
 Findings carry ``file:line``, a severity, an explanation, and a stable
 fingerprint; pre-existing accepted findings live in

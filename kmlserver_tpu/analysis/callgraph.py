@@ -25,6 +25,17 @@ For forbidden-construct matching, every call site also gets a DOTTED
 NAME (``"time.sleep"``, ``"np.asarray"``, ``"open"``) resolved through
 the module's import aliases, plus the bare method name for
 receiver-independent rules (``.item()``, ``.result()``).
+
+Execution-context classification (ISSUE 20) lives here too: every
+function is classified as event-loop (reachable from asyncio Protocol
+callbacks, ``async def``s, ``loop.call_soon/call_later/call_at``
+targets, ``add_done_callback`` callbacks registered in loop context, or
+configured entries), worker-thread (reachable from ``Thread(target=…)``
+/ ``executor.submit(fn)`` / ``run_in_executor`` / ``to_thread``
+targets), or neither. The same conservative stance applies: a function
+REFERENCE handed to a scheduler resolves only when it is visibly a
+project function — which also means executor hops naturally END the
+loop walk, because the handed-off callable produces no call edge.
 """
 
 from __future__ import annotations
@@ -33,7 +44,12 @@ import ast
 import dataclasses
 from typing import Iterable
 
-from .core import FunctionInfo, ProjectIndex, iter_nodes_shallow
+from .core import (
+    AnalysisConfig,
+    FunctionInfo,
+    ProjectIndex,
+    iter_nodes_shallow,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +61,7 @@ class CallSite:
     dotted: str | None  # "time.sleep", "open", … None when unresolvable
     method: str | None  # bare attr name for ".item()"-style rules
     target: str | None  # project function ref "relpath::qualname"
+    awaited: bool = False  # directly under an ``await`` — yields, not blocks
 
 
 def _dotted_name(node: ast.AST) -> str | None:
@@ -60,7 +77,10 @@ def _dotted_name(node: ast.AST) -> str | None:
 
 
 def resolve_call(
-    index: ProjectIndex, caller: FunctionInfo, call: ast.Call
+    index: ProjectIndex,
+    caller: FunctionInfo,
+    call: ast.Call,
+    awaited: bool = False,
 ) -> CallSite:
     func = call.func
     line = call.lineno
@@ -100,7 +120,18 @@ def resolve_call(
             )
             if attr_cls:
                 target = index.class_method(attr_cls, func.attr)
-        # mod.f(...) / Class.m(...)
+        # mod.OBJ.m(...) — module singleton through a module alias
+        elif (
+            isinstance(value, ast.Attribute)
+            and isinstance(value.value, ast.Name)
+            and value.value.id in mod.module_imports
+        ):
+            obj_cls = index.module_attr_types.get(
+                (mod.module_imports[value.value.id], value.attr)
+            )
+            if obj_cls:
+                target = index.class_method(obj_cls, func.attr)
+        # mod.f(...) / Class.m(...) / OBJ.m(...)
         elif isinstance(value, ast.Name):
             name = value.id
             if name in mod.module_imports:
@@ -115,12 +146,25 @@ def resolve_call(
                 # local alias spelling is kept too via `dotted`
                 root = mod.external_imports[name].split(".")[0]
                 dotted = f"{root}.{func.attr}"
+            else:
+                # module singleton: same-module NAME, or
+                # "from X import NAME" where X assigned NAME = Class()
+                obj_cls = index.module_attr_types.get(
+                    (caller.relpath, name)
+                )
+                if obj_cls is None and name in mod.name_imports:
+                    obj_cls = index.module_attr_types.get(
+                        mod.name_imports[name]
+                    )
+                if obj_cls:
+                    target = index.class_method(obj_cls, func.attr)
 
     return CallSite(
         line=line,
         dotted=dotted,
         method=method,
         target=target.ref if target is not None else None,
+        awaited=awaited,
     )
 
 
@@ -128,10 +172,25 @@ def function_calls(
     index: ProjectIndex, info: FunctionInfo
 ) -> list[CallSite]:
     """Every call site in ``info``'s own scope (closures excluded)."""
+    nodes = list(iter_nodes_shallow(info.node))
+    # every call under an ``await`` expression counts as awaited — the
+    # direct coroutine call, and coroutine factories handed to awaited
+    # combinators (``await asyncio.wait_for(event.wait(), …)``: that
+    # ``.wait()`` builds a coroutine, it does not block)
+    awaited_ids: set[int] = set()
+    for node in nodes:
+        if isinstance(node, ast.Await):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    awaited_ids.add(id(sub))
     out: list[CallSite] = []
-    for node in iter_nodes_shallow(info.node):
+    for node in nodes:
         if isinstance(node, ast.Call):
-            out.append(resolve_call(index, info, node))
+            out.append(
+                resolve_call(
+                    index, info, node, awaited=id(node) in awaited_ids
+                )
+            )
     return out
 
 
@@ -150,20 +209,31 @@ class CallGraph:
             )
         return self._sites[ref]
 
-    def reachable(self, entries: Iterable[str]) -> dict[str, list[str]]:
+    def reachable(
+        self,
+        entries: Iterable[str],
+        cuts: Iterable[str] = (),
+    ) -> dict[str, list[str]]:
         """BFS from ``entries`` → ``{ref: call path from an entry}``.
-        The path (entry → … → ref) makes findings explainable."""
+        The path (entry → … → ref) makes findings explainable. ``cuts``
+        are refs the walk never enters — statically reachable functions
+        that a dispatch layer guarantees never RUN in this context."""
+        cut_set = set(cuts)
         paths: dict[str, list[str]] = {}
         queue: list[str] = []
         for entry in entries:
-            if self.index.function(entry) and entry not in paths:
+            if (
+                self.index.function(entry)
+                and entry not in paths
+                and entry not in cut_set
+            ):
                 paths[entry] = [entry]
                 queue.append(entry)
         while queue:
             ref = queue.pop(0)
             for site in self.sites(ref):
                 tgt = site.target
-                if tgt is not None and tgt not in paths:
+                if tgt is not None and tgt not in paths and tgt not in cut_set:
                     paths[tgt] = paths[ref] + [tgt]
                     queue.append(tgt)
         return paths
@@ -180,3 +250,248 @@ def match_forbidden(
     if site.method is not None and site.method in forbidden_methods:
         return f".{site.method}()"
     return None
+
+
+# ---------------------------------------------------------------------------
+# execution-context classification (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+# asyncio transport base classes whose callbacks the loop invokes
+_PROTOCOL_BASES = frozenset(
+    {
+        "asyncio.Protocol",
+        "asyncio.BufferedProtocol",
+        "asyncio.DatagramProtocol",
+        "asyncio.SubprocessProtocol",
+    }
+)
+
+# loop scheduling methods -> positional index of the callback argument
+_SCHEDULE_CALLBACK_ARG = {
+    "call_soon": 0,
+    "call_soon_threadsafe": 0,
+    "call_later": 1,
+    "call_at": 1,
+}
+
+# thread-launch calls -> positional index of the callable argument
+# (`Thread(target=…)` passes it by keyword and is handled separately)
+_THREAD_CALLABLE_ARG = {
+    "submit": 0,  # Executor.submit — only counts when arg 0 RESOLVES
+    "run_in_executor": 1,
+    "to_thread": 0,
+}
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """Which functions run where. ``loop``/``thread`` map each
+    reachable ref to its call path from a context root; ``loop_roots``
+    maps each loop root to WHY it is one (for findings)."""
+
+    loop: dict[str, list[str]]
+    thread: dict[str, list[str]]
+    loop_roots: dict[str, str]
+
+    def contexts(self, ref: str) -> set[str]:
+        out: set[str] = set()
+        if ref in self.loop:
+            out.add("event-loop")
+        if ref in self.thread:
+            out.add("worker-thread")
+        return out
+
+
+def resolve_func_ref(
+    index: ProjectIndex, caller: FunctionInfo, node: ast.AST
+) -> str | None:
+    """Resolve a bare function REFERENCE (a callback handed to a
+    scheduler) to a project ref, under the same conservative rules as
+    :func:`resolve_call`. Locals, parameters, and closures → None."""
+    mod = index.modules[caller.relpath]
+    if isinstance(node, ast.Name):
+        info = index.functions.get((caller.relpath, node.id))
+        if info is None and node.id in mod.name_imports:
+            info = index.functions.get(mod.name_imports[node.id])
+        return info.ref if info else None
+    if isinstance(node, ast.Attribute):
+        value = node.value
+        if isinstance(value, ast.Name):
+            if value.id == "self" and caller.class_name:
+                info = index.class_method(caller.class_name, node.attr)
+                return info.ref if info else None
+            if value.id in mod.module_imports:
+                info = index.functions.get(
+                    (mod.module_imports[value.id], node.attr)
+                )
+                return info.ref if info else None
+            if index.classes.get(value.id) is not None:
+                info = index.class_method(value.id, node.attr)
+                return info.ref if info else None
+        elif (
+            isinstance(value, ast.Attribute)
+            and isinstance(value.value, ast.Name)
+            and value.value.id == "self"
+            and caller.class_name
+        ):
+            attr_cls = index.attr_types.get(
+                (caller.class_name, value.attr)
+            )
+            if attr_cls:
+                info = index.class_method(attr_cls, node.attr)
+                return info.ref if info else None
+    return None
+
+
+def _callback_targets(
+    index: ProjectIndex, caller: FunctionInfo, node: ast.AST
+) -> list[str]:
+    """Refs a callback argument may invoke: the ref itself, or — for a
+    lambda — every resolvable call in its body (the lambda runs in the
+    scheduler's context, so its calls do too)."""
+    ref = resolve_func_ref(index, caller, node)
+    if ref is not None:
+        return [ref]
+    if isinstance(node, ast.Lambda):
+        out: list[str] = []
+        for sub in ast.walk(node.body):
+            if isinstance(sub, ast.Call):
+                site = resolve_call(index, caller, sub)
+                if site.target is not None:
+                    out.append(site.target)
+        return out
+    return []
+
+
+def _is_protocol_class(index: ProjectIndex, class_name: str) -> bool:
+    relpath = index.classes.get(class_name)
+    if relpath is None:
+        return False
+    mod = index.modules[relpath]
+    for base in index.class_bases.get(class_name, ()):
+        if base in _PROTOCOL_BASES:
+            return True
+        # "from asyncio import Protocol" / aliased imports
+        if "." not in base and mod.external_imports.get(base) in _PROTOCOL_BASES:
+            return True
+    return False
+
+
+def _scheduled_loop_roots(index: ProjectIndex) -> dict[str, str]:
+    """Global pre-pass: targets of ``loop.call_soon``/``call_later``/
+    ``call_at``/``call_soon_threadsafe`` anywhere in the tree (full
+    walk, closures and lambdas included — ``call_soon_threadsafe``
+    schedules ONTO the loop from any context, so the scheduling site's
+    own context is irrelevant)."""
+    roots: dict[str, str] = {}
+    for info in index.functions.values():
+        for node in ast.walk(info.node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            argidx = _SCHEDULE_CALLBACK_ARG.get(node.func.attr)
+            if argidx is None or len(node.args) <= argidx:
+                continue
+            for ref in _callback_targets(index, info, node.args[argidx]):
+                roots.setdefault(
+                    ref,
+                    f"scheduled onto the loop by "
+                    f"`{info.qualname}` via {node.func.attr}",
+                )
+    return roots
+
+
+def _thread_roots(index: ProjectIndex) -> dict[str, str]:
+    """Targets handed to threads/executors anywhere in the tree:
+    ``Thread(target=f)``, ``pool.submit(f)``, ``loop.run_in_executor
+    (None, f)``, ``asyncio.to_thread(f)``. Only resolvable project
+    function refs count — `batcher.submit(request)` hands off DATA, not
+    a callable, and produces no root."""
+    roots: dict[str, str] = {}
+    for info in index.functions.values():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            if dotted is not None and dotted.split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        ref = resolve_func_ref(index, info, kw.value)
+                        if ref:
+                            roots.setdefault(
+                                ref,
+                                f"thread target launched by "
+                                f"`{info.qualname}`",
+                            )
+                continue
+            if isinstance(node.func, ast.Attribute):
+                argidx = _THREAD_CALLABLE_ARG.get(node.func.attr)
+                if argidx is None or len(node.args) <= argidx:
+                    continue
+                ref = resolve_func_ref(index, info, node.args[argidx])
+                if ref:
+                    roots.setdefault(
+                        ref,
+                        f"handed to an executor by `{info.qualname}` "
+                        f"via {node.func.attr}",
+                    )
+    return roots
+
+
+def classify_contexts(
+    index: ProjectIndex, cfg: AnalysisConfig, graph: CallGraph | None = None
+) -> ExecContext:
+    """Classify every function by execution context (see module
+    docstring). Loop reachability honors ``cfg.loop_cut_functions`` and
+    iterates to a fixpoint over ``add_done_callback`` registrations:
+    a done-callback registered by loop-context code runs on the loop
+    (asyncio futures) or is a ``call_soon_threadsafe`` trampoline whose
+    real target the scheduling pre-pass already captured."""
+    graph = graph or CallGraph(index)
+    loop_roots: dict[str, str] = {}
+    for info in index.functions.values():
+        if isinstance(info.node, ast.AsyncFunctionDef):
+            loop_roots.setdefault(info.ref, "async def")
+        elif info.class_name and _is_protocol_class(index, info.class_name):
+            loop_roots.setdefault(
+                info.ref, f"asyncio protocol callback on {info.class_name}"
+            )
+    loop_roots.update(_scheduled_loop_roots(index))
+    for entry in cfg.loop_entries:
+        if index.function(entry) is not None:
+            loop_roots.setdefault(entry, "configured loop entry")
+
+    cuts = set(cfg.loop_cut_functions)
+    loop_paths = graph.reachable(loop_roots, cuts=cuts)
+    while True:
+        added = False
+        for ref in list(loop_paths):
+            info = index.function(ref)
+            if info is None:
+                continue
+            for node in ast.walk(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_done_callback"
+                    and node.args
+                ):
+                    continue
+                for tgt in _callback_targets(index, info, node.args[0]):
+                    if tgt not in loop_paths and tgt not in cuts:
+                        loop_roots.setdefault(
+                            tgt,
+                            f"done-callback registered in loop context "
+                            f"by `{info.qualname}`",
+                        )
+                        added = True
+        if not added:
+            break
+        loop_paths = graph.reachable(loop_roots, cuts=cuts)
+
+    thread_paths = graph.reachable(_thread_roots(index))
+    return ExecContext(
+        loop=loop_paths, thread=thread_paths, loop_roots=loop_roots
+    )

@@ -278,6 +278,91 @@ class AnalysisConfig:
         "kubernetes/job-multihost.yaml",
     )
 
+    # --- loopblock checker (ISSUE 20) ---
+    # Event-loop roots the classifier cannot auto-detect: the asyncio
+    # transport calls these through locals/attrs the conservative graph
+    # refuses to resolve (`app = state.app; app.handle(...)` inline in
+    # `_Conn._dispatch` for non-recommend routes; the loop-native
+    # batcher's admission/flush pair). Auto-detected roots — asyncio
+    # Protocol callbacks, `async def`s, call_soon/call_later targets —
+    # need no entry here.
+    loop_entries: tuple[str, ...] = (
+        "kmlserver_tpu/serving/app.py::RecommendApp.handle",
+        "kmlserver_tpu/serving/app.py::RecommendApp.finish_recommend",
+        "kmlserver_tpu/serving/batcher.py::AsyncMicroBatcher.submit",
+        "kmlserver_tpu/serving/batcher.py::AsyncMicroBatcher._flush",
+    )
+    # Statically reachable from a loop entry but never RUN on the loop:
+    # the asyncio transport intercepts recommend POSTs in `_dispatch`
+    # (before the inline `app.handle` call) and routes them through the
+    # engine pool / loop-native batcher, so `_post_recommend`'s and
+    # `recommend_direct`'s blocking branches only execute on the
+    # threaded front end. Cutting here keeps the loop map honest; the
+    # anchor test pins both refs so a rename can't hollow the cut.
+    loop_cut_functions: tuple[str, ...] = (
+        "kmlserver_tpu/serving/app.py::RecommendApp._post_recommend",
+        "kmlserver_tpu/serving/app.py::RecommendApp.recommend_direct",
+    )
+    # Blocking constructs forbidden in event-loop context, by resolved
+    # dotted name. jax.device_put / np.asarray are deliberately ABSENT:
+    # async-dispatch staging pays those on the loop by design (bounded
+    # work), unlike the unbounded stalls below.
+    loopblock_forbidden_calls: tuple[str, ...] = (
+        "time.sleep",
+        "open",
+        "os.replace",
+        "os.rename",
+        "os.fsync",
+        "os.fdopen",
+        "os.statvfs",
+        "subprocess.run",
+        "subprocess.Popen",
+        "subprocess.check_output",
+        "socket.create_connection",
+        "pickle.load",
+        "pickle.dump",
+        "json.load",
+        "json.dump",
+        "jax.jit",
+        "jax.block_until_ready",
+    )
+    # … and by bare method name on any receiver. `wait`/`acquire`/
+    # `result` only match UN-awaited call sites — `await x.wait()`
+    # yields to the loop, `x.wait()` freezes it.
+    loopblock_forbidden_methods: tuple[str, ...] = (
+        "result",
+        "wait",
+        "acquire",
+        "item",
+        "block_until_ready",
+    )
+
+    # --- lockown checker (ISSUE 20) ---
+    # minimum guarded accesses before a field's owning lock is inferred;
+    # below this the evidence is too thin to call an unguarded write a
+    # race (deliberately lock-free classes stay silent).
+    lockown_min_guarded: int = 2
+    # the repo's documented ownership-handoff convention: a method named
+    # `*_locked` is only ever called with the owning lock already held
+    # (forecast._roll_locked, mesh._close_locked). Such methods are
+    # excluded from both the ownership vote and the unguarded-write
+    # sweep — the suffix IS the documentation.
+    lockown_held_suffix: str = "_locked"
+
+    # --- envread checker (ISSUE 20) ---
+    # project wrappers around os.getenv — a call to one of these at
+    # module import time freezes the knob exactly like a bare getenv
+    envread_helper_functions: tuple[str, ...] = (
+        "kmlserver_tpu/config.py::_getenv_int",
+        "kmlserver_tpu/config.py::_getenv_float",
+        "kmlserver_tpu/config.py::_getenv_bool",
+        "kmlserver_tpu/config.py::_getenv_hybrid_mode",
+        "kmlserver_tpu/config.py::_getenv_blend_weight",
+        "kmlserver_tpu/config.py::_getenv_model_layout",
+        "kmlserver_tpu/config.py::_getenv_gang_rank",
+        "kmlserver_tpu/config.py::_getenv_bitpack_threshold",
+    )
+
 
 # ---------------------------------------------------------------------------
 # project index
@@ -343,8 +428,15 @@ class ProjectIndex:
         self.methods_by_name: dict[str, list[FunctionInfo]] = {}
         # (class, attr) -> class name of the attribute's value
         self.attr_types: dict[tuple[str, str], str] = {}
+        # class name -> dotted base expressions ("asyncio.Protocol")
+        self.class_bases: dict[str, list[str]] = {}
+        # (relpath, NAME) -> class, for module-level singletons
+        # ``MONITOR = IoHealthMonitor()`` — lets the call graph resolve
+        # ``mod.MONITOR.m()`` the way attr_types resolves ``self.x.m()``
+        self.module_attr_types: dict[tuple[str, str], str] = {}
         for relpath in sorted(py_files):
             self._index_file(relpath)
+        self._scrape_module_singletons()
 
     # ---------- construction ----------
 
@@ -371,6 +463,11 @@ class ProjectIndex:
                 self._add_function(relpath, node.name, node, None)
             elif isinstance(node, ast.ClassDef):
                 self.classes[node.name] = relpath
+                self.class_bases[node.name] = [
+                    dotted
+                    for base in node.bases
+                    if (dotted := _dotted_expr(base)) is not None
+                ]
                 for item in node.body:
                     if isinstance(
                         item, (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -392,6 +489,29 @@ class ProjectIndex:
         self.functions[(relpath, qualname)] = info
         method = qualname.rsplit(".", 1)[-1]
         self.methods_by_name.setdefault(method, []).append(info)
+
+    def _scrape_module_singletons(self) -> None:
+        """Second pass (all classes known): module-level ``NAME =
+        ClassName()`` assignments, recorded so calls through the
+        singleton resolve to that class's methods."""
+        for relpath, mod in self.modules.items():
+            for node in mod.tree.body:
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Name)
+                ):
+                    continue
+                cls = node.value.func.id
+                if cls not in self.classes and cls in mod.name_imports:
+                    _src, orig = mod.name_imports[cls]
+                    cls = orig
+                if cls in self.classes:
+                    self.module_attr_types[
+                        (relpath, node.targets[0].id)
+                    ] = cls
 
     def _index_imports(self, mod: ModuleInfo) -> None:
         """Best-effort: map local names onto project module relpaths.
@@ -505,6 +625,18 @@ class ProjectIndex:
         if 1 <= lineno <= len(lines):
             return lines[lineno - 1]
         return ""
+
+
+def _dotted_expr(node: ast.AST) -> str | None:
+    """Flatten a Name/Attribute chain → "a.b.c" (None otherwise)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
 
 
 def _annotation_class_name(node: ast.AST) -> str | None:
@@ -645,9 +777,12 @@ def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Fi
     from . import (
         atomicwrite,
         costspec,
+        envread,
         exitcodes,
         hotpath,
         locking,
+        lockown,
+        loopblock,
         metricsreg,
         registries,
     )
@@ -661,6 +796,9 @@ def all_checkers() -> dict[str, Callable[[ProjectIndex, AnalysisConfig], list[Fi
         "exit-codes": exitcodes.run,
         "metrics": metricsreg.run,
         "costspec": costspec.run,
+        "loopblock": loopblock.run,
+        "lockown": lockown.run,
+        "envread": envread.run,
     }
 
 

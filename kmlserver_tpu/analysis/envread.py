@@ -1,0 +1,263 @@
+"""Checker 11 — env reads at import time or under a jit trace.
+
+PR 12's bug class: a ``KMLS_*`` knob read at module import time (or,
+worse, inside a ``jax.jit``-traced function) freezes its value — into
+the process for import-time reads, into the compiled artifact for
+traced reads — so flipping the env var later silently does nothing.
+The project contract is that knobs are read LAZILY through the
+``config._getenv_*`` helpers at call time, from untraced code.
+
+Two sweeps, both pure-AST:
+
+- **import time** — ``os.getenv`` / ``os.environ.get`` /
+  ``os.environ[...]`` / any configured project helper called at module
+  scope (class bodies and module-level ``if``/``try`` blocks included;
+  function bodies excluded — they run later).
+- **jit-traced** — the same reads inside any function reachable from a
+  jit root. Roots are detected structurally: ``@jax.jit`` and
+  ``@partial(jax.jit, …)`` decorators, module-level ``name =
+  jax.jit(impl)`` / ``name = partial(jax.jit, …)(impl)`` wrappings, and
+  in-function ``jax.jit(fn)`` calls with a resolvable target — the
+  shapes the ``ops/`` and ``parallel/`` kernels actually use (the
+  anchor test pins that these roots keep existing). Reachability rides
+  the conservative project call graph.
+
+Findings whose literal names a registered knob carry its
+``KNOB_REGISTRY`` scope, cross-checked via the knobs checker's parser,
+so the message says exactly which declared knob just got frozen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .callgraph import CallGraph, _dotted_name, resolve_func_ref
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    ProjectIndex,
+)
+from .registries import parse_knob_registry
+
+
+def _canon_dotted(mod: ModuleInfo, dotted: str) -> str:
+    """Canonicalize the leading alias segment through the module's
+    external imports ("getenv" -> "os.getenv", "environ.get" ->
+    "os.environ.get")."""
+    root, _, rest = dotted.partition(".")
+    ext = mod.external_imports.get(root)
+    if ext:
+        return f"{ext}.{rest}" if rest else ext
+    return dotted
+
+
+def _literal_arg(node: ast.Call) -> str | None:
+    if node.args:
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return str(first.value)
+    return None
+
+
+def _env_read(
+    index: ProjectIndex,
+    mod: ModuleInfo,
+    node: ast.AST,
+    helpers: frozenset[str],
+) -> tuple[str, str | None] | None:
+    """→ (construct, env-var literal or None) when ``node`` reads the
+    environment; None otherwise."""
+    if isinstance(node, ast.Subscript):
+        dotted = _dotted_name(node.value)
+        if dotted and _canon_dotted(mod, dotted) == "os.environ":
+            name: str | None = None
+            sub = node.slice
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                name = sub.value
+            return "os.environ[...]", name
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = _dotted_name(node.func)
+    if dotted is not None:
+        canon = _canon_dotted(mod, dotted)
+        if canon in ("os.getenv", "os.environ.get"):
+            return canon, _literal_arg(node)
+    # project helper call: same-module def or "from config import helper"
+    if isinstance(node.func, ast.Name):
+        name = node.func.id
+        ref = None
+        if (mod.relpath, name) in index.functions:
+            ref = f"{mod.relpath}::{name}"
+        elif name in mod.name_imports:
+            src_rel, src_name = mod.name_imports[name]
+            ref = f"{src_rel}::{src_name}"
+        if ref is not None and ref in helpers:
+            return f"{name}()", _literal_arg(node)
+    return None
+
+
+def _module_scope_nodes(mod: ModuleInfo) -> Iterator[ast.AST]:
+    """Every node that executes at import time: the module body,
+    descending through class bodies and control flow but NEVER into
+    function/lambda bodies."""
+    stack: list[ast.AST] = list(mod.tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jit_expr(mod: ModuleInfo, node: ast.AST) -> bool:
+    """True for ``jax.jit`` and ``partial(jax.jit, …)`` expressions."""
+    dotted = _dotted_name(node)
+    if dotted is not None and _canon_dotted(mod, dotted) == "jax.jit":
+        return True
+    if isinstance(node, ast.Call):
+        func = _dotted_name(node.func)
+        if func is not None and _canon_dotted(mod, func) in (
+            "functools.partial",
+            "partial",
+        ):
+            return bool(node.args) and _is_jit_expr(mod, node.args[0])
+    return False
+
+
+def jit_roots(index: ProjectIndex) -> dict[str, str]:
+    """Function refs whose bodies are traced by jax.jit (see module
+    docstring for the recognized shapes) → why."""
+    roots: dict[str, str] = {}
+    for info in index.functions.values():
+        mod = index.modules[info.relpath]
+        node = info.node
+        decorators = getattr(node, "decorator_list", [])
+        for dec in decorators:
+            if _is_jit_expr(mod, dec):
+                roots.setdefault(info.ref, "jit-decorated")
+        # in-function jax.jit(fn) / partial(jax.jit, …)(fn) wrappings
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call) and sub.args):
+                continue
+            if _is_jit_expr(mod, sub.func) and not isinstance(
+                sub.func, ast.Call
+            ):
+                # direct jax.jit(fn)
+                ref = resolve_func_ref(index, info, sub.args[0])
+                if ref:
+                    roots.setdefault(
+                        ref, f"jit-wrapped in `{info.qualname}`"
+                    )
+            elif isinstance(sub.func, ast.Call) and _is_jit_expr(
+                mod, sub.func
+            ):
+                # partial(jax.jit, …)(fn)
+                ref = resolve_func_ref(index, info, sub.args[0])
+                if ref:
+                    roots.setdefault(
+                        ref, f"jit-wrapped in `{info.qualname}`"
+                    )
+    # module-level wrappings: name = jax.jit(impl) & co
+    for relpath, mod in index.modules.items():
+        for node in _module_scope_nodes(mod):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if not _is_jit_expr(mod, node.func):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                info2 = index.functions.get((relpath, arg.id))
+                if info2 is None and arg.id in mod.name_imports:
+                    info2 = index.functions.get(mod.name_imports[arg.id])
+                if info2 is not None:
+                    roots.setdefault(
+                        info2.ref, "jit-wrapped at module level"
+                    )
+    return roots
+
+
+def _knob_note(
+    name: str | None, knob_scopes: dict[str, str], prefix: str
+) -> str:
+    if name is None:
+        return ""
+    if name in knob_scopes:
+        return (
+            f" `{name}` is a registered {knob_scopes[name]}-scope knob —"
+            " flipping it after this read silently does nothing."
+        )
+    if name.startswith(prefix):
+        return f" `{name}` is not in KNOB_REGISTRY."
+    return ""
+
+
+def run(index: ProjectIndex, cfg: AnalysisConfig) -> list[Finding]:
+    helpers = frozenset(cfg.envread_helper_functions)
+    knob_scopes, _lines, _reg_line = parse_knob_registry(index, cfg)
+    findings: list[Finding] = []
+
+    # sweep 1: import-time reads (the config module itself is exempt —
+    # its helpers' bodies are functions anyway, and its registry is data)
+    for relpath in sorted(index.modules):
+        mod = index.modules[relpath]
+        for node in _module_scope_nodes(mod):
+            hit = _env_read(index, mod, node, helpers)
+            if hit is None:
+                continue
+            construct, name = hit
+            findings.append(
+                Finding(
+                    checker="envread",
+                    severity=SEVERITY_ERROR,
+                    file=relpath,
+                    line=getattr(node, "lineno", 0),
+                    key=f"import-time:{name or construct}",
+                    message=(
+                        f"environment read `{construct}` at module "
+                        "import time: the value freezes when the module "
+                        "first loads, defeating lazy knob reads (PR 12 "
+                        "bug class) — move it into the function that "
+                        f"needs it.{_knob_note(name, knob_scopes, cfg.knob_prefix)}"
+                    ),
+                )
+            )
+
+    # sweep 2: reads inside jit-traced functions
+    graph = CallGraph(index)
+    roots = jit_roots(index)
+    paths = graph.reachable(roots)
+    for ref in sorted(paths):
+        info = index.function(ref)
+        if info is None:
+            continue
+        mod = index.modules[info.relpath]
+        for node in ast.walk(info.node):
+            hit = _env_read(index, mod, node, helpers)
+            if hit is None:
+                continue
+            construct, name = hit
+            path = paths[ref]
+            via = " -> ".join(p.split("::", 1)[1] for p in path)
+            reason = roots.get(path[0], "jit root")
+            findings.append(
+                Finding(
+                    checker="envread",
+                    severity=SEVERITY_ERROR,
+                    file=info.relpath,
+                    line=getattr(node, "lineno", 0),
+                    key=f"jit:{name or construct}@{info.qualname}",
+                    message=(
+                        f"environment read `{construct}` inside "
+                        f"jit-traced `{info.qualname}` (traced via "
+                        f"{via}; root is {reason}): the value bakes "
+                        "into the compiled artifact at first trace — "
+                        "read it at call time and pass it as an "
+                        f"argument.{_knob_note(name, knob_scopes, cfg.knob_prefix)}"
+                    ),
+                )
+            )
+    return findings

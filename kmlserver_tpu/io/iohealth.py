@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from ..config import _getenv_float
 
@@ -36,6 +37,9 @@ from ..config import _getenv_float
 EWMA_ALPHA = 0.2
 MIN_SAMPLES = 8
 DEFAULT_SLOW_MS = 250.0
+# how stale the cached free-space reading may get before the next
+# artifact operation re-runs statvfs
+DISK_REFRESH_S = 5.0
 
 
 class IoHealthMonitor:
@@ -49,6 +53,8 @@ class IoHealthMonitor:
         self._retries = 0
         self._slow = False
         self._disk_path: str | None = None
+        self._disk_free: int | None = None
+        self._disk_free_at: float | None = None
 
     # ---------- observations ----------
 
@@ -57,6 +63,9 @@ class IoHealthMonitor:
         conviction. ``op`` ∈ token_poll / read / write / fsync."""
         seconds = max(seconds, 0.0)
         slow_s = _getenv_float("KMLS_IO_SLOW_MS", DEFAULT_SLOW_MS) / 1e3
+        # every observation comes from a thread already touching the
+        # PVC — the safe place to keep the free-space cache warm
+        self.refresh_disk_free()
         with self._lock:
             prev = self._ewma_s.get(op)
             self._ewma_s[op] = (
@@ -88,20 +97,46 @@ class IoHealthMonitor:
     # ---------- disk space ----------
 
     def watch_disk(self, path: str) -> None:
-        """Point the free-space gauge at the artifact mount."""
+        """Point the free-space gauge at the artifact mount. Callers are
+        PVC-touching threads (preflight, engine load), so the immediate
+        first refresh is safe here."""
         with self._lock:
             self._disk_path = path
+            self._disk_free_at = None  # force the refresh below
+        self.refresh_disk_free()
 
-    def disk_free_bytes(self) -> int | None:
+    def refresh_disk_free(self) -> int | None:
+        """Re-run ``statvfs`` on the watched mount and cache the result
+        (rate-limited to one probe per :data:`DISK_REFRESH_S`). Only
+        ever called from the worker threads that already touch the PVC —
+        NEVER from the event loop: on a sick NFS mount ``statvfs`` can
+        hang for seconds, the exact gray failure this monitor exists to
+        convict (the loopblock checker pins the loop side to the cached
+        :meth:`disk_free_bytes` read)."""
         with self._lock:
             path = self._disk_path
+            stamp = self._disk_free_at
+            cached = self._disk_free
         if not path:
             return None
+        now = time.monotonic()
+        if stamp is not None and now - stamp < DISK_REFRESH_S:
+            return cached
         try:
             stat = os.statvfs(path)
+            free: int | None = stat.f_bavail * stat.f_frsize
         except OSError:
-            return None
-        return stat.f_bavail * stat.f_frsize
+            free = None
+        with self._lock:
+            self._disk_free = free
+            self._disk_free_at = now
+        return free
+
+    def disk_free_bytes(self) -> int | None:
+        """Last cached free-space reading — loop-safe: never touches the
+        disk (see :meth:`refresh_disk_free`)."""
+        with self._lock:
+            return self._disk_free
 
     # ---------- state reads ----------
 
@@ -133,6 +168,8 @@ class IoHealthMonitor:
             self._retries = 0
             self._slow = False
             self._disk_path = None
+            self._disk_free = None
+            self._disk_free_at = None
 
 
 # One process-wide monitor: artifacts.py feeds it from whichever thread

@@ -693,6 +693,10 @@ class RecommendApp:
         if not full.startswith(root + os.sep):
             return _json_response(404, {"detail": "Not Found"})
         try:
+            # kmls-verify: allow[loopblock] — deliberate: static assets
+            # are a handful of small local files (dashboard HTML/JS) on
+            # the container image, not the PVC; a sub-ms read is cheaper
+            # than an executor hop and the route is cold
             with open(full, "rb") as fh:
                 data = fh.read()
         except (OSError, IsADirectoryError):
@@ -1444,6 +1448,8 @@ class RecommendApp:
         resolved to DeadlineExceeded/NoHealthyReplicas degrades to the
         fallback answer for the seeds that rode in on the future."""
         try:
+            # kmls-verify: allow[loopblock] — callers hand in a DONE
+            # future (docstring contract above); result() only unwraps
             recs, source = future.result()
         except Exception as exc:
             if isinstance(exc, MeshShardUnavailable):

@@ -1497,6 +1497,9 @@ class AsyncMicroBatcher:
     def _complete(
         self, batch, task, t_dispatch: float, loop, idx: int, finish=None
     ) -> None:
+        # kmls-verify: allow[loopblock] — scheduled via
+        # call_soon_threadsafe from the executor task's done-callback,
+        # so the task is complete and result() returns immediately
         self._resolve(batch, task.result(), t_dispatch, loop, idx, finish)
 
     def _resolve(

@@ -3,8 +3,8 @@
 
 Usage (from the repo root)::
 
-    python scripts/kmls_verify.py                 # all eight checkers
-    python scripts/kmls_verify.py --checker knobs --checker locks
+    python scripts/kmls_verify.py                 # all eleven checkers
+    python scripts/kmls_verify.py --checker knobs --checker loopblock
     python scripts/kmls_verify.py --json          # machine-readable
     python scripts/kmls_verify.py --write-baseline  # accept current findings
 

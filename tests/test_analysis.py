@@ -881,6 +881,368 @@ def test_costspec_pragma_suppresses_forwarding_helper(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# checker 9: event-loop blocking (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+# the PR 18 regression, reconstructed: an asyncio.Protocol callback
+# dispatches into a project helper whose body blocks the loop
+_LOOPBLOCK_FAULTS = """
+    import time
+
+    def fire(site, replica=None):
+        time.sleep(0.05)
+
+    def take(site, replica=None):
+        return 0.05
+    """
+
+_LOOPBLOCK_BAD = """
+    import asyncio
+
+    from .faults import fire
+
+    class _Conn(asyncio.Protocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def data_received(self, data):
+            self._dispatch(data)
+
+        def _dispatch(self, data):
+            fire("fleet.peer")
+            self.transport.write(data)
+    """
+
+# the compliant twin — the PR 18 hot-fix shape: take() the delay and
+# schedule delivery with loop.call_later instead of sleeping inline
+_LOOPBLOCK_GOOD = """
+    import asyncio
+
+    from .faults import take
+
+    class _Conn(asyncio.Protocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def data_received(self, data):
+            self._dispatch(data)
+
+        def _dispatch(self, data):
+            delay = take("fleet.peer")
+            loop = asyncio.get_running_loop()
+            loop.call_later(delay, self._deliver, data)
+
+        def _deliver(self, data):
+            self.transport.write(data)
+    """
+
+_LOOPBLOCK_ASYNC = """
+    import asyncio
+    import pickle
+
+    def load_model(path):
+        with open(path, "rb") as fh:
+            return pickle.load(fh)
+
+    async def handler(pool, state, fut, path):
+        # awaited calls yield, they don't block: exempt — including the
+        # coroutine FACTORY handed to an awaited combinator
+        await asyncio.wait_for(state.idle.wait(), timeout=1.0)
+        # an executor hop ends the loop-context walk: load_model runs
+        # on a worker thread even though it blocks
+        pool.submit(load_model, path)
+        # ...but an inline un-awaited result() IS a loop stall
+        return fut.result()
+    """
+
+
+def test_loopblock_flags_protocol_dispatch_blocking(tmp_path):
+    """The PR 18 `_dispatch` stall: blocking reached FROM an asyncio
+    protocol callback is flagged with the entry path and root reason."""
+    write_tree(
+        tmp_path,
+        {"pkg/aio.py": _LOOPBLOCK_BAD, "pkg/faults.py": _LOOPBLOCK_FAULTS},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["loopblock"])
+    got = {f.key: f.message for f in result["findings"]}
+    assert "time.sleep@fire" in got, got
+    message = got["time.sleep@fire"]
+    assert "_dispatch -> fire" in message
+    assert "asyncio protocol callback on _Conn" in message
+
+
+def test_loopblock_quiet_on_call_later_shape(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/aio.py": _LOOPBLOCK_GOOD, "pkg/faults.py": _LOOPBLOCK_FAULTS},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["loopblock"])
+    assert result["findings"] == []
+
+
+def test_loopblock_awaited_exempt_executor_escapes_result_flagged(
+    tmp_path,
+):
+    write_tree(tmp_path, {"pkg/aio.py": _LOOPBLOCK_ASYNC})
+    result = run_fixture(tmp_path, fixture_cfg(), ["loopblock"])
+    got = keys(result, "loopblock")
+    # the inline fut.result() on the loop is the ONLY finding: the
+    # awaited .wait() is exempt and load_model escaped to the executor
+    assert got == {".result()@handler"}, got
+
+
+def test_loopblock_pragma_suppresses(tmp_path):
+    bad = _LOOPBLOCK_FAULTS.replace(
+        "time.sleep(0.05)",
+        "time.sleep(0.05)  # kmls-verify: allow[loopblock] fixture",
+    )
+    write_tree(
+        tmp_path, {"pkg/aio.py": _LOOPBLOCK_BAD, "pkg/faults.py": bad}
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["loopblock"])
+    assert "time.sleep@fire" not in keys(result)
+    assert any(
+        f.key == "time.sleep@fire" for f in result["suppressed"]
+    )
+
+
+def test_loopblock_baseline_round_trip(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/aio.py": _LOOPBLOCK_BAD, "pkg/faults.py": _LOOPBLOCK_FAULTS},
+    )
+    cfg = fixture_cfg()
+    first = run_fixture(tmp_path, cfg, ["loopblock"])
+    assert first["findings"]
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, first["findings"])
+    second = run_fixture(
+        tmp_path, cfg, ["loopblock"], baseline=load_baseline(baseline_path)
+    )
+    assert second["findings"] == []
+    assert len(second["baselined"]) == len(first["findings"])
+
+
+# ---------------------------------------------------------------------------
+# checker 10: lock-ownership race inference (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+_LOCKOWN_BAD = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._label = ""
+
+        def incr(self):
+            with self._lock:
+                self._count += 1
+
+        def read(self):
+            with self._lock:
+                return self._count
+
+        def reset(self):
+            self._count = 0
+    """
+
+_LOCKOWN_GOOD = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._hint = 0
+
+        def incr(self):
+            with self._lock:
+                self._count += 1
+                self._roll_locked()
+
+        def read(self):
+            with self._lock:
+                return self._count
+
+        def _roll_locked(self):
+            # `*_locked` handoff convention: caller holds the lock
+            self._count = 0
+
+        def hint(self):
+            with self._lock:
+                self._hint = 1
+
+        def guess(self):
+            # one guarded access is below the evidence bar: no owner is
+            # inferred for _hint, so this write must NOT be flagged
+            self._hint = 2
+    """
+
+
+def test_lockown_flags_unguarded_write_with_majority_owner(tmp_path):
+    write_tree(tmp_path, {"pkg/state.py": _LOCKOWN_BAD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["lockown"])
+    got = {f.key: f.message for f in result["findings"]}
+    assert set(got) == {"unguarded:_count@Tracker.reset"}, got
+    # the message names the inferred owning lock and the evidence count
+    assert "Tracker._lock" in got["unguarded:_count@Tracker.reset"]
+    # _label has no post-__init__ accesses: never voted, never flagged
+    assert not any("_label" in k for k in got)
+
+
+def test_lockown_quiet_on_locked_suffix_and_thin_evidence(tmp_path):
+    write_tree(tmp_path, {"pkg/state.py": _LOCKOWN_GOOD})
+    result = run_fixture(tmp_path, fixture_cfg(), ["lockown"])
+    assert result["findings"] == []
+
+
+def test_lockown_unguarded_reads_are_not_findings(tmp_path):
+    # a snapshot read outside the lock is deliberate policy, not a race
+    bad = _LOCKOWN_BAD.replace(
+        "def reset(self):\n            self._count = 0",
+        "def reset(self):\n            return self._count + 1",
+    )
+    write_tree(tmp_path, {"pkg/state.py": bad})
+    result = run_fixture(tmp_path, fixture_cfg(), ["lockown"])
+    assert result["findings"] == []
+
+
+def test_lockown_pragma_suppresses(tmp_path):
+    bad = _LOCKOWN_BAD.replace(
+        "self._count = 0",
+        "self._count = 0  # kmls-verify: allow[lockown] fixture",
+    )
+    write_tree(tmp_path, {"pkg/state.py": bad})
+    result = run_fixture(tmp_path, fixture_cfg(), ["lockown"])
+    assert result["findings"] == []
+    assert any(
+        f.key == "unguarded:_count@Tracker.reset"
+        for f in result["suppressed"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# checker 11: env reads at import/jit time (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+_ENVREAD_CONFIG = """
+    KNOB_REGISTRY: dict[str, str] = {
+        "KMLS_DEADLINE_S": "serving",
+        "KMLS_TOPK": "serving",
+    }
+    """
+
+# the PR 12 bug class: module-level reads freeze the knob at import
+_ENVREAD_BAD = """
+    import os
+
+    DEADLINE = float(os.environ.get("KMLS_DEADLINE_S", "1200"))
+    MODE = os.getenv("KMLS_MODE", "hybrid")
+
+    def fn():
+        return DEADLINE
+    """
+
+_ENVREAD_JIT = """
+    import os
+
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        k = int(os.environ["KMLS_TOPK"])
+        return x * k
+
+    def outer(x):
+        return jax.jit(impl)(x)
+
+    def impl(x):
+        return float(os.getenv("KMLS_SCALE", "1.0")) * x
+    """
+
+_ENVREAD_GOOD = """
+    import os
+
+    DEADLINE_DEFAULT = 1200.0
+
+    def deadline():
+        return float(
+            os.environ.get("KMLS_DEADLINE_S", str(DEADLINE_DEFAULT))
+        )
+
+    def kernel_host(x):
+        return deadline() * x
+    """
+
+
+def test_envread_flags_import_time_reads_with_knob_scope(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/bench.py": _ENVREAD_BAD, "pkg/config.py": _ENVREAD_CONFIG},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["envread"])
+    got = {f.key: f.message for f in result["findings"]}
+    assert set(got) == {
+        "import-time:KMLS_DEADLINE_S",
+        "import-time:KMLS_MODE",
+    }, got
+    # the registered knob's scope is cross-checked into the message; the
+    # unregistered one is called out as missing from KNOB_REGISTRY
+    assert "serving-scope knob" in got["import-time:KMLS_DEADLINE_S"]
+    assert "not in KNOB_REGISTRY" in got["import-time:KMLS_MODE"]
+
+
+def test_envread_flags_reads_inside_jit_traced_functions(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/ops.py": _ENVREAD_JIT, "pkg/config.py": _ENVREAD_CONFIG},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["envread"])
+    got = keys(result, "envread")
+    # both root shapes: @jax.jit decorator AND in-function jax.jit(fn)
+    assert got == {"jit:KMLS_TOPK@kernel", "jit:KMLS_SCALE@impl"}, got
+
+
+def test_envread_quiet_on_lazy_call_time_reads(tmp_path):
+    write_tree(
+        tmp_path,
+        {"pkg/bench.py": _ENVREAD_GOOD, "pkg/config.py": _ENVREAD_CONFIG},
+    )
+    result = run_fixture(tmp_path, fixture_cfg(), ["envread"])
+    assert result["findings"] == []
+
+
+def test_envread_sees_project_helper_calls_at_module_scope(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "pkg/config.py": (
+                "import os\n\n"
+                "def getenv_int(name, default):\n"
+                "    return int(os.getenv(name, str(default)))\n"
+            ),
+            "pkg/serve.py": (
+                "from .config import getenv_int\n\n"
+                'LIMIT = getenv_int("KMLS_LIMIT", 4)\n\n'
+                "def ok():\n"
+                '    return getenv_int("KMLS_LIMIT", 4)\n'
+            ),
+        },
+    )
+    result = run_fixture(
+        tmp_path,
+        fixture_cfg(
+            envread_helper_functions=("pkg/config.py::getenv_int",)
+        ),
+        ["envread"],
+    )
+    # the module-scope helper call is flagged; the call-time one is not
+    assert keys(result, "envread") == {"import-time:KMLS_LIMIT"}
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip + CLI gate
 # ---------------------------------------------------------------------------
 
@@ -1150,6 +1512,58 @@ def test_real_tree_indexes_the_things_checkers_depend_on():
     assert unresolved == [], unresolved
 
 
+def test_real_tree_concurrency_anchors():
+    """ISSUE 20 anchors: the execution-context model's configured refs
+    and structural roots must keep resolving on the real tree — a rename
+    would otherwise silently hollow loopblock/lockown/envread."""
+    cfg = AnalysisConfig()
+    index = ProjectIndex.from_config(REPO_ROOT, cfg)
+    # configured loop entries/cuts and env-helper refs all resolve
+    for ref in (
+        cfg.loop_entries
+        + cfg.loop_cut_functions
+        + cfg.envread_helper_functions
+    ):
+        assert index.function(ref) is not None, ref
+    from kmlserver_tpu.analysis.callgraph import (
+        _is_protocol_class,
+        classify_contexts,
+    )
+
+    # the PR 18 anchor: _Conn is an asyncio protocol subclass and its
+    # _dispatch is classified event-loop — the acceptance scenario
+    # (re-introducing a blocking fire() there) depends on exactly this
+    assert _is_protocol_class(index, "_Conn")
+    ctx = classify_contexts(index, cfg)
+    dispatch = "kmlserver_tpu/serving/aioserver.py::_Conn._dispatch"
+    assert dispatch in ctx.loop, sorted(ctx.loop)[:20]
+    assert "protocol callback" in ctx.loop_roots[ctx.loop[dispatch][0]]
+    # the engine pool keeps a worker-thread context too
+    assert ctx.thread, "no thread roots found on the real tree"
+    # module singletons resolve (lockown/loopblock see MONITOR.method())
+    assert (
+        index.module_attr_types.get(
+            ("kmlserver_tpu/io/iohealth.py", "MONITOR")
+        )
+        == "IoHealthMonitor"
+    )
+    # envread's jit roots: the ops/ kernels keep their traced shapes
+    from kmlserver_tpu.analysis.envread import jit_roots
+
+    roots = jit_roots(index)
+    assert any(
+        ref.startswith("kmlserver_tpu/ops/") for ref in roots
+    ), sorted(roots)
+    # lockown's marquee cross-context classes still own discovered locks
+    from kmlserver_tpu.analysis.locking import discover_locks
+
+    locks, _aliases = discover_locks(index)
+    owners = {lock.owner for lock in locks}
+    assert {"IoHealthMonitor", "TrafficForecaster"} <= owners, sorted(
+        owners
+    )
+
+
 def test_cli_exit_codes(tmp_path):
     """The CLI is the CI gate: clean tree -> 0, violation -> 1."""
     script = os.path.join(REPO_ROOT, "scripts", "kmls_verify.py")
@@ -1174,9 +1588,18 @@ def test_cli_exit_codes(tmp_path):
 @pytest.mark.parametrize(
     "checker",
     ["hotpath", "locks", "atomic-write", "knobs", "fault-sites",
-     "exit-codes", "metrics", "costspec"],
+     "exit-codes", "metrics", "costspec", "loopblock", "lockown",
+     "envread"],
 )
 def test_every_checker_registered(checker):
     from kmlserver_tpu.analysis.core import all_checkers
 
     assert checker in all_checkers()
+
+
+def test_checker_count_ratchet():
+    """Eleven checkers as of ISSUE 20 — a dropped registration must
+    fail loudly, not silently shrink the gate."""
+    from kmlserver_tpu.analysis.core import all_checkers
+
+    assert len(all_checkers()) == 11, sorted(all_checkers())

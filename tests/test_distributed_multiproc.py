@@ -119,6 +119,83 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# Minimal 2-process jax.distributed CPU bootstrap — nothing but init and
+# a process_count() check. If THIS can't run, the dead-rank watchdog test
+# below can only ever time out on the environment, not on the watchdog.
+_WORKER_PROBE = r"""
+import os, sys
+
+rank, port = sys.argv[1], sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["KMLS_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+os.environ["KMLS_NUM_PROCESSES"] = "2"
+os.environ["KMLS_PROCESS_ID"] = rank
+
+from kmlserver_tpu.parallel.distributed import maybe_initialize
+
+assert maybe_initialize() is True
+import jax
+
+assert jax.process_count() == 2, jax.process_count()
+print(f"PROBE RANK {rank} OK", flush=True)
+"""
+
+
+def _scrubbed_env() -> dict[str, str]:
+    env = os.environ.copy()
+    for var in ("XLA_FLAGS", "JAX_PLATFORMS", "KMLS_COORDINATOR_ADDRESS",
+                "KMLS_NUM_PROCESSES", "KMLS_PROCESS_ID",
+                "KMLS_FAULT_RANK_DEAD"):
+        env.pop(var, None)
+    return env
+
+
+_PROBE_RESULT: list[str | None] = []
+
+
+def _distributed_cpu_init_blocker() -> str | None:
+    """Probe (cached per session): spawn the minimal 2-process CPU
+    bootstrap once and return None when it works, else a short reason
+    naming what the ENVIRONMENT cannot do. Sandboxed CI runners without
+    working localhost gRPC (or with a coordinator service that never
+    comes up) fail here identically at every commit — skipping with the
+    probe's reason keeps the watchdog test meaningful where it CAN run
+    instead of reporting an environment defect as a watchdog defect."""
+    if _PROBE_RESULT:
+        return _PROBE_RESULT[0]
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_PROBE, str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_scrubbed_env(), cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    reason: str | None = None
+    try:
+        outs = [p.communicate(timeout=90)[0] for p in procs]
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0 or f"PROBE RANK {rank} OK" not in out:
+                tail = "\n".join(out.strip().splitlines()[-3:])
+                reason = (
+                    f"2-process jax.distributed CPU init failed on "
+                    f"rank {rank} (rc={p.returncode}): {tail}"
+                )
+                break
+    except subprocess.TimeoutExpired:
+        reason = "2-process jax.distributed CPU init hung (>90s)"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+    _PROBE_RESULT.append(reason)
+    return reason
+
+
 # Dead-rank watchdog acceptance (ISSUE 4): rank 1 joins the distributed
 # runtime, then goes silent — KMLS_FAULT_RANK_DEAD stops its heartbeats and
 # it never enters the collective. Without the watchdog rank 0 would block in
@@ -139,10 +216,14 @@ if rank == "1":
     os.environ["KMLS_FAULT_RANK_DEAD"] = "1"
 
 from kmlserver_tpu.parallel.distributed import RankWatchdog, maybe_initialize
-from kmlserver_tpu.mining.job import EXIT_RANK_DEAD
 
 assert maybe_initialize() is True
 import jax
+
+# AFTER initialize: importing mining.job runs a jax computation during
+# module import, and jax.distributed.initialize() refuses to run once
+# any computation has executed
+from kmlserver_tpu.mining.job import EXIT_RANK_DEAD
 
 wd = RankWatchdog(
     os.path.join(base, "heartbeats"), rank=int(rank), num_processes=2,
@@ -176,12 +257,11 @@ def test_dead_rank_aborts_within_timeout(tmp_path):
 
     from kmlserver_tpu.mining.job import EXIT_RANK_DEAD
 
+    blocker = _distributed_cpu_init_blocker()
+    if blocker is not None:
+        pytest.skip(f"distributed-cpu-init-unavailable: {blocker}")
     port = _free_port()
-    env = os.environ.copy()
-    for var in ("XLA_FLAGS", "JAX_PLATFORMS", "KMLS_COORDINATOR_ADDRESS",
-                "KMLS_NUM_PROCESSES", "KMLS_PROCESS_ID",
-                "KMLS_FAULT_RANK_DEAD"):
-        env.pop(var, None)
+    env = _scrubbed_env()
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER_DEADRANK,
